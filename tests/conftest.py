@@ -1,0 +1,18 @@
+"""Test configuration: run on CPU with 8 virtual devices.
+
+Multi-chip hardware is not available in CI; sharding tests exercise a virtual
+8-device CPU mesh (mirrors how the driver dry-runs dryrun_multichip). Must be
+set before jax initializes — conftest is imported before any test module.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell pre-sets the tpu tunnel
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,111 @@
+"""Columnar core tests (types, Column/Page, dictionary encoding).
+
+Mirrors the reference's spi-level unit tier (core/trino-spi tests, SURVEY §4):
+drive the data model directly with numpy rows.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.page import Column, Dictionary, Page, concat_pages
+
+
+def test_type_registry_roundtrip():
+    for text, typ in [
+        ("bigint", T.BIGINT), ("integer", T.INTEGER), ("double", T.DOUBLE),
+        ("boolean", T.BOOLEAN), ("varchar", T.VARCHAR), ("date", T.DATE),
+        ("decimal(12,2)", T.DecimalType(12, 2)),
+        ("varchar(25)", T.VarcharType(25)),
+    ]:
+        assert T.parse_type(text) == typ
+
+
+def test_coercion_lattice():
+    assert T.common_super_type(T.INTEGER, T.BIGINT) == T.BIGINT
+    assert T.common_super_type(T.BIGINT, T.DOUBLE) == T.DOUBLE
+    assert T.common_super_type(T.UNKNOWN, T.DATE) == T.DATE
+    assert T.common_super_type(
+        T.DecimalType(12, 2), T.DecimalType(10, 4)) == T.DecimalType(14, 4)
+    # bigint forces 19 integer digits -> would exceed short-decimal precision;
+    # round 1 falls back to double rather than long decimals
+    assert T.common_super_type(T.DecimalType(10, 2), T.BIGINT) == T.DOUBLE
+    assert T.common_super_type(T.DecimalType(10, 2), T.INTEGER) == T.DecimalType(12, 2)
+    assert T.common_super_type(
+        T.TimestampType(3), T.TimestampType(6)) == T.TimestampType(6)
+    assert T.common_super_type(T.BOOLEAN, T.BIGINT) is None
+
+
+def test_dictionary_sorted_codes_preserve_order():
+    d, codes = Dictionary.build(["cherry", "apple", "banana", "apple"])
+    assert list(d.values) == ["apple", "banana", "cherry"]
+    assert codes.tolist() == [2, 0, 1, 0]
+    assert d.code_of("banana") == 1
+    assert d.code_of("zzz") == -1
+    # code order == string order
+    assert (codes[1] < codes[2]) == ("apple" < "banana")
+
+
+def test_page_from_numpy_and_back():
+    page = Page.from_numpy(
+        [np.array([1, 2, 3]), np.array([1.5, 2.5, 3.5]),
+         np.array(["b", "a", "b"], dtype=object)],
+        [T.BIGINT, T.DOUBLE, T.VARCHAR])
+    assert page.capacity == 3 and int(page.num_rows) == 3
+    rows = page.to_pylist()
+    assert rows == [(1, 1.5, "b"), (2, 2.5, "a"), (3, 3.5, "b")]
+
+
+def test_page_filter_compacts():
+    page = Page.from_numpy([np.arange(8), np.arange(8) * 10.0],
+                           [T.BIGINT, T.DOUBLE])
+    mask = jnp.asarray([True, False, True, False, True, False, False, True])
+    out = page.filter(mask)
+    assert out.capacity == 8
+    assert int(out.num_rows) == 4
+    assert out.to_pylist() == [(0, 0.0), (2, 20.0), (4, 40.0), (7, 70.0)]
+
+
+def test_page_filter_respects_num_rows():
+    # rows beyond num_rows are padding and must not pass the filter
+    page = Page.from_numpy([np.arange(8)], [T.BIGINT])
+    page = Page(page.columns, jnp.asarray(5, dtype=jnp.int32))
+    out = page.filter(jnp.ones(8, dtype=jnp.bool_))
+    assert int(out.num_rows) == 5
+
+
+def test_page_filter_under_jit():
+    page = Page.from_numpy([np.arange(16), np.arange(16) * 2.0],
+                           [T.BIGINT, T.DOUBLE])
+
+    @jax.jit
+    def go(p):
+        return p.filter(p.column(0).values % 3 == 0)
+
+    out = go(page)
+    assert int(out.num_rows) == 6
+    assert [r[0] for r in out.to_pylist()] == [0, 3, 6, 9, 12, 15]
+
+
+def test_nulls_roundtrip():
+    page = Page.from_numpy([np.array([1, 2, 3])], [T.BIGINT],
+                           valids=[np.array([True, False, True])])
+    assert page.to_pylist() == [(1,), (None,), (3,)]
+
+
+def test_concat_pages():
+    p1 = Page.from_numpy([np.array([1, 2])], [T.BIGINT])
+    p2 = Page.from_numpy([np.array([3])], [T.BIGINT])
+    out = concat_pages([p1, p2])
+    assert out.to_pylist() == [(1,), (2,), (3,)]
+
+
+def test_page_is_pytree():
+    page = Page.from_numpy([np.arange(4)], [T.BIGINT])
+    leaves = jax.tree_util.tree_leaves(page)
+    assert len(leaves) == 2  # values + num_rows
+    rebuilt = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(page), leaves)
+    assert rebuilt.to_pylist() == page.to_pylist()

@@ -1,0 +1,46 @@
+"""TupleDomain/Domain/Range algebra tests (spi/predicate analog)."""
+
+from trino_tpu import types as T
+from trino_tpu.predicate import Domain, Range, TupleDomain
+
+
+def test_range_intersect():
+    a = Range.between(1, 10)
+    b = Range.greater_than(5)
+    r = a.intersect(b)
+    assert (r.low, r.low_inclusive, r.high, r.high_inclusive) == (5, False, 10, True)
+    assert a.intersect(Range.less_than(0)) is None
+    assert Range.equal(5).intersect(Range.between(1, 10)) == Range.equal(5)
+
+
+def test_domain_intersect_and_none():
+    d1 = Domain.from_range(T.BIGINT, Range.between(1, 10))
+    d2 = Domain.from_range(T.BIGINT, Range.greater_equal(11))
+    assert d1.intersect(d2).is_none()
+    d3 = Domain.from_range(T.BIGINT, Range.greater_equal(10))
+    assert d1.intersect(d3).get_single_value() == 10
+
+
+def test_domain_discrete_values():
+    d = Domain.multiple_values(T.BIGINT, [3, 1, 2, 3])
+    assert d.values_if_discrete() == [1, 2, 3]
+    assert d.overlaps_range(2, 2)
+    assert not d.overlaps_range(4, 9)
+
+
+def test_tuple_domain_intersect():
+    td1 = TupleDomain.with_column_domains(
+        {"a": Domain.from_range(T.BIGINT, Range.between(0, 100))})
+    td2 = TupleDomain.with_column_domains(
+        {"a": Domain.from_range(T.BIGINT, Range.greater_than(50)),
+         "b": Domain.single_value(T.VARCHAR, "x")})
+    out = td1.intersect(td2)
+    lo, hi = out.domain("a").bounds()
+    assert (lo, hi) == (50, 100)
+    assert out.domain("b").get_single_value() == "x"
+    assert TupleDomain.none().intersect(td1).is_none()
+
+
+def test_tuple_domain_contradiction_collapses():
+    td = TupleDomain.with_column_domains({"a": Domain.none(T.BIGINT)})
+    assert td.is_none()

@@ -1,0 +1,20 @@
+"""trino_tpu — a TPU-native distributed SQL analytics engine.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of Trino
+(reference surveyed in SURVEY.md): SQL frontend -> cost-based optimizer ->
+plan fragments compiled to jit/shard_map programs over a TPU mesh, with
+columnar Pages as pytrees and ICI collectives as the exchange data plane.
+"""
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# SQL semantics require 64-bit lanes (BIGINT keys, DOUBLE aggregation,
+# microsecond timestamps); JAX defaults to 32-bit. Engine-wide x64 is a
+# correctness requirement; kernels narrow to int32/bf16 where the planner
+# proves it safe (e.g. dictionary codes, date arithmetic).
+_jax.config.update("jax_enable_x64", True)
+
+from trino_tpu import types
+from trino_tpu.page import Column, Dictionary, Page

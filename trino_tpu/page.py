@@ -1,0 +1,299 @@
+"""Columnar Page/Column data model as JAX pytrees.
+
+Reference parity: core/trino-spi/src/main/java/io/trino/spi/Page.java:33 and
+spi/block/ (68 files). Design decisions (SURVEY.md §7.1):
+
+- A Column = device value array + optional validity mask (replaces the Block
+  hierarchy: nulls-as-bitmask instead of null flags per block kind).
+- Strings are dictionary-encoded (spi/block/DictionaryBlock analog): device
+  holds int32 codes; the host-side Dictionary holds the sorted string pool, so
+  comparisons and ORDER BY on codes match string collation.
+- A Page = tuple of equal-capacity Columns + a traced `num_rows` scalar. XLA
+  needs static shapes, so pages have a static *capacity* (array length) and a
+  dynamic row count; rows in [num_rows, capacity) are padding. Filters compact
+  via `jnp.nonzero(size=...)` + gather (Page.filter), the device analog of
+  Page.getPositions (spi/Page.java:332) / Block.copyPositions.
+- Columns/Pages are registered pytrees so whole operator pipelines jit/shard
+  cleanly; Type and Dictionary ride as static aux data (hash/eq by identity id
+  for dictionaries, so repeated pages of one table never retrace).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+
+_dict_ids = itertools.count()
+
+
+class Dictionary:
+    """Host-side sorted string pool backing a dictionary-encoded column.
+
+    Codes are indices into `values` (np.ndarray of str, ascending order), so
+    integer comparison of codes == string comparison of values. Code -1 is
+    reserved for padding. Identity-hashed so it can be jit-static aux data.
+    """
+
+    __slots__ = ("values", "id")
+
+    def __init__(self, values: np.ndarray):
+        values = np.asarray(values, dtype=object)
+        # sortedness is what makes device-side <,>,min,max on codes correct
+        if values.size > 1 and not all(
+                values[i] <= values[i + 1] for i in range(len(values) - 1)):
+            raise ValueError("dictionary must be sorted")
+        self.values = values
+        self.id = next(_dict_ids)
+
+    @classmethod
+    def build(cls, strings: Sequence[str]) -> Tuple["Dictionary", np.ndarray]:
+        """Encode `strings` -> (dictionary, int32 codes)."""
+        uniq, codes = np.unique(np.asarray(strings, dtype=object),
+                                return_inverse=True)
+        return cls(uniq), codes.astype(np.int32)
+
+    def code_of(self, s: str) -> int:
+        """Exact-match lookup; -1 if absent (used to fold literals)."""
+        i = int(np.searchsorted(self.values, s))
+        if i < len(self.values) and self.values[i] == s:
+            return i
+        return -1
+
+    def lower_bound(self, s: str) -> int:
+        return int(np.searchsorted(self.values, s, side="left"))
+
+    def upper_bound(self, s: str) -> int:
+        return int(np.searchsorted(self.values, s, side="right"))
+
+    def decode(self, codes: np.ndarray) -> np.ndarray:
+        out = np.empty(len(codes), dtype=object)
+        valid = codes >= 0
+        out[valid] = self.values[codes[valid]]
+        out[~valid] = None
+        return out
+
+    def __len__(self):
+        return len(self.values)
+
+    def __hash__(self):
+        return self.id
+
+    def __eq__(self, other):
+        return self is other
+
+    def __repr__(self):  # pragma: no cover
+        return f"Dictionary(id={self.id}, n={len(self.values)})"
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Column:
+    """One columnar vector. Reference: spi/block/Block.java:25.
+
+    values : device array [capacity] of type.dtype
+    valid  : optional bool device array [capacity]; None = no nulls
+    type   : SQL Type (static)
+    dictionary : for string types, the host string pool (static)
+    """
+
+    values: jnp.ndarray
+    valid: Optional[jnp.ndarray]
+    type: T.Type
+    dictionary: Optional[Dictionary] = None
+
+    def tree_flatten(self):
+        if self.valid is None:
+            return (self.values,), (False, self.type, self.dictionary)
+        return (self.values, self.valid), (True, self.type, self.dictionary)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        has_valid, typ, dictionary = aux
+        if has_valid:
+            values, valid = children
+        else:
+            (values,), valid = children, None
+        return cls(values, valid, typ, dictionary)
+
+    @property
+    def capacity(self) -> int:
+        return self.values.shape[0]
+
+    def valid_mask(self) -> jnp.ndarray:
+        """Always-materialized validity mask."""
+        if self.valid is None:
+            return jnp.ones(self.capacity, dtype=jnp.bool_)
+        return self.valid
+
+    def gather(self, indices: jnp.ndarray) -> "Column":
+        """copyPositions analog (Block.java:250).
+
+        Out-of-range indices clip to the last row: padding rows of a filtered
+        page are garbage copies of a live row. INVARIANT: consumers must mask
+        with Page.row_mask() — num_rows, not validity, delimits live rows.
+        """
+        values = jnp.take(self.values, indices, mode="clip")
+        valid = None
+        if self.valid is not None:
+            valid = jnp.take(self.valid, indices, mode="clip")
+        return Column(values, valid, self.type, self.dictionary)
+
+    def with_valid(self, valid: Optional[jnp.ndarray]) -> "Column":
+        return Column(self.values, valid, self.type, self.dictionary)
+
+    @classmethod
+    def from_numpy(cls, data: np.ndarray, typ: T.Type,
+                   valid: Optional[np.ndarray] = None,
+                   dictionary: Optional[Dictionary] = None) -> "Column":
+        if T.is_string(typ) and dictionary is None:
+            dictionary, codes = Dictionary.build(data)
+            data = codes
+        arr = jnp.asarray(np.asarray(data, dtype=T.to_numpy_dtype(typ)))
+        v = None if valid is None else jnp.asarray(valid, dtype=jnp.bool_)
+        return cls(arr, v, typ, dictionary)
+
+    def to_numpy(self, num_rows: Optional[int] = None) -> np.ndarray:
+        """Decode back to host values (python objects for strings/nulls)."""
+        n = self.capacity if num_rows is None else int(num_rows)
+        vals = np.asarray(self.values)[:n]
+        if self.dictionary is not None:
+            out = self.dictionary.decode(vals)
+        else:
+            out = vals.astype(object)
+        if self.valid is not None:
+            mask = ~np.asarray(self.valid)[:n]
+            out = out.copy()
+            out[mask] = None
+        return out
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Page:
+    """Horizontal batch of Columns + dynamic row count.
+
+    Reference: spi/Page.java:33. `num_rows` may be a traced scalar under jit;
+    `capacity` (static) is the shared array length of all columns.
+    """
+
+    columns: Tuple[Column, ...]
+    num_rows: jnp.ndarray  # int32 scalar (python int ok outside jit)
+
+    def tree_flatten(self):
+        return (tuple(self.columns), self.num_rows), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        columns, num_rows = children
+        return cls(tuple(columns), num_rows)
+
+    @property
+    def capacity(self) -> int:
+        return self.columns[0].capacity if self.columns else 0
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def column(self, i: int) -> Column:
+        return self.columns[i]
+
+    def row_mask(self) -> jnp.ndarray:
+        """Mask of live rows ([0, num_rows))."""
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.num_rows
+
+    def append_column(self, col: Column) -> "Page":
+        return Page(self.columns + (col,), self.num_rows)
+
+    def select_columns(self, indices: Sequence[int]) -> "Page":
+        return Page(tuple(self.columns[i] for i in indices), self.num_rows)
+
+    def filter(self, mask: jnp.ndarray) -> "Page":
+        """Compact rows where mask is true (Page.getPositions analog).
+
+        jit-safe: output keeps this page's capacity; selected rows move to the
+        front, num_rows becomes the selected count.
+        """
+        mask = mask & self.row_mask()
+        (idx,) = jnp.nonzero(mask, size=self.capacity, fill_value=self.capacity)
+        count = jnp.sum(mask).astype(jnp.int32)
+        cols = tuple(c.gather(idx) for c in self.columns)
+        return Page(cols, count)
+
+    def gather(self, indices: jnp.ndarray, count) -> "Page":
+        cols = tuple(c.gather(indices) for c in self.columns)
+        return Page(cols, jnp.asarray(count, dtype=jnp.int32))
+
+    def pad_to(self, capacity: int) -> "Page":
+        """Grow capacity (static) without changing live rows."""
+        if capacity < self.capacity:
+            raise ValueError("pad_to cannot shrink")
+        if capacity == self.capacity:
+            return self
+        extra = capacity - self.capacity
+        cols = []
+        for c in self.columns:
+            values = jnp.concatenate(
+                [c.values, jnp.zeros((extra,), dtype=c.values.dtype)])
+            valid = None
+            if c.valid is not None:
+                valid = jnp.concatenate(
+                    [c.valid, jnp.zeros((extra,), dtype=jnp.bool_)])
+            cols.append(Column(values, valid, c.type, c.dictionary))
+        return Page(tuple(cols), self.num_rows)
+
+    @classmethod
+    def from_numpy(cls, arrays: Sequence[np.ndarray], typs: Sequence[T.Type],
+                   valids: Optional[Sequence[Optional[np.ndarray]]] = None,
+                   dictionaries: Optional[Sequence[Optional[Dictionary]]] = None,
+                   ) -> "Page":
+        n = len(arrays[0]) if arrays else 0
+        valids = valids or [None] * len(arrays)
+        dictionaries = dictionaries or [None] * len(arrays)
+        cols = tuple(
+            Column.from_numpy(a, t, v, d)
+            for a, t, v, d in zip(arrays, typs, valids, dictionaries))
+        return cls(cols, jnp.asarray(n, dtype=jnp.int32))
+
+    def to_pylist(self) -> list:
+        """Rows as python tuples (client-result materialization)."""
+        n = int(self.num_rows)
+        cols = [c.to_numpy(n) for c in self.columns]
+        return [tuple(col[i] for col in cols) for i in range(n)]
+
+
+def concat_pages(pages: Sequence[Page]) -> Page:
+    """Host-side page concatenation (not jit-safe; used at stage boundaries)."""
+    if not pages:
+        raise ValueError("no pages")
+    if len(pages) == 1:
+        return pages[0]
+    ncols = pages[0].num_columns
+    counts = [int(p.num_rows) for p in pages]
+    total = sum(counts)
+    cols = []
+    for ci in range(ncols):
+        ref = pages[0].column(ci)
+        if any(p.column(ci).dictionary is not ref.dictionary for p in pages):
+            raise ValueError(
+                f"column {ci}: pages use different dictionaries; re-encode "
+                "to a shared dictionary before concatenating")
+        parts = [np.asarray(p.column(ci).values)[:c]
+                 for p, c in zip(pages, counts)]
+        values = jnp.asarray(np.concatenate(parts)) if total else ref.values[:0]
+        valid = None
+        if any(p.column(ci).valid is not None for p in pages):
+            vparts = [
+                np.asarray(p.column(ci).valid_mask())[:c]
+                for p, c in zip(pages, counts)
+            ]
+            valid = jnp.asarray(np.concatenate(vparts))
+        cols.append(Column(values, valid, ref.type, ref.dictionary))
+    return Page(tuple(cols), jnp.asarray(total, dtype=jnp.int32))
