@@ -1,0 +1,218 @@
+"""Expression engine tests: arithmetic/Java semantics, 3VL, dictionary folding.
+
+Mirrors reference operator/scalar tests + sql/gen PageProcessor tests.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.expr import (
+    Call, InputRef, Literal, SpecialForm, SpecialKind,
+    compile_expression, compile_filter)
+from trino_tpu.expr.functions import days_from_civil
+from trino_tpu.page import Page
+
+
+def page_of(*cols):
+    arrays, typs, valids = [], [], []
+    for c in cols:
+        if len(c) == 3:
+            a, t, v = c
+        else:
+            (a, t), v = c, None
+        arrays.append(np.asarray(a) if not isinstance(a, np.ndarray) else a)
+        typs.append(t)
+        valids.append(None if v is None else np.asarray(v, dtype=bool))
+    return Page.from_numpy(arrays, typs, valids=valids)
+
+
+def run(expr, page):
+    col = compile_expression(expr)(page)
+    return col.to_numpy(int(page.num_rows)).tolist()
+
+
+def test_arithmetic_java_semantics():
+    page = page_of(([7, -7, 9], T.BIGINT), ([2, 2, -4], T.BIGINT))
+    a, b = InputRef(0, T.BIGINT), InputRef(1, T.BIGINT)
+    # Java integer division truncates toward zero
+    assert run(Call("divide", (a, b), T.BIGINT), page) == [3, -3, -2]
+    # Java % takes the dividend's sign
+    assert run(Call("modulus", (a, b), T.BIGINT), page) == [1, -1, 1]
+
+
+def test_double_arithmetic_and_null_propagation():
+    page = page_of(([1.5, 2.0, 3.0], T.DOUBLE, [1, 0, 1]),
+                   ([2.0, 4.0, 5.0], T.DOUBLE))
+    e = Call("multiply", (InputRef(0, T.DOUBLE), InputRef(1, T.DOUBLE)), T.DOUBLE)
+    assert run(e, page) == [3.0, None, 15.0]
+
+
+def test_decimal_scaled_arithmetic():
+    # decimal(10,2): 1.50 + 2.25 = 3.75 ; 10.00 * 0.50 = 5.0000 -> scale 4
+    page = page_of(([150, 1000], T.DecimalType(10, 2)),
+                   ([225, 50], T.DecimalType(10, 2)))
+    add = Call("add", (InputRef(0, T.DecimalType(10, 2)),
+                       InputRef(1, T.DecimalType(10, 2))), T.DecimalType(11, 2))
+    assert run(add, page) == [375, 1050]
+    mul = Call("multiply", (InputRef(0, T.DecimalType(10, 2)),
+                            InputRef(1, T.DecimalType(10, 2))), T.DecimalType(18, 4))
+    # 1.50*2.25 = 3.3750 ; 10.00*0.50 = 5.0000 (scale 4)
+    assert run(mul, page) == [33750, 50000]
+
+
+def test_kleene_logic():
+    # a AND b with nulls: false AND null = false; true AND null = null
+    page = page_of(([True, True, False, False], T.BOOLEAN, [1, 0, 1, 0]),
+                   ([True, True, True, True], T.BOOLEAN))
+    e = SpecialForm(SpecialKind.AND,
+                    (InputRef(0, T.BOOLEAN), InputRef(1, T.BOOLEAN)), T.BOOLEAN)
+    assert run(e, page) == [True, None, False, None]
+    # false AND null = false (null on the right)
+    page2 = page_of(([False, True], T.BOOLEAN),
+                    ([True, False], T.BOOLEAN, [0, 0]))
+    assert run(e, page2) == [False, None]
+    e_or = SpecialForm(SpecialKind.OR,
+                       (InputRef(0, T.BOOLEAN), InputRef(1, T.BOOLEAN)), T.BOOLEAN)
+    # true OR null = true
+    assert run(e_or, page2) == [None, True]
+
+
+def test_filter_null_is_false():
+    page = page_of(([1, 2, 3], T.BIGINT, [1, 0, 1]))
+    mask = compile_filter(
+        Call("gt", (InputRef(0, T.BIGINT), Literal(1, T.BIGINT)), T.BOOLEAN))(page)
+    assert np.asarray(mask).tolist() == [False, False, True]
+
+
+def test_string_dictionary_folding():
+    page = page_of((np.array(["BRASS", "COPPER", "STEEL", "BRASS"], dtype=object),
+                    T.VARCHAR))
+    col = InputRef(0, T.VARCHAR)
+    eq = Call("eq", (col, Literal("BRASS", T.VARCHAR)), T.BOOLEAN)
+    assert run(eq, page) == [True, False, False, True]
+    lt = Call("lt", (col, Literal("COPPER", T.VARCHAR)), T.BOOLEAN)
+    assert run(lt, page) == [True, False, False, True]
+    # literal on the left flips
+    gt = Call("gt", (Literal("COPPER", T.VARCHAR), col), T.BOOLEAN)
+    assert run(gt, page) == [True, False, False, True]
+    absent = Call("eq", (col, Literal("GOLD", T.VARCHAR)), T.BOOLEAN)
+    assert run(absent, page) == [False, False, False, False]
+
+
+def test_like():
+    page = page_of((np.array(["PROMO BRUSHED", "STANDARD", "PROMO X", "MEDIUM"],
+                             dtype=object), T.VARCHAR))
+    e = Call("like", (InputRef(0, T.VARCHAR), Literal("PROMO%", T.VARCHAR)),
+             T.BOOLEAN)
+    assert run(e, page) == [True, False, True, False]
+    e2 = Call("like", (InputRef(0, T.VARCHAR), Literal("%D%", T.VARCHAR)), T.BOOLEAN)
+    assert run(e2, page) == [True, True, False, True]
+
+
+def test_string_transform_substr():
+    page = page_of((np.array(["alpha", "beta", "gamma"], dtype=object), T.VARCHAR))
+    e = Call("substr", (InputRef(0, T.VARCHAR), Literal(1, T.INTEGER),
+                        Literal(2, T.INTEGER)), T.VARCHAR)
+    assert run(e, page) == ["al", "be", "ga"]
+    up = Call("upper", (InputRef(0, T.VARCHAR),), T.VARCHAR)
+    assert run(up, page) == ["ALPHA", "BETA", "GAMMA"]
+
+
+def test_date_extract():
+    days = [days_from_civil(1994, 1, 1), days_from_civil(1998, 12, 31),
+            days_from_civil(1970, 1, 1), days_from_civil(1969, 7, 20)]
+    page = page_of((days, T.DATE))
+    col = InputRef(0, T.DATE)
+    assert run(Call("year", (col,), T.BIGINT), page) == [1994, 1998, 1970, 1969]
+    assert run(Call("month", (col,), T.BIGINT), page) == [1, 12, 1, 7]
+    assert run(Call("day", (col,), T.BIGINT), page) == [1, 31, 1, 20]
+    assert run(Call("quarter", (col,), T.BIGINT), page) == [1, 4, 1, 3]
+
+
+def test_date_interval_add():
+    d0 = days_from_civil(1994, 1, 31)
+    page = page_of(([d0], T.DATE))
+    # +1 month clamps to Feb 28
+    e = Call("date_add_ym", (InputRef(0, T.DATE), Literal(1, T.INTERVAL_YEAR_MONTH)),
+             T.DATE)
+    assert run(e, page) == [days_from_civil(1994, 2, 28)]
+    # +12 months
+    e2 = Call("date_add_ym", (InputRef(0, T.DATE), Literal(12, T.INTERVAL_YEAR_MONTH)),
+              T.DATE)
+    assert run(e2, page) == [days_from_civil(1995, 1, 31)]
+
+
+def test_case_switch():
+    page = page_of(([1, 2, 3], T.BIGINT))
+    col = InputRef(0, T.BIGINT)
+    # CASE WHEN x=1 THEN 10 WHEN x=2 THEN 20 ELSE 0 END
+    e = SpecialForm(SpecialKind.SWITCH, (
+        Call("eq", (col, Literal(1, T.BIGINT)), T.BOOLEAN), Literal(10, T.BIGINT),
+        Call("eq", (col, Literal(2, T.BIGINT)), T.BOOLEAN), Literal(20, T.BIGINT),
+        Literal(0, T.BIGINT)), T.BIGINT)
+    assert run(e, page) == [10, 20, 0]
+
+
+def test_in_between_coalesce_nullif():
+    page = page_of(([1, 5, 9], T.BIGINT, [1, 1, 0]))
+    col = InputRef(0, T.BIGINT)
+    e_in = SpecialForm(SpecialKind.IN, (col, Literal(1, T.BIGINT),
+                                        Literal(9, T.BIGINT)), T.BOOLEAN)
+    assert run(e_in, page) == [True, False, None]
+    e_bt = SpecialForm(SpecialKind.BETWEEN,
+                       (col, Literal(0, T.BIGINT), Literal(5, T.BIGINT)), T.BOOLEAN)
+    assert run(e_bt, page) == [True, True, None]
+    e_co = SpecialForm(SpecialKind.COALESCE, (col, Literal(-1, T.BIGINT)), T.BIGINT)
+    assert run(e_co, page) == [1, 5, -1]
+    e_nullif = SpecialForm(SpecialKind.NULLIF, (col, Literal(5, T.BIGINT)), T.BIGINT)
+    assert run(e_nullif, page) == [1, None, None]
+
+
+def test_cast():
+    page = page_of(([1.5, 2.5, -1.5], T.DOUBLE))
+    e = Call("cast", (InputRef(0, T.DOUBLE),), T.BIGINT)
+    # Java Math.round: floor(x + 0.5)
+    assert run(e, page) == [2, 3, -1]
+    page2 = page_of(([3, 4, 5], T.BIGINT))
+    e2 = Call("cast", (InputRef(0, T.BIGINT),), T.DecimalType(10, 2))
+    assert run(e2, page2) == [300, 400, 500]
+
+
+def test_whole_expression_under_jit():
+    # q6-shaped predicate compiled once, fused under jit
+    page = page_of(([100.0, 200.0, 300.0], T.DOUBLE),
+                   ([0.05, 0.07, 0.09], T.DOUBLE))
+    price, disc = InputRef(0, T.DOUBLE), InputRef(1, T.DOUBLE)
+    pred = SpecialForm(SpecialKind.AND, (
+        Call("ge", (disc, Literal(0.05, T.DOUBLE)), T.BOOLEAN),
+        Call("le", (disc, Literal(0.07, T.DOUBLE)), T.BOOLEAN)), T.BOOLEAN)
+    proj = Call("multiply", (price, disc), T.DOUBLE)
+
+    @jax.jit
+    def fragment(p):
+        filtered = p.filter(compile_filter(pred)(p))
+        col = compile_expression(proj)(filtered)
+        return filtered, col
+
+    filtered, col = fragment(page)
+    assert int(filtered.num_rows) == 2
+    np.testing.assert_allclose(
+        np.asarray(col.values)[:2], [100.0 * 0.05, 200.0 * 0.07])
+
+
+def test_decimal_divide_no_double_rounding():
+    # 0.2450 / 0.50 at output scale 0: true quotient 0.49 -> rounds to 0
+    page = page_of(([2450], T.DecimalType(10, 4)), ([50], T.DecimalType(10, 2)))
+    e = Call("divide", (InputRef(0, T.DecimalType(10, 4)),
+                        InputRef(1, T.DecimalType(10, 2))), T.DecimalType(10, 0))
+    assert run(e, page) == [0]
+
+
+def test_round_digits():
+    page = page_of(([1.2345, -1.2345, 2.675], T.DOUBLE))
+    e = Call("round_digits", (InputRef(0, T.DOUBLE), Literal(2, T.INTEGER)),
+             T.DOUBLE)
+    got = run(e, page)
+    assert abs(got[0] - 1.23) < 1e-12 and abs(got[1] + 1.23) < 1e-12

@@ -1,0 +1,234 @@
+"""Operator tests — driven RowPagesBuilder-style (SURVEY §4 unit tier)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.expr import Call, InputRef, Literal
+from trino_tpu.ops import (
+    AggSpec, JoinType, SortKey, Step, filter_project, hash_aggregate,
+    hash_join, limit, order_by, top_n)
+from trino_tpu.page import Page
+
+
+def page_of(*cols):
+    arrays, typs, valids = [], [], []
+    for c in cols:
+        if len(c) == 3:
+            a, t, v = c
+        else:
+            (a, t), v = c, None
+        arrays.append(np.asarray(a) if not isinstance(a, np.ndarray) else a)
+        typs.append(t)
+        valids.append(None if v is None else np.asarray(v, dtype=bool))
+    return Page.from_numpy(arrays, typs, valids=valids)
+
+
+# ---------------------------------------------------------------------------
+# aggregation
+
+def test_global_aggregation():
+    page = page_of(([1, 2, 3, 4], T.BIGINT), ([1.0, 2.0, 3.0, 4.0], T.DOUBLE))
+    op = hash_aggregate([], [
+        AggSpec("sum", 0, T.BIGINT), AggSpec("count", None, None),
+        AggSpec("avg", 1, T.DOUBLE), AggSpec("min", 0, T.BIGINT),
+        AggSpec("max", 1, T.DOUBLE)])
+    out = jax.jit(op)(page)
+    assert out.to_pylist() == [(10, 4, 2.5, 1, 4.0)]
+
+
+def test_group_by_aggregation():
+    page = page_of(([2, 1, 2, 1, 3], T.BIGINT), ([10.0, 20.0, 30.0, 40.0, 50.0], T.DOUBLE))
+    op = hash_aggregate([0], [AggSpec("sum", 1, T.DOUBLE),
+                              AggSpec("count", None, None)])
+    out = jax.jit(op)(page)
+    rows = sorted(out.to_pylist())
+    assert rows == [(1, 60.0, 2), (2, 40.0, 2), (3, 50.0, 1)]
+
+
+def test_group_by_null_key_and_null_inputs():
+    page = page_of(([1, 1, 2, 2], T.BIGINT, [1, 0, 1, 0]),
+                   ([5.0, 6.0, 7.0, 8.0], T.DOUBLE, [1, 1, 0, 1]))
+    op = hash_aggregate([0], [AggSpec("sum", 1, T.DOUBLE),
+                              AggSpec("count", 1, T.DOUBLE)])
+    out = jax.jit(op)(page)
+    rows = out.to_pylist()
+    # nulls group together (one NULL group from rows 1 & 3)
+    by_key = {r[0]: r[1:] for r in rows}
+    assert by_key[1] == (5.0, 1)
+    assert by_key[2] == (None, 0)  # sum of all-null group is NULL, count 0
+    assert by_key[None] == (14.0, 2)
+    assert len(rows) == 3
+
+
+def test_group_by_respects_num_rows():
+    page = page_of(([1, 2, 1, 2, 9, 9], T.BIGINT), ([1, 1, 1, 1, 1, 1], T.BIGINT))
+    page = Page(page.columns, jnp.asarray(4, jnp.int32))  # last two rows dead
+    op = hash_aggregate([0], [AggSpec("sum", 1, T.BIGINT)])
+    out = jax.jit(op)(page)
+    assert sorted(out.to_pylist()) == [(1, 2), (2, 2)]
+
+
+def test_partial_then_final_aggregation():
+    page = page_of(([1, 2, 1, 2], T.BIGINT), ([1.0, 2.0, 3.0, 4.0], T.DOUBLE))
+    partial = hash_aggregate([0], [AggSpec("avg", 1, T.DOUBLE)],
+                             step=Step.PARTIAL)
+    p_out = jax.jit(partial)(page)
+    # partial layout: key, avg_sum, avg_count
+    assert p_out.num_columns == 3
+    final = hash_aggregate([0], [AggSpec("avg", 1, T.DOUBLE)], step=Step.FINAL,
+                           partial_state_channels=[[1, 2]])
+    f_out = jax.jit(final)(p_out)
+    assert sorted(f_out.to_pylist()) == [(1, 2.0), (2, 3.0)]
+
+
+def test_aggregation_filter_mask_channel():
+    # count(x) FILTER (WHERE flag)
+    page = page_of(([1, 1, 1, 1], T.BIGINT), ([10, 20, 30, 40], T.BIGINT),
+                   ([True, False, True, False], T.BOOLEAN))
+    op = hash_aggregate([0], [AggSpec("sum", 1, T.BIGINT, mask_channel=2)])
+    out = jax.jit(op)(page)
+    assert out.to_pylist() == [(1, 40)]
+
+
+# ---------------------------------------------------------------------------
+# join
+
+def test_inner_join_duplicate_keys():
+    probe = page_of(([1, 2, 3, 2], T.BIGINT), ([10.0, 20.0, 30.0, 40.0], T.DOUBLE))
+    build = page_of(([2, 2, 1], T.BIGINT), ([100, 200, 300], T.BIGINT))
+    op = hash_join([0], [0], JoinType.INNER, output_capacity=8)
+    out, total = jax.jit(op)(probe, build)
+    assert int(total) == 5  # 1x1 + 2x2 + 0 + 2x2... probe row 2 & 4 each match 2
+    rows = sorted(out.to_pylist())
+    assert rows == [(1, 10.0, 1, 300), (2, 20.0, 2, 100), (2, 20.0, 2, 200),
+                    (2, 40.0, 2, 100), (2, 40.0, 2, 200)]
+
+
+def test_join_overflow_detection():
+    probe = page_of(([1, 1], T.BIGINT))
+    build = page_of(([1, 1, 1], T.BIGINT))
+    op = hash_join([0], [0], JoinType.INNER, output_capacity=4)
+    out, total = jax.jit(op)(probe, build)
+    assert int(total) == 6 and int(out.num_rows) == 4  # truncated, flagged
+
+
+def test_left_join_null_extension():
+    probe = page_of(([1, 5], T.BIGINT))
+    build = page_of(([1], T.BIGINT), ([99], T.BIGINT))
+    op = hash_join([0], [0], JoinType.LEFT, output_capacity=4)
+    out, _ = jax.jit(op)(probe, build)
+    assert sorted(out.to_pylist(), key=str) == [(1, 1, 99), (5, None, None)]
+
+
+def test_null_keys_never_match():
+    probe = page_of(([1, 2], T.BIGINT, [0, 1]))
+    build = page_of(([1, 2], T.BIGINT, [0, 1]), ([7, 8], T.BIGINT))
+    op = hash_join([0], [0], JoinType.INNER, output_capacity=4)
+    out, total = jax.jit(op)(probe, build)
+    assert out.to_pylist() == [(2, 2, 8)]
+
+
+def test_semi_and_anti_join():
+    probe = page_of(([1, 2, 3, 4], T.BIGINT))
+    build = page_of(([2, 4, 4], T.BIGINT))
+    semi = hash_join([0], [0], JoinType.SEMI)
+    out, _ = jax.jit(semi)(probe, build)
+    assert [r[0] for r in out.to_pylist()] == [2, 4]
+    anti = hash_join([0], [0], JoinType.ANTI)
+    out, _ = jax.jit(anti)(probe, build)
+    assert [r[0] for r in out.to_pylist()] == [1, 3]
+
+
+def test_composite_key_join():
+    probe = page_of(([1, 1, 2], T.BIGINT), ([10, 20, 10], T.BIGINT))
+    build = page_of(([1, 2], T.BIGINT), ([10, 10], T.BIGINT), ([111, 222], T.BIGINT))
+    op = hash_join([0, 1], [0, 1], JoinType.INNER, output_capacity=6)
+    out, _ = jax.jit(op)(probe, build)
+    assert sorted(out.to_pylist()) == [(1, 10, 1, 10, 111), (2, 10, 2, 10, 222)]
+
+
+def test_join_under_single_jit_with_filter():
+    probe = page_of((np.arange(100) % 10, T.BIGINT), (np.arange(100, dtype=float), T.DOUBLE))
+    build = page_of(([3, 7], T.BIGINT), ([333, 777], T.BIGINT))
+    join_op = hash_join([0], [0], JoinType.INNER, output_capacity=128)
+
+    @jax.jit
+    def frag(p, b):
+        out, total = join_op(p, b)
+        agg = hash_aggregate([0], [AggSpec("count", None, None)])(out)
+        return agg, total
+
+    agg, total = frag(probe, build)
+    assert int(total) == 20
+    assert sorted(agg.to_pylist()) == [(3, 10), (7, 10)]
+
+
+# ---------------------------------------------------------------------------
+# sort / topn / limit
+
+def test_order_by_asc_desc_nulls():
+    page = page_of(([3, 1, 2, 1], T.BIGINT, [1, 1, 0, 1]),
+                   ([1.0, 2.0, 3.0, 4.0], T.DOUBLE))
+    # ASC: nulls last (Trino default)
+    out = jax.jit(order_by([SortKey(0, ascending=True)]))(page)
+    assert [r[0] for r in out.to_pylist()] == [1, 1, 3, None]
+    # DESC: nulls first
+    out = jax.jit(order_by([SortKey(0, ascending=False)]))(page)
+    assert [r[0] for r in out.to_pylist()] == [None, 3, 1, 1]
+    # stability: equal keys keep input order
+    out = jax.jit(order_by([SortKey(0)]))(page)
+    assert out.to_pylist()[0] == (1, 2.0) and out.to_pylist()[1] == (1, 4.0)
+
+
+def test_order_by_multi_key_and_float_desc():
+    page = page_of(([1, 1, 2], T.BIGINT), ([5.0, 9.0, 1.0], T.DOUBLE))
+    out = jax.jit(order_by([SortKey(0, True), SortKey(1, False)]))(page)
+    assert out.to_pylist() == [(1, 9.0), (1, 5.0), (2, 1.0)]
+
+
+def test_nan_sorts_largest():
+    page = page_of(([1.0, float("nan"), 0.5], T.DOUBLE))
+    out = jax.jit(order_by([SortKey(0, True)]))(page)
+    vals = [r[0] for r in out.to_pylist()]
+    assert vals[0] == 0.5 and vals[1] == 1.0 and np.isnan(vals[2])
+    out = jax.jit(order_by([SortKey(0, False)]))(page)
+    vals = [r[0] for r in out.to_pylist()]
+    assert np.isnan(vals[0]) and vals[1] == 1.0
+
+
+def test_top_n_and_limit():
+    page = page_of((np.arange(10)[::-1].copy(), T.BIGINT))
+    out = jax.jit(top_n(3, [SortKey(0, True)]))(page)
+    assert [r[0] for r in out.to_pylist()] == [0, 1, 2]
+    out = jax.jit(limit(4))(page)
+    assert int(out.num_rows) == 4
+
+
+def test_filter_project_operator():
+    page = page_of(([1, 2, 3, 4], T.BIGINT), ([2.0, 4.0, 6.0, 8.0], T.DOUBLE))
+    op = filter_project(
+        Call("gt", (InputRef(0, T.BIGINT), Literal(1, T.BIGINT)), T.BOOLEAN),
+        [Call("multiply", (InputRef(1, T.DOUBLE), Literal(10.0, T.DOUBLE)), T.DOUBLE)])
+    out = jax.jit(op)(page)
+    assert out.to_pylist() == [(40.0,), (60.0,), (80.0,)]
+
+
+def test_min_max_varchar_keeps_dictionary():
+    page = page_of(([1, 1, 2], T.BIGINT),
+                   (np.array(["bb", "aa", "cc"], dtype=object), T.VARCHAR))
+    op = hash_aggregate([0], [AggSpec("min", 1, T.VARCHAR),
+                              AggSpec("max", 1, T.VARCHAR)])
+    out = jax.jit(op)(page)
+    assert sorted(out.to_pylist()) == [(1, "aa", "bb"), (2, "cc", "cc")]
+
+
+def test_composite_join_total_after_collision_filter():
+    probe = page_of(([1, 2], T.BIGINT), ([10, 20], T.BIGINT))
+    build = page_of(([1, 2], T.BIGINT), ([10, 99], T.BIGINT))
+    op = hash_join([0, 1], [0, 1], JoinType.INNER, output_capacity=4)
+    out, total = jax.jit(op)(probe, build)
+    # only (1,10) truly matches; total must reflect the post-verify count
+    assert int(out.num_rows) == 1 and int(total) == 1

@@ -1,0 +1,11 @@
+"""Expression engine: IR + compiler lowering row expressions to jnp.
+
+Replaces the reference's runtime bytecode generation layer
+(core/trino-main/.../sql/gen/, SURVEY §2.6): where Trino compiles a fused
+PageProcessor per expression tree, we build a traced jnp function per
+expression; XLA fuses it with the surrounding operator kernels under jit.
+"""
+
+from trino_tpu.expr.ir import (
+    Call, InputRef, Literal, RowExpression, SpecialForm, SpecialKind)
+from trino_tpu.expr.compiler import compile_expression, compile_filter

@@ -1,0 +1,380 @@
+"""Expression compiler: RowExpression -> traced jnp function over a Page.
+
+Reference parity: sql/gen/ExpressionCompiler.java:56 + PageFunctionCompiler
+.java:101. Where the reference emits JVM bytecode per expression tree, we
+recursively build a jnp computation; under jit, XLA fuses the whole filter/
+project with adjacent operator kernels (the PageProcessor role).
+
+Null semantics are SQL three-valued logic, carried as (values, valid) pairs:
+- default functions: result null iff any input null (RETURNS NULL ON NULL)
+- AND/OR: Kleene logic (false AND null = false, true OR null = true)
+- comparisons with null: null; WHERE treats null as false (compile_filter)
+
+Dictionary folding happens at trace time (dictionaries are static aux data):
+  varchar_col = 'FOO'   -> codes == dict.code_of('FOO')
+  varchar_col < 'FOO'   -> codes < dict.lower_bound('FOO')
+  varchar_col LIKE 'F%' -> gather of a host-computed boolean table by code
+so string predicates cost one int32 compare/gather per row on device.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from trino_tpu import types as T
+from trino_tpu.expr import functions as F
+from trino_tpu.expr.ir import (
+    Call, InputRef, Literal, RowExpression, SpecialForm, SpecialKind)
+from trino_tpu.page import Column, Dictionary, Page
+
+_COMPARISONS = {"eq", "ne", "lt", "le", "gt", "ge"}
+
+
+def _vand(a: Optional[jnp.ndarray], b: Optional[jnp.ndarray]):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+def _lit_column(lit: Literal) -> Column:
+    typ = lit.type
+    if lit.value is None:
+        return Column(jnp.zeros((), dtype=typ.dtype),
+                      jnp.zeros((), dtype=jnp.bool_), typ, None)
+    if T.is_string(typ):
+        # bare string literal with no dictionary context; comparisons fold it
+        # against the other side's dictionary before this is ever materialized
+        raise NotImplementedError(
+            "free-standing string literal needs dictionary context")
+    value = lit.value
+    if isinstance(typ, T.DecimalType):
+        # literals carried as ints already scaled by the frontend
+        value = int(value)
+    return Column(jnp.asarray(value, dtype=typ.dtype), None, typ, None)
+
+
+def _eval(expr: RowExpression, page: Page) -> Column:
+    if isinstance(expr, InputRef):
+        return page.columns[expr.index]
+    if isinstance(expr, Literal):
+        return _lit_column(expr)
+    if isinstance(expr, Call):
+        return _eval_call(expr, page)
+    if isinstance(expr, SpecialForm):
+        return _eval_special(expr, page)
+    raise TypeError(f"unknown expression node: {expr!r}")
+
+
+def _string_side(args) -> bool:
+    return any(T.is_string(a.type) for a in args)
+
+
+def _eval_call(expr: Call, page: Page) -> Column:
+    name = expr.name
+    # --- dictionary-folded string paths -----------------------------------
+    if name in _COMPARISONS and _string_side(expr.args):
+        return _string_comparison(name, expr.args, page, expr.type)
+    if name == "like":
+        return _like(expr, page)
+    if name in ("lower", "upper", "trim", "ltrim", "rtrim", "substr",
+                "substring", "concat", "replace", "reverse"):
+        return _string_transform(expr, page)
+    if name == "length":
+        arg = _eval(expr.args[0], page)
+        table = F.dictionary_table(arg.dictionary, ("length",),
+                                   lambda s: len(s))
+        return Column(jnp.take(table, arg.values, mode="clip").astype(jnp.int64),
+                      arg.valid, expr.type, None)
+    # --- generic null-propagating scalar ----------------------------------
+    impl = F.lookup(name)
+    args = [_eval(a, page) for a in expr.args]
+    values = impl(expr.type, [a.type for a in expr.args],
+                  *[a.values for a in args])
+    valid = None
+    for a in args:
+        valid = _vand(valid, a.valid)
+    return Column(values, valid, expr.type, None)
+
+
+def _literal_str(expr: RowExpression) -> Optional[str]:
+    if isinstance(expr, Literal) and T.is_string(expr.type):
+        return expr.value
+    return None
+
+
+def _string_comparison(name: str, args, page: Page, out_type) -> Column:
+    a_lit, b_lit = _literal_str(args[0]), _literal_str(args[1])
+    if a_lit is not None and b_lit is not None:
+        # constant fold
+        result = {
+            "eq": a_lit == b_lit, "ne": a_lit != b_lit, "lt": a_lit < b_lit,
+            "le": a_lit <= b_lit, "gt": a_lit > b_lit, "ge": a_lit >= b_lit,
+        }[name]
+        return Column(jnp.asarray(result), None, out_type, None)
+    if b_lit is None and a_lit is not None:
+        # normalize literal to the right: lit <op> col == col <flip op> lit
+        flip = {"eq": "eq", "ne": "ne", "lt": "gt", "le": "ge",
+                "gt": "lt", "ge": "le"}[name]
+        return _string_comparison(flip, (args[1], args[0]), page, out_type)
+    col = _eval(args[0], page)
+    if b_lit is not None:
+        d = col.dictionary
+        if d is None:
+            raise NotImplementedError("string comparison without dictionary")
+        codes = col.values
+        if name == "eq":
+            code = d.code_of(b_lit)
+            vals = (codes == code) if code >= 0 else jnp.zeros_like(codes, dtype=jnp.bool_)
+        elif name == "ne":
+            code = d.code_of(b_lit)
+            vals = (codes != code) if code >= 0 else jnp.ones_like(codes, dtype=jnp.bool_)
+        elif name == "lt":
+            vals = codes < d.lower_bound(b_lit)
+        elif name == "le":
+            vals = codes < d.upper_bound(b_lit)
+        elif name == "gt":
+            vals = codes >= d.upper_bound(b_lit)
+        else:  # ge
+            vals = codes >= d.lower_bound(b_lit)
+        return Column(vals, col.valid, out_type, None)
+    # column vs column: only valid when both sides share one dictionary
+    other = _eval(args[1], page)
+    if col.dictionary is not other.dictionary:
+        raise NotImplementedError(
+            "string column comparison across distinct dictionaries")
+    vals = F.lookup(name)(out_type, [T.BIGINT, T.BIGINT],
+                          col.values, other.values)
+    return Column(vals, _vand(col.valid, other.valid), out_type, None)
+
+
+def _like(expr: Call, page: Page) -> Column:
+    col = _eval(expr.args[0], page)
+    pattern = _literal_str(expr.args[1])
+    if pattern is None or col.dictionary is None:
+        raise NotImplementedError("LIKE requires literal pattern + dictionary")
+    escape = None
+    if len(expr.args) > 2:
+        escape = _literal_str(expr.args[2])
+    table = F.like_table(col.dictionary, pattern, escape)
+    vals = jnp.take(table, col.values, mode="clip")
+    return Column(vals, col.valid, expr.type, None)
+
+
+def _string_transform(expr: Call, page: Page) -> Column:
+    """str->str functions as dictionary remap (host transform, device gather)."""
+    name = expr.name
+    col = _eval(expr.args[0], page)
+    if col.dictionary is None:
+        raise NotImplementedError(f"{name} requires dictionary-encoded input")
+    lits = [a for a in expr.args[1:]]
+    lit_vals = []
+    for a in lits:
+        if not isinstance(a, Literal):
+            raise NotImplementedError(f"{name} with non-literal extra args")
+        lit_vals.append(a.value)
+    py = _PY_STRING_FNS[name]
+    key = (name,) + tuple(lit_vals)
+    nd, remap = F.transform_dictionary(col.dictionary, key,
+                                       lambda s: py(s, *lit_vals))
+    codes = jnp.take(remap, col.values, mode="clip")
+    return Column(codes, col.valid, expr.type, nd)
+
+
+def _py_substr(s: str, start: int, length: Optional[int] = None) -> str:
+    # SQL substr is 1-based; negative start counts from the end (Trino)
+    if start > 0:
+        i = start - 1
+    elif start < 0:
+        i = len(s) + start
+        if i < 0:
+            return ""
+    else:
+        return ""
+    piece = s[i:]
+    if length is not None:
+        piece = piece[:max(length, 0)]
+    return piece
+
+
+_PY_STRING_FNS = {
+    "lower": lambda s: s.lower(),
+    "upper": lambda s: s.upper(),
+    "trim": lambda s: s.strip(),
+    "ltrim": lambda s: s.lstrip(),
+    "rtrim": lambda s: s.rstrip(),
+    "substr": _py_substr,
+    "substring": _py_substr,
+    "concat": lambda s, suffix: s + suffix,
+    "replace": lambda s, find, repl="": s.replace(find, repl),
+    "reverse": lambda s: s[::-1],
+}
+
+
+def _eval_special(expr: SpecialForm, page: Page) -> Column:
+    kind = expr.kind
+    if kind is SpecialKind.AND:
+        return _kleene_and([_eval(a, page) for a in expr.args], expr.type)
+    if kind is SpecialKind.OR:
+        return _kleene_or([_eval(a, page) for a in expr.args], expr.type)
+    if kind is SpecialKind.NOT:
+        a = _eval(expr.args[0], page)
+        return Column(~a.values, a.valid, expr.type, None)
+    if kind is SpecialKind.IS_NULL:
+        a = _eval(expr.args[0], page)
+        if a.valid is None:
+            vals = jnp.zeros(jnp.shape(a.values), dtype=jnp.bool_)
+        else:
+            vals = ~a.valid
+        return Column(vals, None, expr.type, None)
+    if kind is SpecialKind.COALESCE:
+        args = [_eval(a, page) for a in expr.args]
+        dicts = {id(a.dictionary) for a in args if a.dictionary is not None}
+        if len(dicts) > 1:
+            raise NotImplementedError("COALESCE over distinct dictionaries")
+        dictionary = next((a.dictionary for a in args
+                           if a.dictionary is not None), None)
+        out = args[-1]
+        for a in reversed(args[:-1]):
+            if a.valid is None:
+                out = a
+                continue
+            values = jnp.where(a.valid, a.values, out.values)
+            valid = a.valid | out.valid if out.valid is not None else None
+            out = Column(values, valid, expr.type, dictionary)
+        return out
+    if kind is SpecialKind.IF:
+        cond = _eval(expr.args[0], page)
+        then = _eval(expr.args[1], page)
+        els = _eval(expr.args[2], page)
+        take_then = cond.values
+        if cond.valid is not None:
+            take_then = take_then & cond.valid  # null condition -> else
+        values = jnp.where(take_then, then.values, els.values)
+        if then.valid is None and els.valid is None:
+            valid = None
+        else:
+            tv = then.valid if then.valid is not None else jnp.ones((), jnp.bool_)
+            ev = els.valid if els.valid is not None else jnp.ones((), jnp.bool_)
+            valid = jnp.where(take_then, tv, ev)
+        dictionary = then.dictionary if then.dictionary is not None else els.dictionary
+        if (then.dictionary is not None and els.dictionary is not None
+                and then.dictionary is not els.dictionary):
+            raise NotImplementedError("IF over distinct dictionaries")
+        return Column(values, valid, expr.type, dictionary)
+    if kind is SpecialKind.SWITCH:
+        # [c1, v1, c2, v2, ..., default] — fold right into nested IFs
+        args = list(expr.args)
+        out = _eval(args[-1], page)
+        pairs = list(zip(args[:-1:2], args[1:-1:2]))
+        for cond_e, val_e in reversed(pairs):
+            cond = _eval(cond_e, page)
+            val = _eval(val_e, page)
+            if (val.dictionary is not None and out.dictionary is not None
+                    and val.dictionary is not out.dictionary):
+                raise NotImplementedError("CASE over distinct dictionaries")
+            dictionary = (val.dictionary if val.dictionary is not None
+                          else out.dictionary)
+            take = cond.values
+            if cond.valid is not None:
+                take = take & cond.valid
+            values = jnp.where(take, val.values, out.values)
+            tv = val.valid if val.valid is not None else jnp.ones((), jnp.bool_)
+            ov = out.valid if out.valid is not None else jnp.ones((), jnp.bool_)
+            valid = None
+            if val.valid is not None or out.valid is not None:
+                valid = jnp.where(take, tv, ov)
+            out = Column(values, valid, expr.type, dictionary)
+        return out
+    if kind is SpecialKind.IN:
+        needle = expr.args[0]
+        eqs = [Call("eq", (needle, v), T.BOOLEAN) for v in expr.args[1:]]
+        return _kleene_or([_eval(e, page) for e in eqs], expr.type)
+    if kind is SpecialKind.BETWEEN:
+        value, low, high = expr.args
+        conj = SpecialForm(SpecialKind.AND, (
+            Call("ge", (value, low), T.BOOLEAN),
+            Call("le", (value, high), T.BOOLEAN)), T.BOOLEAN)
+        return _eval(conj, page)
+    if kind is SpecialKind.NULLIF:
+        a = _eval(expr.args[0], page)
+        b_eq = _eval(Call("eq", (expr.args[0], expr.args[1]), T.BOOLEAN), page)
+        equal = b_eq.values
+        if b_eq.valid is not None:
+            equal = equal & b_eq.valid
+        base_valid = a.valid if a.valid is not None else jnp.ones((), jnp.bool_)
+        valid = jnp.broadcast_to(base_valid & ~equal, jnp.shape(equal))
+        return Column(a.values, valid, expr.type, a.dictionary)
+    raise TypeError(f"unknown special form: {kind}")
+
+
+def _kleene_and(args, out_type) -> Column:
+    # false dominates null; null & true = null
+    value, valid = args[0].values, args[0].valid
+    for a in args[1:]:
+        av, an = a.values, a.valid
+        new_value = value & av
+        if valid is None and an is None:
+            new_valid = None
+        else:
+            v1 = valid if valid is not None else jnp.ones((), jnp.bool_)
+            v2 = an if an is not None else jnp.ones((), jnp.bool_)
+            # valid iff both valid, or either side is a definite false
+            new_valid = (v1 & v2) | (v1 & ~value) | (v2 & ~av)
+        value, valid = new_value, new_valid
+    return Column(value, valid, out_type, None)
+
+
+def _kleene_or(args, out_type) -> Column:
+    value, valid = args[0].values, args[0].valid
+    for a in args[1:]:
+        av, an = a.values, a.valid
+        new_value = value | av
+        if valid is None and an is None:
+            new_valid = None
+        else:
+            v1 = valid if valid is not None else jnp.ones((), jnp.bool_)
+            v2 = an if an is not None else jnp.ones((), jnp.bool_)
+            # valid iff both valid, or either side is a definite true
+            new_valid = (v1 & v2) | (v1 & value) | (v2 & av)
+        value, valid = new_value, new_valid
+    return Column(value, valid, out_type, None)
+
+
+def _broadcast(col: Column, capacity: int) -> Column:
+    if jnp.ndim(col.values) == 0:
+        values = jnp.broadcast_to(col.values, (capacity,))
+        valid = col.valid
+        if valid is not None and jnp.ndim(valid) == 0:
+            valid = jnp.broadcast_to(valid, (capacity,))
+        return Column(values, valid, col.type, col.dictionary)
+    if col.valid is not None and jnp.ndim(col.valid) == 0:
+        return Column(col.values, jnp.broadcast_to(col.valid, (capacity,)),
+                      col.type, col.dictionary)
+    return col
+
+
+def compile_expression(expr: RowExpression) -> Callable[[Page], Column]:
+    """Build fn(page) -> Column of per-row results (project channel)."""
+
+    def fn(page: Page) -> Column:
+        return _broadcast(_eval(expr, page), page.capacity)
+
+    return fn
+
+
+def compile_filter(expr: RowExpression) -> Callable[[Page], jnp.ndarray]:
+    """Build fn(page) -> bool mask; SQL WHERE: null counts as false."""
+
+    def fn(page: Page) -> jnp.ndarray:
+        col = _broadcast(_eval(expr, page), page.capacity)
+        mask = col.values
+        if col.valid is not None:
+            mask = mask & col.valid
+        return mask
+
+    return fn

@@ -1,0 +1,462 @@
+"""Scalar function registry + builtin implementations.
+
+Reference parity: operator/scalar/ (227 files) + sql/gen null-propagation
+conventions. Implementations are jnp kernels over value arrays; the compiler
+wraps them with default RETURNS NULL ON NULL INPUT semantics (valid = AND of
+input valids), matching @ScalarFunction defaults.
+
+Java-semantics notes (bit-identical goal, SURVEY §7 hard part 4):
+- integer division/remainder truncate toward zero (lax.div/lax.rem), not
+  Python floor semantics
+- CAST(double AS bigint) rounds like Java Math.round: floor(x + 0.5)
+- decimal arithmetic on scaled int64 with explicit rescaling, HALF_UP rounding
+
+String functions run against the host-side Dictionary: a per-(dictionary, op)
+lookup table is computed once on host and gathered by code on device — the
+TPU-native replacement for per-row joni/re2j regex evaluation.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.page import Column, Dictionary
+
+# ---------------------------------------------------------------------------
+# registry
+
+# impl(out_type, arg_types, *value_arrays) -> value_array
+_SCALARS: Dict[str, Callable] = {}
+
+
+def scalar(name: str):
+    def deco(fn):
+        _SCALARS[name] = fn
+        return fn
+    return deco
+
+
+def lookup(name: str) -> Callable:
+    if name not in _SCALARS:
+        raise KeyError(f"unknown scalar function: {name}")
+    return _SCALARS[name]
+
+
+def exists(name: str) -> bool:
+    return name in _SCALARS
+
+
+# ---------------------------------------------------------------------------
+# arithmetic
+
+def _is_decimal(t):
+    return isinstance(t, T.DecimalType)
+
+
+def _rescale(values, from_scale: int, to_scale: int):
+    """Scaled-int64 rescale with HALF_UP rounding on scale-down."""
+    if to_scale == from_scale:
+        return values
+    if to_scale > from_scale:
+        return values * (10 ** (to_scale - from_scale))
+    factor = 10 ** (from_scale - to_scale)
+    # round half away from zero, like Trino's Decimals HALF_UP
+    half = factor // 2
+    adj = jnp.where(values >= 0, values + half, values - half)
+    return jax.lax.div(adj, jnp.int64(factor))
+
+
+@scalar("add")
+def _add(out_type, arg_types, a, b):
+    if _is_decimal(out_type):
+        a = _rescale(a, arg_types[0].scale, out_type.scale)
+        b = _rescale(b, arg_types[1].scale, out_type.scale)
+    return a + b
+
+
+@scalar("subtract")
+def _subtract(out_type, arg_types, a, b):
+    if _is_decimal(out_type):
+        a = _rescale(a, arg_types[0].scale, out_type.scale)
+        b = _rescale(b, arg_types[1].scale, out_type.scale)
+    return a - b
+
+
+@scalar("multiply")
+def _multiply(out_type, arg_types, a, b):
+    if _is_decimal(out_type):
+        raw = a * b  # scale = s1 + s2
+        return _rescale(raw, arg_types[0].scale + arg_types[1].scale,
+                        out_type.scale)
+    return a * b
+
+
+@scalar("divide")
+def _divide(out_type, arg_types, a, b):
+    if _is_decimal(out_type):
+        # scale so ONE integer division + ONE HALF_UP rounding yields
+        # out_type.scale exactly (no double rounding): shift the numerator up
+        # when the target scale is higher, the denominator up when lower
+        shift = out_type.scale + arg_types[1].scale - arg_types[0].scale
+        num = a * (10 ** max(shift, 0)) if shift >= 0 else a
+        den = b * (10 ** max(-shift, 0)) if shift < 0 else b
+        half = jax.lax.div(jnp.abs(den), jnp.int64(2))
+        adj = jnp.where((num >= 0) == (den >= 0), num + half, num - half)
+        return jax.lax.div(adj, den)
+    if jnp.issubdtype(jnp.result_type(a), jnp.integer):
+        return jax.lax.div(a, b)  # truncate toward zero (Java)
+    return a / b
+
+
+@scalar("modulus")
+def _modulus(out_type, arg_types, a, b):
+    if jnp.issubdtype(jnp.result_type(a), jnp.integer):
+        return jax.lax.rem(a, b)  # sign of dividend (Java %)
+    return jnp.fmod(a, b)
+
+
+@scalar("negate")
+def _negate(out_type, arg_types, a):
+    return -a
+
+
+# ---------------------------------------------------------------------------
+# comparison (numeric / date / codes — string literals are pre-folded to codes
+# by the compiler using the column dictionary)
+
+@scalar("eq")
+def _eq(out_type, arg_types, a, b):
+    return a == b
+
+
+@scalar("ne")
+def _ne(out_type, arg_types, a, b):
+    return a != b
+
+
+@scalar("lt")
+def _lt(out_type, arg_types, a, b):
+    return a < b
+
+
+@scalar("le")
+def _le(out_type, arg_types, a, b):
+    return a <= b
+
+
+@scalar("gt")
+def _gt(out_type, arg_types, a, b):
+    return a > b
+
+
+@scalar("ge")
+def _ge(out_type, arg_types, a, b):
+    return a >= b
+
+
+# ---------------------------------------------------------------------------
+# math
+
+@scalar("abs")
+def _abs(out_type, arg_types, a):
+    return jnp.abs(a)
+
+
+@scalar("ceil")
+def _ceil(out_type, arg_types, a):
+    if _is_decimal(arg_types[0]):
+        s = arg_types[0].scale
+        f = jnp.int64(10 ** s)
+        q = jax.lax.div(a, f)
+        return q + ((jax.lax.rem(a, f) > 0) & (a > 0)).astype(jnp.int64)
+    if jnp.issubdtype(jnp.result_type(a), jnp.integer):
+        return a
+    return jnp.ceil(a)
+
+
+@scalar("floor")
+def _floor(out_type, arg_types, a):
+    if _is_decimal(arg_types[0]):
+        s = arg_types[0].scale
+        f = jnp.int64(10 ** s)
+        q = jax.lax.div(a, f)
+        return q - ((jax.lax.rem(a, f) < 0) & (a < 0)).astype(jnp.int64)
+    if jnp.issubdtype(jnp.result_type(a), jnp.integer):
+        return a
+    return jnp.floor(a)
+
+
+@scalar("round")
+def _round(out_type, arg_types, a):
+    if _is_decimal(arg_types[0]):
+        return _rescale(a, arg_types[0].scale, 0)
+    if jnp.issubdtype(jnp.result_type(a), jnp.integer):
+        return a
+    # Trino rounds half away from zero
+    return jnp.where(a >= 0, jnp.floor(a + 0.5), jnp.ceil(a - 0.5))
+
+
+@scalar("round_digits")
+def _round_digits(out_type, arg_types, a, d):
+    """round(x, d); the compiler folds literal d (the only supported form)."""
+    if _is_decimal(arg_types[0]):
+        raise NotImplementedError("round(decimal, d)")
+    if jnp.issubdtype(jnp.result_type(a), jnp.integer):
+        return a
+    f = 10.0 ** d
+    scaled = a * f
+    return jnp.where(scaled >= 0, jnp.floor(scaled + 0.5),
+                     jnp.ceil(scaled - 0.5)) / f
+
+
+@scalar("sqrt")
+def _sqrt(out_type, arg_types, a):
+    return jnp.sqrt(a)
+
+
+@scalar("power")
+def _power(out_type, arg_types, a, b):
+    return jnp.power(a, b)
+
+
+@scalar("exp")
+def _exp(out_type, arg_types, a):
+    return jnp.exp(a)
+
+
+@scalar("ln")
+def _ln(out_type, arg_types, a):
+    return jnp.log(a)
+
+
+@scalar("log10")
+def _log10(out_type, arg_types, a):
+    return jnp.log10(a)
+
+
+@scalar("sign")
+def _sign(out_type, arg_types, a):
+    return jnp.sign(a)
+
+
+@scalar("greatest")
+def _greatest(out_type, arg_types, *args):
+    out = args[0]
+    for a in args[1:]:
+        out = jnp.maximum(out, a)
+    return out
+
+
+@scalar("least")
+def _least(out_type, arg_types, *args):
+    out = args[0]
+    for a in args[1:]:
+        out = jnp.minimum(out, a)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# date/time. DATE = int32 days since epoch; civil-date math in pure integer
+# ops (vectorizes onto VPU; reference: scalar/DateTimeFunctions.java).
+
+def _civil_from_days(days):
+    """days since 1970-01-01 -> (year, month, day), proleptic Gregorian."""
+    z = days.astype(jnp.int64) + 719468
+    era = jax.lax.div(jnp.where(z >= 0, z, z - 146096), jnp.int64(146097))
+    doe = z - era * 146097
+    yoe = jax.lax.div(
+        doe - jax.lax.div(doe, jnp.int64(1460))
+        + jax.lax.div(doe, jnp.int64(36524))
+        - jax.lax.div(doe, jnp.int64(146096)), jnp.int64(365))
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + jax.lax.div(yoe, jnp.int64(4))
+                 - jax.lax.div(yoe, jnp.int64(100)))
+    mp = jax.lax.div(5 * doy + 2, jnp.int64(153))
+    d = doy - jax.lax.div(153 * mp + 2, jnp.int64(5)) + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def days_from_civil(y: int, m: int, d: int) -> int:
+    """Host-side inverse (for literals/boundaries)."""
+    y -= m <= 2
+    era = (y if y >= 0 else y - 399) // 400
+    yoe = y - era * 400
+    doy = (153 * (m + (-3 if m > 2 else 9)) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+@scalar("year")
+def _year(out_type, arg_types, a):
+    y, _, _ = _civil_from_days(_days_of(arg_types[0], a))
+    return y
+
+
+@scalar("month")
+def _month(out_type, arg_types, a):
+    _, m, _ = _civil_from_days(_days_of(arg_types[0], a))
+    return m
+
+
+@scalar("day")
+def _day(out_type, arg_types, a):
+    _, _, d = _civil_from_days(_days_of(arg_types[0], a))
+    return d
+
+
+@scalar("quarter")
+def _quarter(out_type, arg_types, a):
+    _, m, _ = _civil_from_days(_days_of(arg_types[0], a))
+    return jax.lax.div(m - 1, jnp.int64(3)) + 1
+
+
+def _days_of(typ, a):
+    if isinstance(typ, T.DateType):
+        return a
+    if isinstance(typ, T.TimestampType):
+        micros_per_day = jnp.int64(86_400_000_000)
+        return jax.lax.div(
+            jnp.where(a >= 0, a, a - micros_per_day + 1), micros_per_day)
+    raise TypeError(f"not a temporal type: {typ}")
+
+
+def _add_months_device(days, months):
+    """date + interval year-month with end-of-month clamping."""
+    y, m, d = _civil_from_days(days)
+    total = y * 12 + (m - 1) + months
+    ny = jax.lax.div(jnp.where(total >= 0, total, total - 11), jnp.int64(12))
+    nm = total - ny * 12 + 1
+    # clamp day to target month length
+    leap = ((jax.lax.rem(ny, jnp.int64(4)) == 0)
+            & (jax.lax.rem(ny, jnp.int64(100)) != 0)
+            | (jax.lax.rem(ny, jnp.int64(400)) == 0))
+    mlen = jnp.array([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31])
+    length = mlen[nm - 1] + ((nm == 2) & leap)
+    nd = jnp.minimum(d, length)
+    # days_from_civil, device version
+    yy = ny - (nm <= 2)
+    era = jax.lax.div(jnp.where(yy >= 0, yy, yy - 399), jnp.int64(400))
+    yoe = yy - era * 400
+    doy = jax.lax.div(153 * (nm + jnp.where(nm > 2, -3, 9)) + 2,
+                      jnp.int64(5)) + nd - 1
+    doe = yoe * 365 + jax.lax.div(yoe, jnp.int64(4)) - jax.lax.div(
+        yoe, jnp.int64(100)) + doy
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+@scalar("date_add_ym")
+def _date_add_ym(out_type, arg_types, days, months):
+    return _add_months_device(days, months)
+
+
+@scalar("date_add_dt")
+def _date_add_dt(out_type, arg_types, days, micros):
+    micros_per_day = jnp.int64(86_400_000_000)
+    return (days + jax.lax.div(micros, micros_per_day)).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# casts
+
+@scalar("cast")
+def _cast(out_type, arg_types, a):
+    src = arg_types[0]
+    if src == out_type:
+        return a
+    if isinstance(out_type, T.DoubleType):
+        if _is_decimal(src):
+            return a.astype(jnp.float64) / (10.0 ** src.scale)
+        return a.astype(jnp.float64)
+    if isinstance(out_type, T.RealType):
+        if _is_decimal(src):
+            return (a.astype(jnp.float64) / (10.0 ** src.scale)).astype(jnp.float32)
+        return a.astype(jnp.float32)
+    if isinstance(out_type, (T.BigintType, T.IntegerType, T.SmallintType,
+                             T.TinyintType)):
+        if isinstance(src, (T.DoubleType, T.RealType)):
+            # Java Math.round semantics: floor(x + 0.5)
+            return jnp.floor(a.astype(jnp.float64) + 0.5).astype(out_type.dtype)
+        if _is_decimal(src):
+            return _rescale(a, src.scale, 0).astype(out_type.dtype)
+        return a.astype(out_type.dtype)
+    if _is_decimal(out_type):
+        if _is_decimal(src):
+            return _rescale(a, src.scale, out_type.scale)
+        if isinstance(src, (T.DoubleType, T.RealType)):
+            scaled = a.astype(jnp.float64) * (10.0 ** out_type.scale)
+            return jnp.floor(scaled + jnp.where(scaled >= 0, 0.5, -0.5)).astype(jnp.int64)
+        if T.is_integral(src):
+            return a.astype(jnp.int64) * (10 ** out_type.scale)
+    if isinstance(out_type, T.TimestampType) and isinstance(src, T.DateType):
+        return a.astype(jnp.int64) * 86_400_000_000
+    if isinstance(out_type, T.DateType) and isinstance(src, T.TimestampType):
+        return _days_of(src, a).astype(jnp.int32)
+    if isinstance(out_type, T.BooleanType):
+        return a != 0
+    if isinstance(src, T.BooleanType) and T.is_numeric(out_type):
+        return a.astype(out_type.dtype)
+    raise NotImplementedError(f"cast {src} -> {out_type}")
+
+
+# ---------------------------------------------------------------------------
+# dictionary-backed string ops: host computes a per-pool table, device gathers.
+
+_DICT_TABLE_CACHE: Dict[Tuple[int, object], jnp.ndarray] = {}
+
+
+def dictionary_table(d: Dictionary, key, fn) -> jnp.ndarray:
+    """Memoized host map over the string pool -> device array (index by code)."""
+    ck = (d.id, key)
+    if ck not in _DICT_TABLE_CACHE:
+        table = np.asarray([fn(s) for s in d.values])
+        _DICT_TABLE_CACHE[ck] = jnp.asarray(table)
+    return _DICT_TABLE_CACHE[ck]
+
+
+def like_pattern_to_regex(pattern: str, escape: Optional[str] = None) -> str:
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if escape and c == escape and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return "^" + "".join(out) + "$"
+
+
+def like_table(d: Dictionary, pattern: str,
+               escape: Optional[str] = None) -> jnp.ndarray:
+    rx = re.compile(like_pattern_to_regex(pattern, escape), re.DOTALL)
+    return dictionary_table(d, ("like", pattern, escape),
+                            lambda s: rx.match(s) is not None)
+
+
+def transform_dictionary(d: Dictionary, key, fn) -> Tuple[Dictionary, jnp.ndarray]:
+    """str->str transform as (new sorted dictionary, code remap table).
+
+    Device: new_codes = take(remap, codes). Memoized per (dictionary, op).
+    """
+    ck = (d.id, key, "xform")
+    if ck not in _DICT_TABLE_CACHE:
+        transformed = np.asarray([fn(s) for s in d.values], dtype=object)
+        new_vals, remap = np.unique(transformed, return_inverse=True)
+        nd = Dictionary(new_vals)
+        _DICT_TABLE_CACHE[ck] = (nd, jnp.asarray(remap.astype(np.int32)))
+    return _DICT_TABLE_CACHE[ck]

@@ -1,0 +1,87 @@
+"""Row-expression IR.
+
+Reference parity: the planner-side RowExpression family backing
+sql/gen/RowExpressionCompiler.java (ConstantExpression, InputReferenceExpression,
+CallExpression, SpecialForm). Expressions reference operator input channels by
+index (InputRef), matching how compiled PageProcessors address Page blocks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional, Tuple
+
+from trino_tpu import types as T
+
+
+class RowExpression:
+    type: T.Type
+
+    def children(self) -> Tuple["RowExpression", ...]:
+        return ()
+
+
+@dataclasses.dataclass(frozen=True)
+class InputRef(RowExpression):
+    """Reference to input channel `index` of the page being processed."""
+
+    index: int
+    type: T.Type
+
+    def __str__(self):
+        return f"#{self.index}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Literal(RowExpression):
+    """Constant. value=None means typed NULL."""
+
+    value: Optional[Any]
+    type: T.Type
+
+    def __str__(self):
+        return "null" if self.value is None else repr(self.value)
+
+
+@dataclasses.dataclass(frozen=True)
+class Call(RowExpression):
+    """Scalar function call resolved to a registry name, e.g. 'add:bigint'."""
+
+    name: str
+    args: Tuple[RowExpression, ...]
+    type: T.Type
+
+    def children(self):
+        return self.args
+
+    def __str__(self):
+        return f"{self.name}({', '.join(map(str, self.args))})"
+
+
+class SpecialKind(enum.Enum):
+    """Forms with non-default null/shortcut semantics (SpecialForm.Form)."""
+
+    AND = "and"
+    OR = "or"
+    NOT = "not"
+    IS_NULL = "is_null"
+    COALESCE = "coalesce"
+    IF = "if"            # args: cond, then, else
+    IN = "in"            # args: needle, value1..valueN (literals or exprs)
+    BETWEEN = "between"  # args: value, low, high
+    SWITCH = "switch"    # searched CASE: [cond1, val1, ..., condN, valN, default]
+    NULLIF = "nullif"
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecialForm(RowExpression):
+    kind: SpecialKind
+    args: Tuple[RowExpression, ...]
+    type: T.Type
+
+    def children(self):
+        return self.args
+
+    def __str__(self):
+        return f"{self.kind.value}({', '.join(map(str, self.args))})"

@@ -1,0 +1,15 @@
+"""Operator library: the compute kernels of the engine.
+
+Reference parity: core/trino-main/.../operator/ (SURVEY §2.7). Operators here
+are pure functions Page -> Page built from static parameters; a plan fragment
+composes them into one function that jits into a single fused XLA program —
+the Driver/Operator pull loop (operator/Driver.java:355) collapses into XLA's
+own scheduling, which is the TPU-idiomatic replacement for pipeline
+parallelism across operators.
+"""
+
+from trino_tpu.ops.filter_project import filter_project
+from trino_tpu.ops.aggregate import (
+    AGGREGATES, AggSpec, hash_aggregate, Step)
+from trino_tpu.ops.join import hash_join, JoinType
+from trino_tpu.ops.sort import limit, order_by, top_n, SortKey

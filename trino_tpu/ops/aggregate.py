@@ -1,0 +1,391 @@
+"""Hash aggregation as sort-based segment reduction.
+
+Reference parity: operator/HashAggregationOperator.java + the group-by hashes
+(MultiChannelGroupByHash.java:853, BigintGroupByHash.java:425) and codegen'd
+accumulators (operator/aggregation/AccumulatorCompiler.java:80).
+
+TPU design: instead of an open-addressing hash table (pointer-chasing, bad fit
+for the VPU), group-by = lexicographic `lax.sort` on the key columns, segment
+boundary detection, then `jax.ops.segment_*` reductions — O(n log n) but
+entirely vectorized, fusible, and deterministic. Distributed plans split the
+work into PARTIAL (pre-exchange, per shard) and FINAL (post-exchange) steps
+exactly like PushPartialAggregationThroughExchange.java; aggregate *state* is
+a tuple of columns (e.g. avg = (sum, count)), mirroring the reference's
+serialized accumulator states.
+
+Null semantics: GROUP BY treats NULL as a regular group (null-first in the
+sort key); aggregates skip NULL inputs; SUM/AVG/MIN/MAX of zero non-null rows
+is NULL, COUNT is 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trino_tpu import types as T
+from trino_tpu.page import Column, Page
+
+
+class Step:
+    """Aggregation step (reference: operator/aggregation/AggregationNode.Step)."""
+
+    SINGLE = "single"
+    PARTIAL = "partial"
+    FINAL = "final"
+
+
+@dataclasses.dataclass(frozen=True)
+class StateColumn:
+    """One column of aggregate state.
+
+    contrib: (values, valid_mask) -> per-row contribution array
+    reducer: 'sum' | 'min' | 'max' — also how partial states merge
+    """
+
+    type: T.Type
+    contrib: Callable
+    reducer: str
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregateFunction:
+    """Declarative aggregate: state columns + final projection.
+
+    final: (state_value_arrays, nonnull_counts_or_None) -> (values, valid|None)
+    """
+
+    name: str
+    state: Callable[[T.Type], Tuple[StateColumn, ...]]
+    final: Callable
+    output_type: Callable[[Optional[T.Type]], T.Type]
+
+
+def _sum_state(in_type):
+    acc_t = T.DOUBLE if isinstance(in_type, (T.DoubleType, T.RealType)) else T.BIGINT
+    if isinstance(in_type, T.DecimalType):
+        acc_t = in_type
+    return (
+        StateColumn(acc_t, lambda v, m: jnp.where(m, v, 0).astype(acc_t.dtype), "sum"),
+        StateColumn(T.BIGINT, lambda v, m: m.astype(jnp.int64), "sum"),  # nnz
+    )
+
+
+def _sum_final(state, _):
+    total, nnz = state
+    return total, nnz > 0
+
+
+def _count_state(in_type):
+    return (StateColumn(T.BIGINT, lambda v, m: m.astype(jnp.int64), "sum"),)
+
+
+def _count_final(state, _):
+    return state[0], None
+
+
+def _minmax_state(in_type, is_min):
+    dt = in_type.dtype
+    if jnp.issubdtype(jnp.dtype(dt), jnp.floating):
+        ident = jnp.inf if is_min else -jnp.inf
+    elif jnp.dtype(dt) == jnp.bool_:
+        ident = True if is_min else False
+    else:
+        info = jnp.iinfo(dt)
+        ident = info.max if is_min else info.min
+    red = "min" if is_min else "max"
+    return (
+        StateColumn(in_type, lambda v, m: jnp.where(m, v, ident).astype(dt), red),
+        StateColumn(T.BIGINT, lambda v, m: m.astype(jnp.int64), "sum"),
+    )
+
+
+def _minmax_final(state, _):
+    value, nnz = state
+    return value, nnz > 0
+
+
+def _avg_state(in_type):
+    if isinstance(in_type, T.DecimalType):
+        sum_t = in_type
+    else:
+        sum_t = T.DOUBLE
+    return (
+        StateColumn(sum_t, lambda v, m: jnp.where(m, v, 0).astype(sum_t.dtype), "sum"),
+        StateColumn(T.BIGINT, lambda v, m: m.astype(jnp.int64), "sum"),
+    )
+
+
+def _avg_final_factory(in_type):
+    def final(state, _):
+        total, nnz = state
+        denom = jnp.maximum(nnz, 1)
+        if isinstance(in_type, T.DecimalType):
+            # decimal avg keeps scale, HALF_UP
+            half = jax.lax.div(denom, jnp.int64(2))
+            adj = jnp.where(total >= 0, total + half, total - half)
+            value = jax.lax.div(adj, denom)
+        else:
+            value = total.astype(jnp.float64) / denom
+        return value, nnz > 0
+    return final
+
+
+def get_aggregate(name: str, in_type: Optional[T.Type]) -> AggregateFunction:
+    """Resolve an aggregate by name + input type (FunctionRegistry analog)."""
+    n = name.lower()
+    if n == "count":
+        return AggregateFunction("count", _count_state, _count_final,
+                                 lambda t: T.BIGINT)
+    if n == "sum":
+        out = in_type if isinstance(in_type, (T.DecimalType, T.DoubleType,
+                                              T.RealType)) else T.BIGINT
+        if isinstance(in_type, T.RealType):
+            out = T.REAL
+        return AggregateFunction("sum", _sum_state, _sum_final, lambda t: out)
+    if n == "avg":
+        out = in_type if isinstance(in_type, T.DecimalType) else T.DOUBLE
+        return AggregateFunction("avg", _avg_state, _avg_final_factory(in_type),
+                                 lambda t: out)
+    if n == "min":
+        return AggregateFunction(
+            "min", lambda t: _minmax_state(t, True), _minmax_final,
+            lambda t: in_type)
+    if n == "max":
+        return AggregateFunction(
+            "max", lambda t: _minmax_state(t, False), _minmax_final,
+            lambda t: in_type)
+    raise KeyError(f"unknown aggregate function: {name}")
+
+
+AGGREGATES = ("count", "sum", "avg", "min", "max")
+
+
+@dataclasses.dataclass(frozen=True)
+class AggSpec:
+    """One aggregate call in a plan: fn(input_channel). input None = count(*)."""
+
+    name: str
+    input: Optional[int]
+    input_type: Optional[T.Type]
+    mask_channel: Optional[int] = None  # e.g. count(x) FILTER (WHERE ...)
+    distinct: bool = False
+
+
+def _sort_key_arrays(page: Page, key_channels: Sequence[int]):
+    """Composite sort operands: dead-flag first, then (null, value) per key.
+
+    Null rows' value lanes hold garbage; canonicalize them to 0 so all nulls
+    of a key collate into ONE group (the null flag is a separate sort key).
+    """
+    dead = ~page.row_mask()  # False (live) sorts before True (dead)
+    operands = [dead]
+    for ch in key_channels:
+        col = page.column(ch)
+        if col.valid is not None:
+            operands.append(~col.valid)  # nulls group after non-nulls
+            operands.append(jnp.where(col.valid, col.values,
+                                      jnp.zeros((), col.values.dtype)))
+        else:
+            operands.append(col.values)
+    return operands
+
+
+def hash_aggregate(
+    key_channels: Sequence[int],
+    aggs: Sequence[AggSpec],
+    step: str = Step.SINGLE,
+    partial_state_channels: Optional[Sequence[Sequence[int]]] = None,
+) -> Callable[[Page], Page]:
+    """Build a group-by aggregation operator.
+
+    Output page layout: [key columns..., per-agg output columns...]. For
+    step=PARTIAL the per-agg outputs are the raw state columns (consumed by a
+    FINAL step whose partial_state_channels maps agg -> its state channels).
+    Capacity: output keeps input capacity (#groups <= #rows).
+    """
+    key_channels = tuple(key_channels)
+    resolved = [get_aggregate(a.name, a.input_type) for a in aggs]
+
+    def op(page: Page) -> Page:
+        n = page.capacity
+        if not key_channels:
+            return _global_aggregate(page, aggs, resolved, step,
+                                     partial_state_channels)
+        operands = _sort_key_arrays(page, key_channels)
+        perm = jnp.arange(n, dtype=jnp.int32)
+        sorted_ops = jax.lax.sort(operands + [perm],
+                                  num_keys=len(operands))
+        perm_sorted = sorted_ops[-1]
+        # boundary detection on the *sorted* key operands (incl. null flags)
+        key_ops = sorted_ops[1:-1]
+        live_sorted = ~sorted_ops[0]
+        boundary = jnp.zeros(n, dtype=jnp.bool_).at[0].set(True)
+        for arr in key_ops:
+            boundary = boundary | (arr != jnp.roll(arr, 1)).at[0].set(
+                boundary[0])
+        boundary = boundary & live_sorted
+        group_of_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+        num_groups = jnp.sum(boundary).astype(jnp.int32)
+        # route dead rows to an out-of-range segment id so they drop out
+        seg = jnp.where(live_sorted, group_of_sorted, n)
+
+        out_cols: List[Column] = []
+        # group key output = first sorted row of each segment
+        first_idx = jnp.zeros(n, dtype=jnp.int32).at[
+            jnp.where(boundary, group_of_sorted, n)].set(
+            jnp.arange(n, dtype=jnp.int32), mode="drop")
+        key_row = jnp.take(perm_sorted, first_idx, mode="clip")
+        for ch in key_channels:
+            out_cols.append(page.column(ch).gather(key_row))
+
+        agg_cols = _accumulate(page, aggs, resolved, step,
+                               partial_state_channels, perm_sorted, seg, n)
+        out_cols.extend(agg_cols)
+        return Page(tuple(out_cols), num_groups)
+
+    return op
+
+
+def _segment_reduce(contrib, seg, n, reducer):
+    if reducer == "sum":
+        return jax.ops.segment_sum(contrib, seg, num_segments=n)
+    if reducer == "min":
+        return jax.ops.segment_min(contrib, seg, num_segments=n)
+    if reducer == "max":
+        return jax.ops.segment_max(contrib, seg, num_segments=n)
+    raise ValueError(reducer)
+
+
+def _accumulate(page, aggs, resolved, step, partial_state_channels,
+                perm_sorted, seg, n) -> List[Column]:
+    """Per-agg state accumulation + (for FINAL/SINGLE) final projection."""
+    out: List[Column] = []
+    for ai, (spec, fn) in enumerate(zip(aggs, resolved)):
+        if step == Step.FINAL:
+            # inputs are partial state columns; merge with each state's reducer
+            chans = partial_state_channels[ai]
+            states = fn.state(spec.input_type)
+            merged = []
+            for sc, ch in zip(states, chans):
+                col = page.column(ch)
+                vals = jnp.take(col.values, perm_sorted, mode="clip")
+                # dead rows contribute the reducer identity
+                if sc.reducer == "sum":
+                    ident = jnp.zeros((), dtype=vals.dtype)
+                elif sc.reducer == "min":
+                    ident = _ident_for(vals.dtype, True)
+                else:
+                    ident = _ident_for(vals.dtype, False)
+                vals = jnp.where(seg < n, vals, ident)
+                merged.append(_segment_reduce(vals, seg, n, sc.reducer))
+            values, valid = fn.final(merged, None)
+            out.append(_agg_out_column(fn, spec, values, valid,
+                                       page.column(chans[0]).dictionary))
+        else:
+            states = fn.state(spec.input_type)
+            dictionary = None
+            if spec.input is not None:
+                col = page.column(spec.input)
+                dictionary = col.dictionary
+                vals = jnp.take(col.values, perm_sorted, mode="clip")
+                mask = jnp.take(col.valid_mask(), perm_sorted, mode="clip")
+            else:
+                vals = jnp.zeros(page.capacity, dtype=jnp.int64)
+                mask = jnp.ones(page.capacity, dtype=jnp.bool_)
+            mask = mask & (seg < n)
+            if spec.mask_channel is not None:
+                fcol = page.column(spec.mask_channel)
+                fmask = jnp.take(fcol.values & fcol.valid_mask(), perm_sorted,
+                                 mode="clip")
+                mask = mask & fmask
+            state_arrays = []
+            for sc in states:
+                contrib = sc.contrib(vals, mask)
+                state_arrays.append(_segment_reduce(contrib, seg, n, sc.reducer))
+            if step == Step.PARTIAL:
+                for sc, arr in zip(states, state_arrays):
+                    d = dictionary if T.is_string(sc.type) else None
+                    out.append(Column(arr.astype(sc.type.dtype), None, sc.type,
+                                      d))
+            else:  # SINGLE
+                values, valid = fn.final(state_arrays, None)
+                out.append(_agg_out_column(fn, spec, values, valid, dictionary))
+    return out
+
+
+def _ident_for(dtype, is_min):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf if is_min else -jnp.inf, dtype=dtype)
+    if dtype == jnp.bool_:
+        return jnp.asarray(is_min, dtype=dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.asarray(info.max if is_min else info.min, dtype=dtype)
+
+
+def _agg_out_column(fn, spec, values, valid, dictionary=None) -> Column:
+    out_t = fn.output_type(spec.input_type)
+    # min/max over varchar operate on dictionary codes; keep the pool so the
+    # result decodes as strings
+    if not T.is_string(out_t):
+        dictionary = None
+    return Column(values.astype(out_t.dtype), valid, out_t, dictionary)
+
+
+def _global_aggregate(page, aggs, resolved, step, partial_state_channels):
+    """No GROUP BY: one output row (reference: AggregationOperator.java)."""
+    live = page.row_mask()
+    out_cols: List[Column] = []
+    for ai, (spec, fn) in enumerate(zip(aggs, resolved)):
+        states = fn.state(spec.input_type)
+        if step == Step.FINAL:
+            chans = partial_state_channels[ai]
+            merged = []
+            for sc, ch in zip(states, chans):
+                col = page.column(ch)
+                vals = col.values
+                ident = (jnp.zeros((), vals.dtype) if sc.reducer == "sum" else
+                         _ident_for(vals.dtype, sc.reducer == "min"))
+                vals = jnp.where(live, vals, ident)
+                if sc.reducer == "sum":
+                    merged.append(jnp.sum(vals, keepdims=True))
+                elif sc.reducer == "min":
+                    merged.append(jnp.min(vals, keepdims=True))
+                else:
+                    merged.append(jnp.max(vals, keepdims=True))
+            values, valid = fn.final(merged, None)
+            out_cols.append(_agg_out_column(
+                fn, spec, values, valid, page.column(chans[0]).dictionary))
+            continue
+        dictionary = None
+        if spec.input is not None:
+            col = page.column(spec.input)
+            dictionary = col.dictionary
+            vals, mask = col.values, col.valid_mask() & live
+        else:
+            vals = jnp.zeros(page.capacity, dtype=jnp.int64)
+            mask = live
+        if spec.mask_channel is not None:
+            fcol = page.column(spec.mask_channel)
+            mask = mask & fcol.values & fcol.valid_mask()
+        state_arrays = []
+        for sc in states:
+            contrib = sc.contrib(vals, mask)
+            if sc.reducer == "sum":
+                state_arrays.append(jnp.sum(contrib, keepdims=True))
+            elif sc.reducer == "min":
+                state_arrays.append(jnp.min(contrib, keepdims=True))
+            else:
+                state_arrays.append(jnp.max(contrib, keepdims=True))
+        if step == Step.PARTIAL:
+            for sc, arr in zip(states, state_arrays):
+                d = dictionary if T.is_string(sc.type) else None
+                out_cols.append(Column(arr.astype(sc.type.dtype), None, sc.type,
+                                       d))
+        else:
+            values, valid = fn.final(state_arrays, None)
+            out_cols.append(_agg_out_column(fn, spec, values, valid, dictionary))
+    return Page(tuple(out_cols), jnp.asarray(1, dtype=jnp.int32))

@@ -1,0 +1,33 @@
+"""Fused filter + project operator.
+
+Reference parity: operator/ScanFilterAndProjectOperator.java +
+FilterAndProjectOperator.java with their compiled PageProcessor
+(operator/project/PageProcessor.java). Here: compile_filter/compile_expression
+produce traced jnp, and XLA fuses predicate, compaction, and projections into
+one kernel under the fragment's jit.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+from trino_tpu.expr.compiler import compile_expression, compile_filter
+from trino_tpu.expr.ir import RowExpression
+from trino_tpu.page import Page
+
+
+def filter_project(
+    filter_expr: Optional[RowExpression],
+    projections: Sequence[RowExpression],
+) -> Callable[[Page], Page]:
+    """Build op: keep rows passing filter_expr, emit one column per projection."""
+    filter_fn = compile_filter(filter_expr) if filter_expr is not None else None
+    project_fns = [compile_expression(p) for p in projections]
+
+    def op(page: Page) -> Page:
+        if filter_fn is not None:
+            page = page.filter(filter_fn(page))
+        cols = tuple(fn(page) for fn in project_fns)
+        return Page(cols, page.num_rows)
+
+    return op

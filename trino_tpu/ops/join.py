@@ -1,0 +1,186 @@
+"""Hash join as sorted-build + binary-search probe + cumsum expansion.
+
+Reference parity: operator/join/ (HashBuilderOperator.java:59, PagesHash.java,
+LookupJoinOperator.java:36, HashSemiJoinOperator, NestedLoopJoinOperator).
+
+TPU design: open-addressing tables probe with data-dependent loops — a poor
+VPU fit. Instead:
+  build:  sort build rows by join key (lax.sort)                O(n log n)
+  probe:  lower/upper bound via vectorized searchsorted         O(m log n)
+  expand: match counts -> cumsum offsets -> one gather per side O(out)
+This is exact for duplicate keys (a probe row emits hi-lo rows) and fully
+static-shape: the output page has a planner-chosen capacity; the operator also
+returns the true match total so the executor can detect overflow and re-run
+at a larger capacity bucket (SURVEY §7 hard part 1).
+
+Composite keys collapse to one u64 via a mixing hash; INNER joins verify the
+real key columns post-expansion so collisions are filtered exactly. (LEFT
+composite joins currently trust the 64-bit hash — collision-verification with
+null-row re-extension is a planned refinement.) SQL semantics: NULL join keys
+never match (including NULL = NULL); LEFT rows without matches emit once with
+build side NULL.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trino_tpu import types as T
+from trino_tpu.page import Column, Page
+
+
+class JoinType:
+    INNER = "inner"
+    LEFT = "left"          # probe side preserved
+    SEMI = "semi"          # probe rows with >=1 match (IN / EXISTS)
+    ANTI = "anti"          # probe rows with 0 matches (NOT IN w/o nulls)
+
+
+_MIX = jnp.uint64(0x9E3779B97F4A7C15)
+
+
+def _mix64(x: jnp.ndarray) -> jnp.ndarray:
+    """splitmix64 finalizer — the PagesHash hash-combining analog."""
+    x = x.astype(jnp.uint64)
+    x = (x ^ (x >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> 27)) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> 31)
+
+
+def _key_u64(page: Page, channels: Sequence[int]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(key, key_is_null): single u64 key; composite keys mix-hashed."""
+    cols = [page.column(ch) for ch in channels]
+    null = jnp.zeros(page.capacity, dtype=jnp.bool_)
+    for c in cols:
+        if c.valid is not None:
+            null = null | ~c.valid
+    def to_u64(raw):
+        if raw.dtype == jnp.bool_:
+            return raw.astype(jnp.uint64)
+        if jnp.issubdtype(raw.dtype, jnp.floating):
+            # canonicalize -0.0 -> +0.0 so SQL-equal doubles get equal bits
+            return jax.lax.bitcast_convert_type(
+                raw.astype(jnp.float64) + 0.0, jnp.uint64)
+        return raw.astype(jnp.uint64)
+
+    if len(cols) == 1:
+        return to_u64(cols[0].values), null
+    acc = jnp.zeros(page.capacity, dtype=jnp.uint64)
+    for c in cols:
+        k = to_u64(c.values)
+        acc = _mix64(acc ^ _mix64(k) ^ (acc * _MIX))
+    return acc, null
+
+
+def hash_join(
+    probe_keys: Sequence[int],
+    build_keys: Sequence[int],
+    join_type: str = JoinType.INNER,
+    output_capacity: Optional[int] = None,
+    verify_composite: bool = True,
+) -> Callable[[Page, Page], Tuple[Page, jnp.ndarray]]:
+    """Build op(probe_page, build_page) -> (output_page, true_total_rows).
+
+    Output layout: probe columns ++ build columns (semi/anti: probe only).
+    output_capacity: static result capacity; defaults to probe capacity.
+    true_total_rows may exceed num_rows when the capacity was too small —
+    the executor re-plans at a larger bucket (never silently truncates).
+    """
+    probe_keys = tuple(probe_keys)
+    build_keys = tuple(build_keys)
+    composite = len(probe_keys) > 1
+
+    def op(probe: Page, build: Page) -> Tuple[Page, jnp.ndarray]:
+        n_build = build.capacity
+        n_probe = probe.capacity
+        n_probe_cols = probe.num_columns
+        cap = output_capacity or n_probe
+        for pk, bk in zip(probe_keys, build_keys):
+            pd = probe.column(pk).dictionary
+            bd = build.column(bk).dictionary
+            if pd is not None and bd is not None and pd is not bd:
+                raise NotImplementedError(
+                    "string join keys across distinct dictionaries; "
+                    "re-encode to a shared dictionary first")
+
+        bkey, bnull = _key_u64(build, build_keys)
+        pkey, pnull = _key_u64(probe, probe_keys)
+        # dead/null build rows: mask their key to u64::MAX and sort by
+        # (key, dead) — keeps the key array globally sorted for searchsorted
+        # while guaranteeing live rows occupy the prefix [0, n_live) (live
+        # rows win ties at MAX via the secondary dead flag)
+        b_dead = ~build.row_mask() | bnull
+        u64max = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+        bkey_masked = jnp.where(b_dead, u64max, bkey)
+        sort_ops = jax.lax.sort(
+            [bkey_masked, b_dead, jnp.arange(n_build, dtype=jnp.int32)],
+            num_keys=2)
+        bkey_s, b_dead_s, bperm = sort_ops
+        n_live_build = jnp.sum(~b_dead_s).astype(jnp.int32)
+
+        p_dead = ~probe.row_mask() | pnull
+        # searchsorted over the live prefix: clamp indices into [0, n_live]
+        lo = jnp.searchsorted(bkey_s, pkey, side="left")
+        hi = jnp.searchsorted(bkey_s, pkey, side="right")
+        lo = jnp.minimum(lo, n_live_build)
+        hi = jnp.minimum(hi, n_live_build)
+        counts = jnp.where(p_dead, 0, hi - lo).astype(jnp.int64)
+
+        if join_type == JoinType.SEMI:
+            out = probe.filter((counts > 0) & ~p_dead)
+            return out, out.num_rows.astype(jnp.int64)
+        if join_type == JoinType.ANTI:
+            out = probe.filter((counts == 0) & ~p_dead & probe.row_mask())
+            return out, out.num_rows.astype(jnp.int64)
+
+        emit = counts
+        if join_type == JoinType.LEFT:
+            # unmatched live probe rows (incl. null keys) emit one null-extended row
+            live_probe = probe.row_mask()
+            emit = jnp.where(live_probe & (counts == 0), 1, counts)
+            emit = jnp.where(live_probe, emit, 0)
+        offsets = jnp.cumsum(emit)
+        total = offsets[-1]
+        starts = offsets - emit  # exclusive prefix
+
+        out_idx = jnp.arange(cap, dtype=jnp.int64)
+        # which probe row produced output slot j: last start <= j
+        prow = jnp.searchsorted(offsets, out_idx, side="right").astype(jnp.int32)
+        prow_c = jnp.minimum(prow, n_probe - 1)
+        j_within = out_idx - jnp.take(starts, prow_c, mode="clip")
+        brow_sorted = jnp.take(lo, prow_c, mode="clip") + j_within
+        brow = jnp.take(bperm, jnp.minimum(brow_sorted, n_build - 1),
+                        mode="clip").astype(jnp.int32)
+        slot_live = out_idx < jnp.minimum(total, cap)
+        matched = jnp.take(counts, prow_c, mode="clip") > 0
+
+        pcols = tuple(c.gather(prow_c) for c in probe.columns)
+        bcols = []
+        build_is_null = slot_live & ~matched  # LEFT null-extension rows
+        for c in build.columns:
+            g = c.gather(brow)
+            valid = g.valid_mask() & ~build_is_null
+            bcols.append(Column(g.values, valid, c.type, c.dictionary))
+        out_rows = jnp.minimum(total, cap).astype(jnp.int32)
+        out_page = Page(pcols + tuple(bcols), out_rows)
+
+        if composite and verify_composite and join_type == JoinType.INNER:
+            # filter hash-collision rows by re-checking real key equality
+            keep = jnp.ones(cap, dtype=jnp.bool_)
+            for pk, bk in zip(probe_keys, build_keys):
+                pv = out_page.column(pk)
+                bv = out_page.column(n_probe_cols + bk)
+                keep = keep & (pv.values == bv.values)
+            out_page = out_page.filter(keep)
+            # overflow contract: if every hash match fit in cap, the filtered
+            # count is the exact total; else keep the (over)count so the
+            # executor re-plans at a larger capacity
+            total = jnp.where(total <= cap,
+                              out_page.num_rows.astype(jnp.int64), total)
+        return out_page, total
+
+    return op
