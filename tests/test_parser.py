@@ -1,0 +1,336 @@
+"""Parser tests.
+
+Mirrors core/trino-parser/src/test/java/io/trino/sql/parser/TestSqlParser.java
+in spirit: round-trip/shape assertions on parsed ASTs plus full TPC-H parse
+coverage (the queries the measurement ladder needs).
+"""
+
+import pytest
+
+from trino_tpu.sql import parse_expression, parse_statement
+from trino_tpu.sql import tree as t
+from trino_tpu.sql.lexer import ParsingError
+
+
+def test_simple_select():
+    q = parse_statement("SELECT a, b AS x FROM t WHERE a > 5")
+    assert isinstance(q, t.Query)
+    spec = q.body
+    assert isinstance(spec, t.QuerySpecification)
+    assert len(spec.select.items) == 2
+    assert spec.select.items[1].alias == t.Identifier("x")
+    assert isinstance(spec.from_, t.Table)
+    assert spec.from_.name.parts == ("t",)
+    assert isinstance(spec.where, t.ComparisonExpression)
+    assert spec.where.op == ">"
+
+
+def test_expression_precedence():
+    e = parse_expression("1 + 2 * 3")
+    assert isinstance(e, t.ArithmeticBinary) and e.op == "+"
+    assert isinstance(e.right, t.ArithmeticBinary) and e.right.op == "*"
+
+    e = parse_expression("a OR b AND NOT c")
+    assert isinstance(e, t.LogicalBinary) and e.op == "OR"
+    assert isinstance(e.right, t.LogicalBinary) and e.right.op == "AND"
+    assert isinstance(e.right.right, t.NotExpression)
+
+
+def test_comparison_chain_and_predicates():
+    e = parse_expression("x BETWEEN 1 AND 10 AND y IN (1, 2, 3)")
+    assert isinstance(e, t.LogicalBinary) and e.op == "AND"
+    assert isinstance(e.left, t.BetweenPredicate)
+    assert isinstance(e.right, t.InPredicate)
+
+    e = parse_expression("name NOT LIKE 'a%'")
+    assert isinstance(e, t.NotExpression)
+    assert isinstance(e.value, t.LikePredicate)
+
+    e = parse_expression("x IS NOT NULL")
+    assert isinstance(e, t.IsNotNullPredicate)
+
+
+def test_literals():
+    assert parse_expression("42") == t.LongLiteral(42)
+    assert parse_expression("-7") == t.LongLiteral(-7)
+    assert parse_expression("4.2") == t.DecimalLiteral("4.2")
+    assert parse_expression("4.2e1") == t.DoubleLiteral(42.0)
+    assert parse_expression("'don''t'") == t.StringLiteral("don't")
+    assert parse_expression("DATE '1995-01-01'") == t.DateLiteral("1995-01-01")
+    assert parse_expression("NULL") == t.NullLiteral()
+    iv = parse_expression("INTERVAL '3' MONTH")
+    assert iv == t.IntervalLiteral("3", "MONTH")
+
+
+def test_case_cast_functions():
+    e = parse_expression(
+        "CASE WHEN a = 1 THEN 'one' ELSE 'other' END")
+    assert isinstance(e, t.SearchedCaseExpression)
+    assert len(e.when_clauses) == 1 and e.default is not None
+
+    e = parse_expression("CAST(x AS decimal(12,2))")
+    assert isinstance(e, t.Cast) and e.target_type == "decimal(12,2)"
+
+    e = parse_expression("sum(x * y)")
+    assert isinstance(e, t.FunctionCall)
+    assert e.name.suffix == "sum"
+
+    e = parse_expression("count(*)")
+    assert isinstance(e, t.FunctionCall) and e.args == ()
+
+    e = parse_expression("count(DISTINCT x)")
+    assert e.distinct
+
+
+def test_joins():
+    q = parse_statement(
+        "SELECT * FROM a JOIN b ON a.id = b.id LEFT JOIN c USING (k)")
+    spec = q.body
+    join = spec.from_
+    assert isinstance(join, t.Join) and join.join_type == "LEFT"
+    assert isinstance(join.criteria, t.JoinUsing)
+    inner = join.left
+    assert isinstance(inner, t.Join) and inner.join_type == "INNER"
+    assert isinstance(inner.criteria, t.JoinOn)
+
+
+def test_implicit_join_and_alias():
+    q = parse_statement("SELECT * FROM a x, b y WHERE x.k = y.k")
+    join = q.body.from_
+    assert isinstance(join, t.Join) and join.join_type == "IMPLICIT"
+    assert isinstance(join.left, t.AliasedRelation)
+    assert join.left.alias == t.Identifier("x")
+
+
+def test_group_order_limit():
+    q = parse_statement(
+        "SELECT k, sum(v) FROM t GROUP BY k HAVING sum(v) > 0 "
+        "ORDER BY 2 DESC NULLS FIRST LIMIT 10")
+    spec = q.body
+    assert isinstance(spec.group_by.elements[0], t.SimpleGroupBy)
+    assert spec.having is not None
+    assert spec.order_by[0].ascending is False
+    assert spec.order_by[0].nulls_first is True
+    assert spec.limit == t.LongLiteral(10)
+
+
+def test_grouping_sets():
+    q = parse_statement(
+        "SELECT a, b, sum(c) FROM t GROUP BY GROUPING SETS ((a, b), (a), ())")
+    gs = q.body.group_by.elements[0]
+    assert isinstance(gs, t.GroupingSets)
+    assert len(gs.sets) == 3 and gs.sets[2] == ()
+
+    q = parse_statement("SELECT a, sum(c) FROM t GROUP BY ROLLUP (a, b)")
+    assert isinstance(q.body.group_by.elements[0], t.Rollup)
+
+
+def test_with_and_subquery():
+    q = parse_statement(
+        "WITH x AS (SELECT 1 AS a) SELECT * FROM x, (SELECT 2 AS b) y")
+    assert q.with_ is not None
+    assert q.with_.queries[0].name == t.Identifier("x")
+
+    e = parse_expression("(SELECT max(v) FROM t)")
+    assert isinstance(e, t.SubqueryExpression)
+
+
+def test_set_operations():
+    q = parse_statement("SELECT 1 UNION ALL SELECT 2 UNION SELECT 3")
+    body = q.body
+    assert isinstance(body, t.SetOperation) and body.op == "UNION"
+    assert body.distinct  # outer UNION is distinct
+    assert isinstance(body.left, t.SetOperation)
+    assert not body.left.distinct  # UNION ALL
+
+
+def test_window_functions():
+    e = parse_expression(
+        "rank() OVER (PARTITION BY a ORDER BY b DESC "
+        "ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW)")
+    assert isinstance(e, t.FunctionCall)
+    assert e.window is not None
+    assert len(e.window.partition_by) == 1
+    assert e.window.frame.frame_type == "ROWS"
+    assert e.window.frame.start_type == "UNBOUNDED_PRECEDING"
+    assert e.window.frame.end_type == "CURRENT_ROW"
+
+
+def test_ddl_dml():
+    s = parse_statement("CREATE TABLE t (a bigint, b varchar(10) NOT NULL)")
+    assert isinstance(s, t.CreateTable)
+    assert s.elements[1].nullable is False
+
+    s = parse_statement("CREATE TABLE t2 AS SELECT * FROM t")
+    assert isinstance(s, t.CreateTableAsSelect)
+
+    s = parse_statement("INSERT INTO t (a, b) SELECT a, b FROM s")
+    assert isinstance(s, t.Insert) and len(s.columns) == 2
+
+    s = parse_statement("DELETE FROM t WHERE a < 0")
+    assert isinstance(s, t.Delete) and s.where is not None
+
+    s = parse_statement("DROP TABLE IF EXISTS t")
+    assert isinstance(s, t.DropTable) and s.exists
+
+
+def test_explain_show_session():
+    s = parse_statement("EXPLAIN ANALYZE SELECT 1")
+    assert isinstance(s, t.Explain) and s.analyze
+
+    s = parse_statement("EXPLAIN (TYPE LOGICAL) SELECT 1")
+    assert s.explain_type == "LOGICAL"
+
+    assert isinstance(parse_statement("SHOW TABLES"), t.ShowTables)
+    assert isinstance(parse_statement("SHOW CATALOGS"), t.ShowCatalogs)
+
+    s = parse_statement("SET SESSION join_distribution_type = 'BROADCAST'")
+    assert isinstance(s, t.SetSession)
+
+
+def test_errors():
+    with pytest.raises(ParsingError):
+        parse_statement("SELECT FROM WHERE")
+    with pytest.raises(ParsingError):
+        parse_statement("SELECT 1 +")
+    with pytest.raises(ParsingError):
+        parse_statement("SELECT 1 junk junk junk")
+
+
+# ---------------------------------------------------------------- TPC-H suite
+
+TPCH = {
+    1: """
+SELECT l_returnflag, l_linestatus, sum(l_quantity) AS sum_qty,
+       sum(l_extendedprice) AS sum_base_price,
+       sum(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+       avg(l_quantity) AS avg_qty, avg(l_extendedprice) AS avg_price,
+       avg(l_discount) AS avg_disc, count(*) AS count_order
+FROM lineitem
+WHERE l_shipdate <= DATE '1998-12-01' - INTERVAL '90' DAY
+GROUP BY l_returnflag, l_linestatus
+ORDER BY l_returnflag, l_linestatus
+""",
+    3: """
+SELECT l_orderkey, sum(l_extendedprice * (1 - l_discount)) AS revenue,
+       o_orderdate, o_shippriority
+FROM customer, orders, lineitem
+WHERE c_mktsegment = 'BUILDING' AND c_custkey = o_custkey
+  AND l_orderkey = o_orderkey AND o_orderdate < DATE '1995-03-15'
+  AND l_shipdate > DATE '1995-03-15'
+GROUP BY l_orderkey, o_orderdate, o_shippriority
+ORDER BY revenue DESC, o_orderdate
+LIMIT 10
+""",
+    5: """
+SELECT n_name, sum(l_extendedprice * (1 - l_discount)) AS revenue
+FROM customer, orders, lineitem, supplier, nation, region
+WHERE c_custkey = o_custkey AND l_orderkey = o_orderkey
+  AND l_suppkey = s_suppkey AND c_nationkey = s_nationkey
+  AND s_nationkey = n_nationkey AND n_regionkey = r_regionkey
+  AND r_name = 'ASIA' AND o_orderdate >= DATE '1994-01-01'
+  AND o_orderdate < DATE '1994-01-01' + INTERVAL '1' YEAR
+GROUP BY n_name ORDER BY revenue DESC
+""",
+    6: """
+SELECT sum(l_extendedprice * l_discount) AS revenue
+FROM lineitem
+WHERE l_shipdate >= DATE '1994-01-01'
+  AND l_shipdate < DATE '1994-01-01' + INTERVAL '1' YEAR
+  AND l_discount BETWEEN 0.06 - 0.01 AND 0.06 + 0.01
+  AND l_quantity < 24
+""",
+    7: """
+SELECT supp_nation, cust_nation, l_year, sum(volume) AS revenue
+FROM (SELECT n1.n_name AS supp_nation, n2.n_name AS cust_nation,
+             EXTRACT(YEAR FROM l_shipdate) AS l_year,
+             l_extendedprice * (1 - l_discount) AS volume
+      FROM supplier, lineitem, orders, customer, nation n1, nation n2
+      WHERE s_suppkey = l_suppkey AND o_orderkey = l_orderkey
+        AND c_custkey = o_custkey AND s_nationkey = n1.n_nationkey
+        AND c_nationkey = n2.n_nationkey
+        AND ((n1.n_name = 'FRANCE' AND n2.n_name = 'GERMANY')
+             OR (n1.n_name = 'GERMANY' AND n2.n_name = 'FRANCE'))
+        AND l_shipdate BETWEEN DATE '1995-01-01' AND DATE '1996-12-31')
+     AS shipping
+GROUP BY supp_nation, cust_nation, l_year
+ORDER BY supp_nation, cust_nation, l_year
+""",
+    9: """
+SELECT nation, o_year, sum(amount) AS sum_profit
+FROM (SELECT n_name AS nation, EXTRACT(YEAR FROM o_orderdate) AS o_year,
+             l_extendedprice * (1 - l_discount)
+               - ps_supplycost * l_quantity AS amount
+      FROM part, supplier, lineitem, partsupp, orders, nation
+      WHERE s_suppkey = l_suppkey AND ps_suppkey = l_suppkey
+        AND ps_partkey = l_partkey AND p_partkey = l_partkey
+        AND o_orderkey = l_orderkey AND s_nationkey = n_nationkey
+        AND p_name LIKE '%green%') AS profit
+GROUP BY nation, o_year ORDER BY nation, o_year DESC
+""",
+    13: """
+SELECT c_count, count(*) AS custdist
+FROM (SELECT c_custkey, count(o_orderkey) AS c_count
+      FROM customer LEFT OUTER JOIN orders
+        ON c_custkey = o_custkey AND o_comment NOT LIKE '%special%requests%'
+      GROUP BY c_custkey) AS c_orders
+GROUP BY c_count ORDER BY custdist DESC, c_count DESC
+""",
+    14: """
+SELECT 100.00 * sum(CASE WHEN p_type LIKE 'PROMO%'
+                         THEN l_extendedprice * (1 - l_discount)
+                         ELSE 0 END)
+       / sum(l_extendedprice * (1 - l_discount)) AS promo_revenue
+FROM lineitem, part
+WHERE l_partkey = p_partkey AND l_shipdate >= DATE '1995-09-01'
+  AND l_shipdate < DATE '1995-09-01' + INTERVAL '1' MONTH
+""",
+    18: """
+SELECT c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+       sum(l_quantity)
+FROM customer, orders, lineitem
+WHERE o_orderkey IN (SELECT l_orderkey FROM lineitem
+                     GROUP BY l_orderkey HAVING sum(l_quantity) > 300)
+  AND c_custkey = o_custkey AND o_orderkey = l_orderkey
+GROUP BY c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+ORDER BY o_totalprice DESC, o_orderdate LIMIT 100
+""",
+    21: """
+SELECT s_name, count(*) AS numwait
+FROM supplier, lineitem l1, orders, nation
+WHERE s_suppkey = l1.l_suppkey AND o_orderkey = l1.l_orderkey
+  AND o_orderstatus = 'F' AND l1.l_receiptdate > l1.l_commitdate
+  AND EXISTS (SELECT * FROM lineitem l2
+              WHERE l2.l_orderkey = l1.l_orderkey
+                AND l2.l_suppkey <> l1.l_suppkey)
+  AND NOT EXISTS (SELECT * FROM lineitem l3
+                  WHERE l3.l_orderkey = l1.l_orderkey
+                    AND l3.l_suppkey <> l1.l_suppkey
+                    AND l3.l_receiptdate > l3.l_commitdate)
+  AND s_nationkey = n_nationkey AND n_name = 'SAUDI ARABIA'
+GROUP BY s_name ORDER BY numwait DESC, s_name LIMIT 100
+""",
+    22: """
+SELECT cntrycode, count(*) AS numcust, sum(c_acctbal) AS totacctbal
+FROM (SELECT substring(c_phone, 1, 2) AS cntrycode, c_acctbal
+      FROM customer
+      WHERE substring(c_phone, 1, 2) IN ('13', '31', '23', '29', '30', '18', '17')
+        AND c_acctbal > (SELECT avg(c_acctbal) FROM customer
+                         WHERE c_acctbal > 0.00
+                           AND substring(c_phone, 1, 2)
+                               IN ('13', '31', '23', '29', '30', '18', '17'))
+        AND NOT EXISTS (SELECT * FROM orders WHERE o_custkey = c_custkey))
+     AS custsale
+GROUP BY cntrycode ORDER BY cntrycode
+""",
+}
+
+
+@pytest.mark.parametrize("qnum", sorted(TPCH))
+def test_tpch_parses(qnum):
+    stmt = parse_statement(TPCH[qnum])
+    assert isinstance(stmt, t.Query)
+    # every query must survive a full AST walk
+    nodes = list(t.walk(stmt))
+    assert len(nodes) > 5

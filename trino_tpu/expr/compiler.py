@@ -248,47 +248,18 @@ def _eval_special(expr: SpecialForm, page: Page) -> Column:
             out = Column(values, valid, expr.type, dictionary)
         return out
     if kind is SpecialKind.IF:
-        cond = _eval(expr.args[0], page)
-        then = _eval(expr.args[1], page)
-        els = _eval(expr.args[2], page)
-        take_then = cond.values
-        if cond.valid is not None:
-            take_then = take_then & cond.valid  # null condition -> else
-        values = jnp.where(take_then, then.values, els.values)
-        if then.valid is None and els.valid is None:
-            valid = None
-        else:
-            tv = then.valid if then.valid is not None else jnp.ones((), jnp.bool_)
-            ev = els.valid if els.valid is not None else jnp.ones((), jnp.bool_)
-            valid = jnp.where(take_then, tv, ev)
-        dictionary = then.dictionary if then.dictionary is not None else els.dictionary
-        if (then.dictionary is not None and els.dictionary is not None
-                and then.dictionary is not els.dictionary):
-            raise NotImplementedError("IF over distinct dictionaries")
-        return Column(values, valid, expr.type, dictionary)
+        return _if_merge(_eval(expr.args[0], page),
+                         _eval(expr.args[1], page),
+                         _eval(expr.args[2], page), expr.type)
     if kind is SpecialKind.SWITCH:
-        # [c1, v1, c2, v2, ..., default] — fold right into nested IFs
+        # [c1, v1, c2, v2, ..., default] — fold right into nested IFs so CASE
+        # shares IF's null/dictionary semantics exactly
         args = list(expr.args)
         out = _eval(args[-1], page)
         pairs = list(zip(args[:-1:2], args[1:-1:2]))
         for cond_e, val_e in reversed(pairs):
-            cond = _eval(cond_e, page)
-            val = _eval(val_e, page)
-            if (val.dictionary is not None and out.dictionary is not None
-                    and val.dictionary is not out.dictionary):
-                raise NotImplementedError("CASE over distinct dictionaries")
-            dictionary = (val.dictionary if val.dictionary is not None
-                          else out.dictionary)
-            take = cond.values
-            if cond.valid is not None:
-                take = take & cond.valid
-            values = jnp.where(take, val.values, out.values)
-            tv = val.valid if val.valid is not None else jnp.ones((), jnp.bool_)
-            ov = out.valid if out.valid is not None else jnp.ones((), jnp.bool_)
-            valid = None
-            if val.valid is not None or out.valid is not None:
-                valid = jnp.where(take, tv, ov)
-            out = Column(values, valid, expr.type, dictionary)
+            out = _if_merge(_eval(cond_e, page), _eval(val_e, page), out,
+                            expr.type)
         return out
     if kind is SpecialKind.IN:
         needle = expr.args[0]
@@ -310,6 +281,26 @@ def _eval_special(expr: SpecialForm, page: Page) -> Column:
         valid = jnp.broadcast_to(base_valid & ~equal, jnp.shape(equal))
         return Column(a.values, valid, expr.type, a.dictionary)
     raise TypeError(f"unknown special form: {kind}")
+
+
+def _if_merge(cond: Column, then: Column, els: Column, out_type) -> Column:
+    """IF(cond, then, els) null semantics: null condition selects else."""
+    take_then = cond.values
+    if cond.valid is not None:
+        take_then = take_then & cond.valid
+    values = jnp.where(take_then, then.values, els.values)
+    if then.valid is None and els.valid is None:
+        valid = None
+    else:
+        tv = then.valid if then.valid is not None else jnp.ones((), jnp.bool_)
+        ev = els.valid if els.valid is not None else jnp.ones((), jnp.bool_)
+        valid = jnp.where(take_then, tv, ev)
+    if (then.dictionary is not None and els.dictionary is not None
+            and then.dictionary is not els.dictionary):
+        raise NotImplementedError("IF/CASE over distinct dictionaries")
+    dictionary = then.dictionary if then.dictionary is not None \
+        else els.dictionary
+    return Column(values, valid, out_type, dictionary)
 
 
 def _kleene_and(args, out_type) -> Column:

@@ -209,7 +209,14 @@ def _round_digits(out_type, arg_types, a, d):
     if _is_decimal(arg_types[0]):
         raise NotImplementedError("round(decimal, d)")
     if jnp.issubdtype(jnp.result_type(a), jnp.integer):
-        return a
+        if d >= 0:
+            return a
+        # Trino round(123, -1) = 120, half away from zero in integer space;
+        # divide magnitudes so // (floor) acts as truncation toward zero
+        p = 10 ** (-d)
+        half = p // 2
+        mag = (jnp.abs(a) + half) // p * p
+        return jnp.where(a >= 0, mag, -mag).astype(a.dtype)
     f = 10.0 ** d
     scaled = a * f
     return jnp.where(scaled >= 0, jnp.floor(scaled + 0.5),
@@ -410,16 +417,23 @@ def _cast(out_type, arg_types, a):
 # ---------------------------------------------------------------------------
 # dictionary-backed string ops: host computes a per-pool table, device gathers.
 
-_DICT_TABLE_CACHE: Dict[Tuple[int, object], jnp.ndarray] = {}
+def _dict_cache(d: Dictionary) -> Dict:
+    """Per-Dictionary memo table, living/dying with the pool object (so a
+    long-running server that churns dictionaries never leaks device arrays)."""
+    cache = getattr(d, "_table_cache", None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(d, "_table_cache", cache)
+    return cache
 
 
 def dictionary_table(d: Dictionary, key, fn) -> jnp.ndarray:
     """Memoized host map over the string pool -> device array (index by code)."""
-    ck = (d.id, key)
-    if ck not in _DICT_TABLE_CACHE:
+    cache = _dict_cache(d)
+    if key not in cache:
         table = np.asarray([fn(s) for s in d.values])
-        _DICT_TABLE_CACHE[ck] = jnp.asarray(table)
-    return _DICT_TABLE_CACHE[ck]
+        cache[key] = jnp.asarray(table)
+    return cache[key]
 
 
 def like_pattern_to_regex(pattern: str, escape: Optional[str] = None) -> str:
@@ -453,10 +467,11 @@ def transform_dictionary(d: Dictionary, key, fn) -> Tuple[Dictionary, jnp.ndarra
 
     Device: new_codes = take(remap, codes). Memoized per (dictionary, op).
     """
-    ck = (d.id, key, "xform")
-    if ck not in _DICT_TABLE_CACHE:
+    cache = _dict_cache(d)
+    ck = (key, "xform")
+    if ck not in cache:
         transformed = np.asarray([fn(s) for s in d.values], dtype=object)
         new_vals, remap = np.unique(transformed, return_inverse=True)
         nd = Dictionary(new_vals)
-        _DICT_TABLE_CACHE[ck] = (nd, jnp.asarray(remap.astype(np.int32)))
-    return _DICT_TABLE_CACHE[ck]
+        cache[ck] = (nd, jnp.asarray(remap.astype(np.int32)))
+    return cache[ck]

@@ -89,13 +89,7 @@ def _count_final(state, _):
 
 def _minmax_state(in_type, is_min):
     dt = in_type.dtype
-    if jnp.issubdtype(jnp.dtype(dt), jnp.floating):
-        ident = jnp.inf if is_min else -jnp.inf
-    elif jnp.dtype(dt) == jnp.bool_:
-        ident = True if is_min else False
-    else:
-        info = jnp.iinfo(dt)
-        ident = info.max if is_min else info.min
+    ident = _ident_for(jnp.dtype(dt), is_min)
     red = "min" if is_min else "max"
     return (
         StateColumn(in_type, lambda v, m: jnp.where(m, v, ident).astype(dt), red),
@@ -147,7 +141,13 @@ def get_aggregate(name: str, in_type: Optional[T.Type]) -> AggregateFunction:
             out = T.REAL
         return AggregateFunction("sum", _sum_state, _sum_final, lambda t: out)
     if n == "avg":
-        out = in_type if isinstance(in_type, T.DecimalType) else T.DOUBLE
+        # Trino: avg(real) -> real, avg(decimal) keeps type/scale, else double
+        if isinstance(in_type, T.DecimalType):
+            out = in_type
+        elif isinstance(in_type, T.RealType):
+            out = T.REAL
+        else:
+            out = T.DOUBLE
         return AggregateFunction("avg", _avg_state, _avg_final_factory(in_type),
                                  lambda t: out)
     if n == "min":
@@ -208,6 +208,12 @@ def hash_aggregate(
     Capacity: output keeps input capacity (#groups <= #rows).
     """
     key_channels = tuple(key_channels)
+    for a in aggs:
+        if a.distinct:
+            # DISTINCT aggregation is planned as mark-distinct + filtered agg
+            # (Trino: MarkDistinctOperator); until that rewrite exists, refuse
+            # rather than silently computing the non-distinct result.
+            raise NotImplementedError(f"{a.name}(DISTINCT ...)")
     resolved = [get_aggregate(a.name, a.input_type) for a in aggs]
 
     def op(page: Page) -> Page:

@@ -14,9 +14,10 @@ returns the true match total so the executor can detect overflow and re-run
 at a larger capacity bucket (SURVEY §7 hard part 1).
 
 Composite keys collapse to one u64 via a mixing hash; INNER joins verify the
-real key columns post-expansion so collisions are filtered exactly. (LEFT
-composite joins currently trust the 64-bit hash — collision-verification with
-null-row re-extension is a planned refinement.) SQL semantics: NULL join keys
+real key columns post-expansion so collisions are filtered exactly, and
+SEMI/ANTI joins re-check candidates and scatter the verdict back per probe
+row. (LEFT composite joins currently trust the 64-bit hash — collision-
+verification with null-row re-extension is a planned refinement.) SQL semantics: NULL join keys
 never match (including NULL = NULL); LEFT rows without matches emit once with
 build side NULL.
 """
@@ -130,11 +131,13 @@ def hash_join(
         hi = jnp.minimum(hi, n_live_build)
         counts = jnp.where(p_dead, 0, hi - lo).astype(jnp.int64)
 
-        if join_type == JoinType.SEMI:
-            out = probe.filter((counts > 0) & ~p_dead)
-            return out, out.num_rows.astype(jnp.int64)
-        if join_type == JoinType.ANTI:
-            out = probe.filter((counts == 0) & ~p_dead & probe.row_mask())
+        if join_type in (JoinType.SEMI, JoinType.ANTI) and not (
+                composite and verify_composite):
+            # single-column keys: to_u64 is injective, hash match == key match
+            if join_type == JoinType.SEMI:
+                out = probe.filter((counts > 0) & ~p_dead)
+            else:
+                out = probe.filter((counts == 0) & ~p_dead & probe.row_mask())
             return out, out.num_rows.astype(jnp.int64)
 
         emit = counts
@@ -157,6 +160,25 @@ def hash_join(
                         mode="clip").astype(jnp.int32)
         slot_live = out_idx < jnp.minimum(total, cap)
         matched = jnp.take(counts, prow_c, mode="clip") > 0
+
+        if join_type in (JoinType.SEMI, JoinType.ANTI):
+            # composite keys: re-check real key equality on each expanded
+            # candidate, then scatter-or back to probe rows. Exact whenever the
+            # hash-expansion fits in cap (else total > cap -> executor re-runs
+            # at a bigger bucket, same contract as INNER).
+            keep = slot_live & matched
+            for pk, bk in zip(probe_keys, build_keys):
+                pv = jnp.take(probe.column(pk).values, prow_c, mode="clip")
+                bv = jnp.take(build.column(bk).values, brow, mode="clip")
+                keep = keep & (pv == bv)
+            verified = jnp.zeros(n_probe, dtype=jnp.bool_).at[prow_c].max(
+                keep, mode="drop")
+            if join_type == JoinType.SEMI:
+                out = probe.filter(verified & ~p_dead)
+            else:
+                out = probe.filter(~verified & ~p_dead & probe.row_mask())
+            rows = out.num_rows.astype(jnp.int64)
+            return out, jnp.where(total <= cap, rows, total)
 
         pcols = tuple(c.gather(prow_c) for c in probe.columns)
         bcols = []

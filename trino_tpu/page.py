@@ -41,7 +41,7 @@ class Dictionary:
     reserved for padding. Identity-hashed so it can be jit-static aux data.
     """
 
-    __slots__ = ("values", "id")
+    __slots__ = ("values", "id", "_table_cache")
 
     def __init__(self, values: np.ndarray):
         values = np.asarray(values, dtype=object)
@@ -71,6 +71,15 @@ class Dictionary:
 
     def upper_bound(self, s: str) -> int:
         return int(np.searchsorted(self.values, s, side="right"))
+
+    def encode(self, strings: np.ndarray) -> np.ndarray:
+        """Map strings -> int32 codes; raises if any value is absent."""
+        arr = np.asarray(strings, dtype=object)
+        codes = np.searchsorted(self.values, arr).astype(np.int32)
+        codes = np.minimum(codes, len(self.values) - 1)
+        if not np.array_equal(self.values[codes], arr):
+            raise KeyError("value(s) not present in dictionary")
+        return codes
 
     def decode(self, codes: np.ndarray) -> np.ndarray:
         out = np.empty(len(codes), dtype=object)
