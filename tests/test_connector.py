@@ -1,0 +1,167 @@
+"""Connector SPI + tpch/memory/blackhole connector tests.
+
+Mirrors plugin/trino-tpch/src/test/ TestTpchMetadata and the BaseConnectorTest
+capability pattern (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.connector import (CatalogManager, ColumnMetadata,
+                                 SchemaTableName, TableMetadata)
+from trino_tpu.connector import blackhole, memory, tpch
+from trino_tpu.page import Column, Page
+
+
+@pytest.fixture(scope="module")
+def tpch_conn():
+    return tpch.create_connector()
+
+
+def test_tpch_metadata(tpch_conn):
+    md = tpch_conn.metadata
+    assert "tiny" in md.list_schemas()
+    tables = md.list_tables("tiny")
+    assert SchemaTableName("tiny", "lineitem") in tables
+    assert len(tables) == 8
+
+    h = md.get_table_handle(SchemaTableName("tiny", "orders"))
+    assert h is not None
+    meta = md.get_table_metadata(h)
+    names = [c.name for c in meta.columns]
+    assert names[:3] == ["o_orderkey", "o_custkey", "o_orderstatus"]
+    assert md.get_table_handle(SchemaTableName("tiny", "nope")) is None
+
+    stats = md.get_table_statistics(h)
+    assert stats.row_count == 15_000  # tiny = sf0.01
+
+
+def test_tpch_scan_roundtrip(tpch_conn):
+    md = tpch_conn.metadata
+    h = md.get_table_handle(SchemaTableName("tiny", "nation"))
+    cols = md.get_column_handles(h)
+    splits = tpch_conn.split_manager.get_splits(h)
+    assert len(splits) == 1
+    pages = list(tpch_conn.page_source.pages(splits[0], cols, 64))
+    assert len(pages) == 1
+    page = pages[0]
+    assert int(page.num_rows) == 25
+    keys = page.column(0).to_numpy(25)
+    assert list(keys) == list(range(25))
+    names = page.column(1).to_numpy(25)
+    assert "FRANCE" in names and "GERMANY" in names
+
+
+def test_tpch_lineitem_pages_and_splits(tpch_conn):
+    md = tpch_conn.metadata
+    h = md.get_table_handle(SchemaTableName("tiny", "lineitem"))
+    cols = md.get_column_handles(h)
+    splits = tpch_conn.split_manager.get_splits(h, target_splits=4)
+    assert len(splits) == 4
+    total = 0
+    seen_flags = set()
+    for s in splits:
+        for page in tpch_conn.page_source.pages(s, cols, 8192):
+            n = int(page.num_rows)
+            assert n <= 8192
+            total += n
+            flag_col = page.column(8)
+            seen_flags.update(flag_col.to_numpy(n))
+    assert total == tpch.table_row_count("lineitem", 0.01)
+    assert seen_flags == {"R", "A", "N"}
+
+
+def test_tpch_referential_integrity(tpch_conn):
+    li = tpch.get_table("lineitem", 0.01)
+    orders = tpch.get_table("orders", 0.01)
+    assert set(np.unique(li["l_orderkey"])) <= set(orders["o_orderkey"])
+    cust = tpch.get_table("customer", 0.01)
+    assert orders["o_custkey"].max() <= cust["c_custkey"].max()
+    # dates: ship after order
+    odate_by_key = dict(zip(orders["o_orderkey"], orders["o_orderdate"]))
+    sample = np.random.default_rng(0).integers(0, len(li["l_orderkey"]), 100)
+    for i in sample:
+        assert li["l_shipdate"][i] > odate_by_key[li["l_orderkey"][i]]
+
+
+def test_tpch_pushdown(tpch_conn):
+    md = tpch_conn.metadata
+    h = md.get_table_handle(SchemaTableName("tiny", "orders"))
+    h2 = md.apply_limit(h, 10)
+    assert h2.limit == 10
+    cols = md.get_column_handles(h2)
+    splits = tpch_conn.split_manager.get_splits(h2, target_splits=1)
+    pages = list(tpch_conn.page_source.pages(splits[0], cols, 4096))
+    assert int(pages[0].num_rows) == 10
+
+
+def test_memory_connector_write_read():
+    conn = memory.create_connector()
+    name = SchemaTableName("default", "t1")
+    meta = TableMetadata(name, (
+        ColumnMetadata("a", T.BIGINT), ColumnMetadata("s", T.VarcharType(10))))
+    conn.metadata.create_table(meta)
+    h = conn.metadata.get_table_handle(name)
+
+    page = Page((
+        Column.from_numpy(np.array([1, 2, 3], dtype=np.int64), T.BIGINT),
+        Column.from_numpy(np.array(["x", "y", "x"], dtype=object),
+                          T.VarcharType(10)),
+    ), 3)
+    sink = conn.page_sink(h)
+    sink.append_page(page)
+    sink.finish()
+
+    cols = conn.metadata.get_column_handles(h)
+    splits = conn.split_manager.get_splits(h)
+    pages = list(conn.page_source.pages(splits[0], cols, 16))
+    out = pages[0]
+    assert int(out.num_rows) == 3
+    assert list(out.column(0).to_numpy(3)) == [1, 2, 3]
+    assert list(out.column(1).to_numpy(3)) == ["x", "y", "x"]
+
+    conn.metadata.drop_table(h)
+    assert conn.metadata.get_table_handle(name) is None
+
+
+def test_memory_connector_nulls():
+    conn = memory.create_connector()
+    name = SchemaTableName("default", "t2")
+    conn.metadata.create_table(TableMetadata(
+        name, (ColumnMetadata("a", T.BIGINT),)))
+    h = conn.metadata.get_table_handle(name)
+    page = Page((
+        Column.from_numpy(np.array([7, 0], dtype=np.int64), T.BIGINT,
+                          valid=np.array([True, False])),
+    ), 2)
+    conn.page_sink(h).append_page(page)
+    pages = list(conn.page_source.pages(
+        h and conn.split_manager.get_splits(h)[0],
+        conn.metadata.get_column_handles(h), 8))
+    vals = pages[0].column(0).to_numpy(2)
+    assert vals[0] == 7 and vals[1] is None
+
+
+def test_blackhole():
+    conn = blackhole.create_connector()
+    name = SchemaTableName("default", "sink")
+    conn.metadata.create_table(TableMetadata(
+        name, (ColumnMetadata("x", T.BIGINT),)))
+    h = conn.metadata.get_table_handle(name)
+    page = Page((Column.from_numpy(np.arange(5, dtype=np.int64), T.BIGINT),), 5)
+    conn.page_sink(h).append_page(page)
+    assert conn._metadata.rows_written == 5
+    assert list(conn.page_source.pages(
+        conn.split_manager.get_splits(h)[0],
+        conn.metadata.get_column_handles(h), 8)) == []
+
+
+def test_catalog_manager():
+    cm = CatalogManager()
+    cm.register("tpch", tpch.create_connector())
+    cm.register("memory", memory.create_connector())
+    assert cm.catalogs() == ["memory", "tpch"]
+    assert cm.get("tpch").name == "tpch"
+    with pytest.raises(KeyError):
+        cm.get("nope")

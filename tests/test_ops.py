@@ -142,6 +142,32 @@ def test_semi_and_anti_join():
     assert [r[0] for r in out.to_pylist()] == [1, 3]
 
 
+def test_composite_semi_anti_join():
+    # exercises the verified expansion path (scatter-back per probe row)
+    probe = page_of(([1, 1, 2, 3], T.BIGINT), ([10, 20, 10, 30], T.BIGINT))
+    build = page_of(([1, 2, 2], T.BIGINT), ([10, 10, 10], T.BIGINT))
+    semi = hash_join([0, 1], [0, 1], JoinType.SEMI)
+    out, total = jax.jit(semi)(probe, build)
+    assert sorted(r[:2] for r in out.to_pylist()) == [(1, 10), (2, 10)]
+    assert int(total) == 2
+    anti = hash_join([0, 1], [0, 1], JoinType.ANTI)
+    out, total = jax.jit(anti)(probe, build)
+    assert sorted(r[:2] for r in out.to_pylist()) == [(1, 20), (3, 30)]
+    assert int(total) == 2
+
+
+def test_composite_semi_overflow_contract():
+    # cap too small for the hash expansion -> total > cap signals re-run
+    probe = page_of(([1, 1, 1], T.BIGINT), ([5, 5, 5], T.BIGINT))
+    build = page_of(([1] * 8, T.BIGINT), ([5] * 8, T.BIGINT))
+    semi = hash_join([0, 1], [0, 1], JoinType.SEMI, output_capacity=4)
+    out, total = jax.jit(semi)(probe, build)
+    assert int(total) > 4  # 24 hash matches exceed cap; executor must re-run
+    big = hash_join([0, 1], [0, 1], JoinType.SEMI, output_capacity=32)
+    out, total = jax.jit(big)(probe, build)
+    assert int(total) == 3 and int(out.num_rows) == 3
+
+
 def test_composite_key_join():
     probe = page_of(([1, 1, 2], T.BIGINT), ([10, 20, 10], T.BIGINT))
     build = page_of(([1, 2], T.BIGINT), ([10, 10], T.BIGINT), ([111, 222], T.BIGINT))

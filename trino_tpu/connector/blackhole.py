@@ -1,0 +1,82 @@
+"""Blackhole connector: swallow writes, serve empty scans.
+
+Reference parity: plugin/trino-blackhole — benchmarking sink (writes are
+counted and dropped).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from trino_tpu.connector.spi import (
+    ColumnHandle, Connector, ConnectorMetadata, ConnectorPageSink,
+    ConnectorPageSource, ConnectorSplitManager, ConnectorTableHandle,
+    SchemaTableName, Split, TableMetadata)
+from trino_tpu.page import Page
+
+
+class BlackHoleMetadata(ConnectorMetadata):
+    def __init__(self):
+        self._tables: Dict[SchemaTableName, TableMetadata] = {}
+        self.rows_written = 0
+        self._lock = threading.Lock()
+
+    def list_schemas(self) -> List[str]:
+        return ["default"]
+
+    def list_tables(self, schema: Optional[str] = None) -> List[SchemaTableName]:
+        return sorted(self._tables, key=lambda n: (n.schema, n.table))
+
+    def get_table_handle(self, name: SchemaTableName):
+        return ConnectorTableHandle(name) if name in self._tables else None
+
+    def get_table_metadata(self, handle: ConnectorTableHandle) -> TableMetadata:
+        return self._tables[handle.name]
+
+    def create_table(self, metadata: TableMetadata,
+                     ignore_existing: bool = False):
+        if metadata.name in self._tables and not ignore_existing:
+            raise ValueError(f"table already exists: {metadata.name}")
+        self._tables[metadata.name] = metadata
+
+    def drop_table(self, handle: ConnectorTableHandle):
+        self._tables.pop(handle.name, None)
+
+    def count(self, n: int):
+        with self._lock:
+            self.rows_written += n
+
+
+class BlackHoleSplitManager(ConnectorSplitManager):
+    def get_splits(self, handle, target_splits: int = 1) -> List[Split]:
+        return [Split(handle, 0, 1)]
+
+
+class BlackHolePageSource(ConnectorPageSource):
+    def pages(self, split: Split, columns: Sequence[ColumnHandle],
+              page_capacity: int) -> Iterator[Page]:
+        return iter(())
+
+
+class BlackHolePageSink(ConnectorPageSink):
+    def __init__(self, metadata: BlackHoleMetadata):
+        self._metadata = metadata
+
+    def append_page(self, page: Page):
+        self._metadata.count(int(page.num_rows))
+
+
+class BlackHoleConnector(Connector):
+    def __init__(self):
+        metadata = BlackHoleMetadata()
+        super().__init__("blackhole", metadata, BlackHoleSplitManager(),
+                         BlackHolePageSource())
+        self._metadata = metadata
+
+    def page_sink(self, handle: ConnectorTableHandle) -> ConnectorPageSink:
+        return BlackHolePageSink(self._metadata)
+
+
+def create_connector() -> Connector:
+    return BlackHoleConnector()

@@ -1,0 +1,198 @@
+"""Memory connector: writable in-RAM tables.
+
+Reference parity: plugin/trino-memory (MemoryMetadata.java, MemoryPagesStore
+.java, MemoryPageSinkProvider) — CREATE TABLE / INSERT / CTAS targets and the
+engine-test workhorse. Tables live as host numpy column arrays; page sources
+re-page them at scan capacity.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.connector.spi import (
+    ColumnHandle, ColumnMetadata, Connector, ConnectorMetadata,
+    ConnectorPageSink, ConnectorPageSource, ConnectorSplitManager,
+    ConnectorTableHandle, SchemaTableName, Split, TableMetadata,
+    TableStatistics, ColumnStatistics, split_range)
+from trino_tpu.page import Column, Dictionary, Page
+
+
+class _StoredTable:
+    def __init__(self, metadata: TableMetadata):
+        self.metadata = metadata
+        self.arrays: List[np.ndarray] = [
+            np.empty(0, dtype=object if T.is_string(c.type)
+                     else T.to_numpy_dtype(c.type))
+            for c in metadata.columns]
+        self.valids: List[Optional[np.ndarray]] = [
+            None for _ in metadata.columns]
+        self.dictionaries: List[Optional[Dictionary]] = [
+            None for _ in metadata.columns]
+
+    @property
+    def row_count(self) -> int:
+        return len(self.arrays[0]) if self.arrays else 0
+
+
+class MemoryMetadata(ConnectorMetadata):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._schemas = {"default"}
+        self._tables: Dict[SchemaTableName, _StoredTable] = {}
+
+    def list_schemas(self) -> List[str]:
+        return sorted(self._schemas)
+
+    def create_schema(self, name: str):
+        self._schemas.add(name)
+
+    def drop_schema(self, name: str):
+        self._schemas.discard(name)
+
+    def list_tables(self, schema: Optional[str] = None) -> List[SchemaTableName]:
+        return sorted((n for n in self._tables
+                       if schema is None or n.schema == schema),
+                      key=lambda n: (n.schema, n.table))
+
+    def get_table_handle(self, name: SchemaTableName):
+        if name in self._tables:
+            return ConnectorTableHandle(name)
+        return None
+
+    def get_table_metadata(self, handle: ConnectorTableHandle) -> TableMetadata:
+        return self._tables[handle.name].metadata
+
+    def get_table_statistics(self, handle: ConnectorTableHandle) -> TableStatistics:
+        t = self._tables[handle.name]
+        return TableStatistics(float(t.row_count), {
+            c.name: ColumnStatistics() for c in t.metadata.columns})
+
+    def create_table(self, metadata: TableMetadata,
+                     ignore_existing: bool = False):
+        with self._lock:
+            if metadata.name in self._tables:
+                if ignore_existing:
+                    return
+                raise ValueError(f"table already exists: {metadata.name}")
+            self._schemas.add(metadata.name.schema)
+            self._tables[metadata.name] = _StoredTable(metadata)
+
+    def drop_table(self, handle: ConnectorTableHandle):
+        with self._lock:
+            self._tables.pop(handle.name, None)
+
+    def stored(self, name: SchemaTableName) -> _StoredTable:
+        return self._tables[name]
+
+
+class MemorySplitManager(ConnectorSplitManager):
+    def __init__(self, metadata: MemoryMetadata):
+        self._metadata = metadata
+
+    def get_splits(self, handle: ConnectorTableHandle,
+                   target_splits: int = 1) -> List[Split]:
+        rows = self._metadata.stored(handle.name).row_count
+        parts = max(1, min(target_splits, math.ceil(max(rows, 1) / 4096)))
+        return [Split(handle, p, parts) for p in range(parts)]
+
+
+class MemoryPageSource(ConnectorPageSource):
+    def __init__(self, metadata: MemoryMetadata):
+        self._metadata = metadata
+
+    def pages(self, split: Split, columns: Sequence[ColumnHandle],
+              page_capacity: int) -> Iterator[Page]:
+        stored = self._metadata.stored(split.table.name)
+        total = stored.row_count
+        start, end = split_range(total, split.part, split.total_parts)
+        off = start
+        while True:
+            hi = min(off + page_capacity, end)
+            n = max(hi - off, 0)
+            cols = []
+            for ch in columns:
+                i = ch.ordinal
+                raw = stored.arrays[i][off:hi]
+                valid = None
+                if stored.valids[i] is not None:
+                    valid = _pad(stored.valids[i][off:hi].astype(bool),
+                                 page_capacity, False)
+                if T.is_string(ch.type):
+                    d = stored.dictionaries[i]
+                    if d is None:
+                        d, _ = Dictionary.build(
+                            np.asarray(stored.arrays[i], dtype=object))
+                        stored.dictionaries[i] = d
+                    fill = np.where(raw == None, d.values[0] if len(d) else "",  # noqa: E711
+                                    raw)
+                    codes = _pad(d.encode(fill), page_capacity, 0)
+                    cols.append(Column.from_numpy(codes, ch.type, valid, d))
+                else:
+                    arr = _pad(np.asarray(raw, T.to_numpy_dtype(ch.type)),
+                               page_capacity, 0)
+                    cols.append(Column.from_numpy(arr, ch.type, valid))
+            yield Page(tuple(cols), n)
+            off = hi
+            if off >= end:
+                break
+
+
+def _pad(arr: np.ndarray, capacity: int, fill) -> np.ndarray:
+    if len(arr) >= capacity:
+        return arr[:capacity]
+    out = np.full(capacity, fill, dtype=arr.dtype)
+    out[:len(arr)] = arr
+    return out
+
+
+class MemoryPageSink(ConnectorPageSink):
+    def __init__(self, stored: _StoredTable, lock: threading.Lock):
+        self._stored = stored
+        self._lock = lock
+
+    def append_page(self, page: Page):
+        stored = self._stored
+        n = int(page.num_rows)
+        with self._lock:
+            for i, col in enumerate(page.columns):
+                vals = col.to_numpy(n)  # decoded objects incl. None
+                typ = stored.metadata.columns[i].type
+                nulls = np.array([v is None for v in vals], dtype=bool)
+                if T.is_string(typ):
+                    filled = np.asarray(
+                        ["" if v is None else v for v in vals], dtype=object)
+                    stored.dictionaries[i] = None  # pool changes; rebuild lazily
+                else:
+                    filled = np.asarray(
+                        [0 if v is None else v for v in vals],
+                        dtype=T.to_numpy_dtype(typ))
+                stored.arrays[i] = np.concatenate(
+                    [stored.arrays[i], filled])
+                if nulls.any() or stored.valids[i] is not None:
+                    old_valid = stored.valids[i]
+                    if old_valid is None:
+                        old_valid = np.ones(
+                            len(stored.arrays[i]) - len(filled), dtype=bool)
+                    stored.valids[i] = np.concatenate([old_valid, ~nulls])
+
+
+class MemoryConnector(Connector):
+    def __init__(self):
+        metadata = MemoryMetadata()
+        super().__init__("memory", metadata, MemorySplitManager(metadata),
+                         MemoryPageSource(metadata))
+        self._metadata = metadata
+
+    def page_sink(self, handle: ConnectorTableHandle) -> ConnectorPageSink:
+        return MemoryPageSink(self._metadata.stored(handle.name),
+                              self._metadata._lock)
+
+
+def create_connector() -> Connector:
+    return MemoryConnector()

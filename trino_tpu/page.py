@@ -73,8 +73,12 @@ class Dictionary:
         return int(np.searchsorted(self.values, s, side="right"))
 
     def encode(self, strings: np.ndarray) -> np.ndarray:
-        """Map strings -> int32 codes; raises if any value is absent."""
+        """Map strings -> int32 codes; raises KeyError if any value is absent."""
         arr = np.asarray(strings, dtype=object)
+        if len(self.values) == 0:
+            if len(arr) == 0:
+                return np.empty(0, dtype=np.int32)
+            raise KeyError("value(s) not present in dictionary")
         codes = np.searchsorted(self.values, arr).astype(np.int32)
         codes = np.minimum(codes, len(self.values) - 1)
         if not np.array_equal(self.values[codes], arr):

@@ -72,10 +72,7 @@ def tokenize(sql: str) -> List[Token]:
         text = m.group()
         col = m.start() - line_start
         if kind in ("ws", "line_comment", "block_comment"):
-            nl = text.count("\n")
-            if nl:
-                line += nl
-                line_start = m.start() + text.rindex("\n") + 1
+            pass  # line tracking below
         elif kind == "ident":
             tk = "KEYWORD" if text.upper() in RESERVED else "IDENT"
             tokens.append(Token(tk, text, line, col))
@@ -95,6 +92,12 @@ def tokenize(sql: str) -> List[Token]:
                 param_index += 1
             else:
                 tokens.append(Token("OP", text, line, col))
+        # advance line tracking for ANY token containing newlines (multi-line
+        # strings/comments/quoted identifiers included)
+        nl = text.count("\n")
+        if nl:
+            line += nl
+            line_start = m.start() + text.rindex("\n") + 1
         pos = m.end()
     tokens.append(Token("EOF", "", line, n - line_start))
     return tokens

@@ -502,8 +502,6 @@ class _Parser:
                     self.next()
                     self.next()
                     return t.AllColumns(name)
-                if self.accept_op(".") and self.accept_op("*"):
-                    return t.AllColumns(name)
             except ParsingError:
                 pass
             self.pos = save
@@ -990,7 +988,9 @@ class _Parser:
             self.expect_op(")")
             if len(args) == 2:
                 return t.IfExpression(args[0], args[1])
-            return t.IfExpression(args[0], args[1], args[2])
+            if len(args) == 3:
+                return t.IfExpression(args[0], args[1], args[2])
+            self.error(f"if() takes 2 or 3 arguments, got {len(args)}")
         distinct = False
         args: Tuple[t.Expression, ...] = ()
         if self.at_op("*"):

@@ -827,12 +827,18 @@ class StartTransaction(Statement):
     pass
 
 
+def _iter_nodes(value):
+    if isinstance(value, Node):
+        yield value
+    elif isinstance(value, tuple):
+        # handles nested tuples: GroupingSets.sets, CreateTable properties
+        for item in value:
+            yield from _iter_nodes(item)
+
+
 def walk(node: Node):
     """Pre-order traversal over every Node reachable from `node`."""
     yield node
     for f in dataclasses.fields(node):
-        v = getattr(node, f.name)
-        items = v if isinstance(v, tuple) else (v,)
-        for item in items:
-            if isinstance(item, Node):
-                yield from walk(item)
+        for child in _iter_nodes(getattr(node, f.name)):
+            yield from walk(child)
