@@ -1,0 +1,191 @@
+"""Logical planner tests.
+
+Mirrors sql/planner/TestLogicalPlanner.java + BasePlanTest plan-shape
+assertions: plan SQL, assert on node structure.
+"""
+
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.connector import tpch, memory
+from trino_tpu.connector.spi import CatalogManager
+from trino_tpu.metadata import Metadata, Session
+from trino_tpu.planner import LogicalPlanner
+from trino_tpu.planner.nodes import (
+    AggregationNode, FilterNode, GroupIdNode, JoinNode, JoinKind, LimitNode,
+    OutputNode, ProjectNode, SemiJoinNode, SortNode, TableScanNode, UnionNode,
+    ValuesNode, visit_plan, format_plan, EnforceSingleRowNode)
+from trino_tpu.sql import parse_statement
+from trino_tpu.sql.analyzer import SemanticError
+
+from test_parser import TPCH
+
+
+@pytest.fixture(scope="module")
+def metadata():
+    cm = CatalogManager()
+    cm.register("tpch", tpch.create_connector())
+    cm.register("memory", memory.create_connector())
+    return Metadata(cm)
+
+
+def plan(metadata, sql):
+    return LogicalPlanner(metadata, Session()).plan(parse_statement(sql))
+
+
+def nodes_of(p, cls):
+    return [n for n in visit_plan(p) if isinstance(n, cls)]
+
+
+def test_scan_filter_project(metadata):
+    p = plan(metadata, "SELECT n_name FROM nation WHERE n_regionkey = 1")
+    assert isinstance(p, OutputNode)
+    assert p.column_names == ("n_name",)
+    scans = nodes_of(p, TableScanNode)
+    assert len(scans) == 1
+    assert str(scans[0].table.name) == "tiny.nation"
+    assert len(nodes_of(p, FilterNode)) == 1
+
+
+def test_aggregation_plan_shape(metadata):
+    p = plan(metadata,
+             "SELECT l_returnflag, sum(l_quantity) FROM lineitem "
+             "GROUP BY l_returnflag")
+    aggs = nodes_of(p, AggregationNode)
+    assert len(aggs) == 1
+    agg = aggs[0]
+    assert len(agg.group_by) == 1
+    assert agg.aggregations[0][1].name == "sum"
+    # agg output name defaults to _colN when unaliased
+    assert p.column_names[0] == "l_returnflag"
+
+
+def test_group_by_ordinal_and_alias(metadata):
+    p = plan(metadata,
+             "SELECT n_regionkey AS rk, count(*) c FROM nation GROUP BY 1 "
+             "ORDER BY c DESC")
+    agg = nodes_of(p, AggregationNode)[0]
+    assert len(agg.group_by) == 1
+    assert len(nodes_of(p, SortNode)) == 1
+
+
+def test_join_extraction(metadata):
+    p = plan(metadata,
+             "SELECT c_name, o_orderkey FROM customer JOIN orders "
+             "ON c_custkey = o_custkey AND o_totalprice > 100")
+    joins = nodes_of(p, JoinNode)
+    assert len(joins) == 1
+    j = joins[0]
+    assert j.kind == JoinKind.INNER
+    assert len(j.criteria) == 1
+    assert j.filter is not None  # non-equi residual
+
+
+def test_implicit_cross_join_with_where(metadata):
+    p = plan(metadata,
+             "SELECT c_name FROM customer, orders WHERE c_custkey = o_custkey")
+    joins = nodes_of(p, JoinNode)
+    assert len(joins) == 1
+    assert joins[0].kind == JoinKind.CROSS
+    # predicate stays in WHERE; optimizer will push it into join criteria
+    assert len(nodes_of(p, FilterNode)) == 1
+
+
+def test_in_subquery_plans_semijoin(metadata):
+    p = plan(metadata, TPCH[18])
+    semis = nodes_of(p, SemiJoinNode)
+    assert len(semis) == 1
+    assert len(semis[0].source_keys) == 1
+
+
+def test_correlated_exists_plans_semijoin(metadata):
+    p = plan(metadata, """
+        SELECT c_name FROM customer
+        WHERE EXISTS (SELECT 1 FROM orders WHERE o_custkey = c_custkey)""")
+    semis = nodes_of(p, SemiJoinNode)
+    assert len(semis) == 1
+
+
+def test_not_exists(metadata):
+    p = plan(metadata, TPCH[22])
+    semis = nodes_of(p, SemiJoinNode)
+    assert len(semis) == 1
+    # scalar subquery becomes enforce-single-row + cross join
+    assert len(nodes_of(p, EnforceSingleRowNode)) == 1
+
+
+def test_correlated_scalar_agg_decorrelates(metadata):
+    p = plan(metadata, """
+        SELECT p_partkey FROM part, partsupp
+        WHERE p_partkey = ps_partkey
+          AND ps_supplycost = (SELECT min(ps_supplycost) FROM partsupp
+                               WHERE ps_partkey = p_partkey)""")
+    # decorrelated: LEFT join against an aggregation grouped by the key
+    joins = nodes_of(p, JoinNode)
+    assert any(j.kind == JoinKind.LEFT for j in joins)
+    aggs = nodes_of(p, AggregationNode)
+    assert any(len(a.group_by) == 1 and a.aggregations for a in aggs)
+
+
+def test_values_and_union(metadata):
+    p = plan(metadata, "SELECT * FROM (VALUES (1, 'a'), (2, 'b')) t(x, y)")
+    vals = nodes_of(p, ValuesNode)
+    assert len(vals) == 1 and len(vals[0].rows) == 2
+
+    p = plan(metadata, "SELECT 1 AS x UNION ALL SELECT 2")
+    assert len(nodes_of(p, UnionNode)) == 1
+
+    p = plan(metadata, "SELECT 1 AS x UNION SELECT 2")
+    # distinct union adds an aggregation
+    assert len(nodes_of(p, AggregationNode)) == 1
+
+
+def test_rollup_plans_groupid(metadata):
+    p = plan(metadata,
+             "SELECT n_regionkey, count(*) FROM nation GROUP BY ROLLUP (n_regionkey)")
+    gids = nodes_of(p, GroupIdNode)
+    assert len(gids) == 1
+    assert len(gids[0].grouping_sets) == 2  # (n_regionkey), ()
+
+
+def test_limit_and_distinct(metadata):
+    p = plan(metadata, "SELECT DISTINCT n_regionkey FROM nation LIMIT 3")
+    assert len(nodes_of(p, LimitNode)) == 1
+    assert len(nodes_of(p, AggregationNode)) == 1
+
+
+def test_cte(metadata):
+    p = plan(metadata, """
+        WITH big AS (SELECT o_custkey FROM orders WHERE o_totalprice > 1000)
+        SELECT count(*) FROM big""")
+    assert len(nodes_of(p, TableScanNode)) == 1
+    assert len(nodes_of(p, AggregationNode)) == 1
+
+
+def test_semantic_errors(metadata):
+    with pytest.raises(SemanticError, match="cannot be resolved"):
+        plan(metadata, "SELECT nope FROM nation")
+    with pytest.raises(SemanticError, match="not found"):
+        plan(metadata, "SELECT * FROM nonexistent_table")
+    with pytest.raises(SemanticError, match="GROUP BY"):
+        plan(metadata, "SELECT n_name, count(*) FROM nation GROUP BY n_regionkey")
+    with pytest.raises(SemanticError, match="ambiguous"):
+        plan(metadata,
+             "SELECT n_nationkey FROM nation a, nation b")
+
+
+def test_coercions_in_comparison(metadata):
+    # l_quantity is decimal(12,2); literal 24 is integer -> coerced
+    p = plan(metadata, "SELECT 1 x FROM lineitem WHERE l_quantity < 24")
+    f = nodes_of(p, FilterNode)[0]
+    assert "lt(" in str(f.predicate)
+    # the literal must be scaled to match decimal(12,2): 24 -> 2400
+    assert "2400" in str(f.predicate)
+
+
+@pytest.mark.parametrize("qnum", [q for q in sorted(TPCH) if q != 21])
+def test_tpch_plans(metadata, qnum):
+    p = plan(metadata, TPCH[qnum])
+    assert isinstance(p, OutputNode)
+    text = format_plan(p)
+    assert "TableScan" in text

@@ -19,7 +19,7 @@ from trino_tpu.connector.spi import (
     ColumnHandle, ColumnMetadata, Connector, ConnectorMetadata,
     ConnectorPageSink, ConnectorPageSource, ConnectorSplitManager,
     ConnectorTableHandle, SchemaTableName, Split, TableMetadata,
-    TableStatistics, ColumnStatistics, split_range)
+    TableStatistics, ColumnStatistics, pad_to_capacity, split_range)
 from trino_tpu.page import Column, Dictionary, Page
 
 
@@ -121,7 +121,7 @@ class MemoryPageSource(ConnectorPageSource):
                 raw = stored.arrays[i][off:hi]
                 valid = None
                 if stored.valids[i] is not None:
-                    valid = _pad(stored.valids[i][off:hi].astype(bool),
+                    valid = pad_to_capacity(stored.valids[i][off:hi].astype(bool),
                                  page_capacity, False)
                 if T.is_string(ch.type):
                     d = stored.dictionaries[i]
@@ -131,24 +131,16 @@ class MemoryPageSource(ConnectorPageSource):
                         stored.dictionaries[i] = d
                     fill = np.where(raw == None, d.values[0] if len(d) else "",  # noqa: E711
                                     raw)
-                    codes = _pad(d.encode(fill), page_capacity, 0)
+                    codes = pad_to_capacity(d.encode(fill), page_capacity, 0)
                     cols.append(Column.from_numpy(codes, ch.type, valid, d))
                 else:
-                    arr = _pad(np.asarray(raw, T.to_numpy_dtype(ch.type)),
+                    arr = pad_to_capacity(np.asarray(raw, T.to_numpy_dtype(ch.type)),
                                page_capacity, 0)
                     cols.append(Column.from_numpy(arr, ch.type, valid))
             yield Page(tuple(cols), n)
             off = hi
             if off >= end:
                 break
-
-
-def _pad(arr: np.ndarray, capacity: int, fill) -> np.ndarray:
-    if len(arr) >= capacity:
-        return arr[:capacity]
-    out = np.full(capacity, fill, dtype=arr.dtype)
-    out[:len(arr)] = arr
-    return out
 
 
 class MemoryPageSink(ConnectorPageSink):

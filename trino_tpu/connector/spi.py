@@ -216,6 +216,17 @@ class CatalogManager:
         return sorted(self._catalogs)
 
 
+def pad_to_capacity(arr, capacity: int, fill):
+    """Pad/truncate a host array slice to the page capacity (padding rows sit
+    beyond num_rows and are never read)."""
+    import numpy as np
+    if len(arr) >= capacity:
+        return arr[:capacity]
+    out = np.full(capacity, fill, dtype=arr.dtype)
+    out[:len(arr)] = arr
+    return out
+
+
 def split_range(total_rows: int, part: int, total_parts: int) -> Tuple[int, int]:
     """Row range [start, end) of split `part` of `total_parts` over a table."""
     rows_per = math.ceil(total_rows / total_parts) if total_parts else total_rows

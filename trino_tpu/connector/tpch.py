@@ -15,6 +15,7 @@ prices are short decimals (scaled int64).
 from __future__ import annotations
 
 import math
+import zlib
 from typing import Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -24,7 +25,7 @@ from trino_tpu.connector.spi import (
     ColumnHandle, ColumnMetadata, Connector, ConnectorMetadata,
     ConnectorPageSource, ConnectorSplitManager, ConnectorTableHandle,
     ColumnStatistics, SchemaTableName, Split, TableMetadata, TableStatistics,
-    split_range)
+    pad_to_capacity, split_range)
 from trino_tpu.expr.functions import days_from_civil
 from trino_tpu.page import Column, Dictionary, Page
 
@@ -146,9 +147,15 @@ def _phone(rng_nation: np.ndarray, seq: np.ndarray) -> np.ndarray:
                      zip(country, p1, p2, p3)], dtype=object)
 
 
+def _table_seed(table: str, sf: float) -> int:
+    """Stable across processes (unlike hash(): PYTHONHASHSEED-randomized) so
+    every worker generating a split sees the same data."""
+    return zlib.crc32(f"{table}:{round(sf * 1000)}".encode())
+
+
 def _gen_table(table: str, sf: float) -> Dict[str, np.ndarray]:
     """Generate full host arrays for one table at one scale factor."""
-    rng = np.random.default_rng(hash((table, round(sf * 1000))) % (2 ** 31))
+    rng = np.random.default_rng(_table_seed(table, sf))
     if table == "region":
         n = 5
         return {
@@ -321,6 +328,7 @@ def _gen_table(table: str, sf: float) -> Dict[str, np.ndarray]:
 
 _TABLE_CACHE: Dict[tuple, Dict[str, np.ndarray]] = {}
 _DICT_CACHE: Dict[tuple, Dictionary] = {}
+_ROWCOUNT_CACHE: Dict[tuple, int] = {}
 
 
 def get_table(table: str, sf: float) -> Dict[str, np.ndarray]:
@@ -395,7 +403,14 @@ def table_row_count(table: str, sf: float) -> int:
     if table == "nation":
         return 25
     if table == "lineitem":
-        return len(get_table("lineitem", sf)["l_orderkey"])
+        # replay only the generator's FIRST draw (lines-per-order) — metadata
+        # and split planning must not materialize the table (sf1000 = ~6B rows)
+        key = ("lineitem_rows", round(sf * 1000))
+        if key not in _ROWCOUNT_CACHE:
+            norders = max(1, int(1_500_000 * sf))
+            rng = np.random.default_rng(_table_seed("lineitem", sf))
+            _ROWCOUNT_CACHE[key] = int(rng.integers(1, 8, norders).sum())
+        return _ROWCOUNT_CACHE[key]
     if table == "partsupp":
         return max(1, int(200_000 * sf)) * 4
     base = TABLES[table][1]
@@ -422,9 +437,7 @@ class TpchPageSource(ConnectorPageSource):
         start, end = split_range(total, split.part, split.total_parts)
         if handle.limit is not None:
             end = min(end, start + handle.limit)
-        for off in range(start, max(end, start + 1), page_capacity):
-            if off >= end and off > start:
-                break
+        for off in range(start, end, page_capacity):
             hi = min(off + page_capacity, end)
             n = hi - off
             cols = []
@@ -433,24 +446,14 @@ class TpchPageSource(ConnectorPageSource):
                 raw = data[ch.name][off:hi]
                 if T.is_string(typ):
                     d = table_dictionary(table, sf, ch.name)
-                    codes = d.encode(raw)
-                    codes = _pad(codes, page_capacity, 0)
+                    codes = pad_to_capacity(d.encode(raw), page_capacity, 0)
                     cols.append(Column.from_numpy(codes, typ, dictionary=d))
                 else:
-                    arr = _pad(np.asarray(raw, T.to_numpy_dtype(typ)),
-                               page_capacity, 0)
+                    arr = pad_to_capacity(
+                        np.asarray(raw, T.to_numpy_dtype(typ)),
+                        page_capacity, 0)
                     cols.append(Column.from_numpy(arr, typ))
             yield Page(tuple(cols), n)
-            if hi >= end:
-                break
-
-
-def _pad(arr: np.ndarray, capacity: int, fill) -> np.ndarray:
-    if len(arr) >= capacity:
-        return arr[:capacity]
-    out = np.full(capacity, fill, dtype=arr.dtype)
-    out[:len(arr)] = arr
-    return out
 
 
 def create_connector() -> Connector:

@@ -34,6 +34,21 @@ class InputRef(RowExpression):
 
 
 @dataclasses.dataclass(frozen=True)
+class SymbolRef(RowExpression):
+    """Plan-level reference to a named symbol (sql/planner/Symbol.java).
+
+    Logical plans carry SymbolRef expressions; LocalExecutionPlanner rewrites
+    them to channel-indexed InputRefs against each operator's page layout.
+    """
+
+    name: str
+    type: T.Type
+
+    def __str__(self):
+        return self.name
+
+
+@dataclasses.dataclass(frozen=True)
 class Literal(RowExpression):
     """Constant. value=None means typed NULL."""
 

@@ -1,0 +1,123 @@
+"""Metadata facade + Session.
+
+Reference parity: core/trino-main metadata/MetadataManager.java (catalog/
+table resolution over connectors) and Session.java (catalog/schema defaults,
+session properties — SystemSessionProperties.java's property bag).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+from trino_tpu.connector.spi import (
+    CatalogManager, ColumnHandle, Connector, ConnectorTableHandle,
+    SchemaTableName, TableMetadata, TableStatistics)
+
+_query_ids = itertools.count(1)
+
+# SystemSessionProperties.java:55-120 analogs (the load-bearing subset)
+SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
+    "join_distribution_type": "AUTOMATIC",   # BROADCAST | PARTITIONED
+    "join_reordering_strategy": "AUTOMATIC",  # NONE | ELIMINATE_CROSS_JOINS | AUTOMATIC
+    "hash_partition_count": 8,
+    "task_concurrency": 1,
+    "query_max_memory": 16 << 30,
+    "page_capacity": 1 << 16,      # rows per device page
+    "join_broadcast_threshold_rows": 1_000_000,
+    "distributed_sort": True,
+    "enable_dynamic_filtering": True,
+    "push_aggregation_through_outer_join": True,
+    "colocated_join": True,
+    "spill_enabled": False,
+}
+
+
+@dataclasses.dataclass
+class Session:
+    catalog: Optional[str] = "tpch"
+    schema: Optional[str] = "tiny"
+    user: str = "user"
+    query_id: str = ""
+    start_date: int = 0  # days since epoch; current_date constant for the query
+    properties: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if not self.query_id:
+            self.query_id = f"q_{next(_query_ids)}"
+        if not self.start_date:
+            import datetime
+            self.start_date = (datetime.date.today()
+                               - datetime.date(1970, 1, 1)).days
+
+    def get(self, prop: str) -> Any:
+        if prop in self.properties:
+            return self.properties[prop]
+        if prop not in SESSION_PROPERTY_DEFAULTS:
+            raise KeyError(f"unknown session property: {prop}")
+        return SESSION_PROPERTY_DEFAULTS[prop]
+
+    def set(self, prop: str, value: Any):
+        if prop not in SESSION_PROPERTY_DEFAULTS:
+            raise KeyError(f"unknown session property: {prop}")
+        self.properties[prop] = value
+
+
+@dataclasses.dataclass(frozen=True)
+class QualifiedTable:
+    catalog: str
+    schema: str
+    table: str
+
+    def __str__(self):
+        return f"{self.catalog}.{self.schema}.{self.table}"
+
+    @property
+    def schema_table(self) -> SchemaTableName:
+        return SchemaTableName(self.schema, self.table)
+
+
+class Metadata:
+    """MetadataManager.java — name resolution across catalogs."""
+
+    def __init__(self, catalogs: CatalogManager):
+        self.catalogs = catalogs
+
+    def resolve_table_name(self, parts: Tuple[str, ...],
+                           session: Session) -> QualifiedTable:
+        if len(parts) == 1:
+            if not session.catalog or not session.schema:
+                raise ValueError(
+                    f"session catalog/schema not set for table {parts[0]}")
+            return QualifiedTable(session.catalog, session.schema, parts[0])
+        if len(parts) == 2:
+            if not session.catalog:
+                raise ValueError("session catalog not set")
+            return QualifiedTable(session.catalog, parts[0], parts[1])
+        if len(parts) == 3:
+            return QualifiedTable(parts[0], parts[1], parts[2])
+        raise ValueError(f"invalid table name: {'.'.join(parts)}")
+
+    def connector(self, catalog: str) -> Connector:
+        return self.catalogs.get(catalog)
+
+    def get_table_handle(self, name: QualifiedTable
+                         ) -> Optional[ConnectorTableHandle]:
+        try:
+            conn = self.catalogs.get(name.catalog)
+        except KeyError:
+            return None
+        return conn.metadata.get_table_handle(name.schema_table)
+
+    def get_table_metadata(self, catalog: str,
+                           handle: ConnectorTableHandle) -> TableMetadata:
+        return self.catalogs.get(catalog).metadata.get_table_metadata(handle)
+
+    def get_column_handles(self, catalog: str,
+                           handle: ConnectorTableHandle) -> List[ColumnHandle]:
+        return self.catalogs.get(catalog).metadata.get_column_handles(handle)
+
+    def get_table_statistics(self, catalog: str,
+                             handle: ConnectorTableHandle) -> TableStatistics:
+        return self.catalogs.get(catalog).metadata.get_table_statistics(handle)

@@ -1,0 +1,304 @@
+"""Expression typing, coercion, and function resolution rules.
+
+Reference parity: core/trino-main sql/analyzer/ExpressionAnalyzer.java (2,795
+LoC) + TypeCoercion.java + metadata/FunctionRegistry.java:372. The planner
+calls into these rules while translating AST expressions; keeping them here
+mirrors the reference's analyzer/planner split without the Analysis side-table
+machinery (we type during translation instead).
+
+Decimal result types follow Trino's DecimalOperators:
+  add/sub:  scale max(s1,s2), precision max(p1-s1,p2-s2)+scale+1
+  multiply: precision p1+p2, scale s1+s2
+  divide:   precision p1+s2+max(0,s2-s1), scale max(s1,s2)
+(precision clamps to 18 — short-decimal int64 path, types.DecimalType).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+from trino_tpu import types as T
+
+
+class SemanticError(Exception):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ResolvedFunction:
+    """Outcome of function resolution: registry name + types."""
+
+    name: str                      # canonical registry/compiler name
+    arg_types: Tuple[T.Type, ...]  # post-coercion argument types
+    return_type: T.Type
+
+
+AGGREGATE_NAMES = frozenset({
+    "count", "sum", "avg", "min", "max", "count_if", "bool_and", "bool_or",
+    "every", "arbitrary", "any_value", "stddev", "stddev_pop", "stddev_samp",
+    "variance", "var_pop", "var_samp", "approx_distinct", "corr", "covar_pop",
+    "covar_samp", "regr_slope", "regr_intercept", "checksum", "geometric_mean",
+})
+
+WINDOW_NAMES = frozenset({
+    "row_number", "rank", "dense_rank", "percent_rank", "cume_dist", "ntile",
+    "lag", "lead", "first_value", "last_value", "nth_value",
+})
+
+
+def is_aggregate(name: str) -> bool:
+    return name.lower() in AGGREGATE_NAMES
+
+
+def is_window(name: str) -> bool:
+    return name.lower() in WINDOW_NAMES
+
+
+# --------------------------------------------------------------- coercion
+
+def can_coerce(src: T.Type, dst: T.Type) -> bool:
+    """Implicit coercion lattice (TypeCoercion.canCoerce)."""
+    if src == dst:
+        return True
+    if isinstance(src, T.UnknownType):
+        return True
+    order = (T.TinyintType, T.SmallintType, T.IntegerType, T.BigintType)
+    if isinstance(src, order) and isinstance(dst, order):
+        return order.index(type(src)) <= order.index(type(dst))
+    if isinstance(src, order) and isinstance(dst, (T.DoubleType, T.RealType,
+                                                   T.DecimalType)):
+        return True
+    if isinstance(src, T.DecimalType):
+        if isinstance(dst, T.DoubleType) or isinstance(dst, T.RealType):
+            return True
+        if isinstance(dst, T.DecimalType):
+            return (dst.scale >= src.scale and
+                    dst.precision - dst.scale >= src.precision - src.scale)
+        return False
+    if isinstance(src, T.RealType) and isinstance(dst, T.DoubleType):
+        return True
+    if isinstance(src, (T.VarcharType, T.CharType)) and isinstance(
+            dst, (T.VarcharType, T.CharType)):
+        return True
+    if isinstance(src, T.DateType) and isinstance(dst, T.TimestampType):
+        return True
+    return False
+
+
+def common_type(a: T.Type, b: T.Type) -> Optional[T.Type]:
+    """Least common supertype for comparisons/CASE/set-ops
+    (TypeCoercion.getCommonSuperType)."""
+    if a == b:
+        return a
+    if isinstance(a, T.UnknownType):
+        return b
+    if isinstance(b, T.UnknownType):
+        return a
+    if isinstance(a, T.DecimalType) and isinstance(b, T.DecimalType):
+        scale = max(a.scale, b.scale)
+        whole = max(a.precision - a.scale, b.precision - b.scale)
+        return T.DecimalType(min(whole + scale, 18), scale)
+    ints = (T.TinyintType, T.SmallintType, T.IntegerType, T.BigintType)
+    if isinstance(a, ints) and isinstance(b, T.DecimalType):
+        return common_type(_int_as_decimal(a), b)
+    if isinstance(b, ints) and isinstance(a, T.DecimalType):
+        return common_type(a, _int_as_decimal(b))
+    if can_coerce(a, b):
+        return b
+    if can_coerce(b, a):
+        return a
+    # numeric tower fallback: anything numeric with double/real -> double
+    if T.is_numeric(a) and T.is_numeric(b):
+        return T.DOUBLE
+    return None
+
+
+def _int_as_decimal(t: T.Type) -> T.DecimalType:
+    digits = {T.TinyintType: 3, T.SmallintType: 5, T.IntegerType: 10,
+              T.BigintType: 18}[type(t)]
+    return T.DecimalType(digits, 0)
+
+
+# ------------------------------------------------- arithmetic result types
+
+def arithmetic_type(op: str, a: T.Type, b: T.Type) -> T.Type:
+    """+ - * / % result type (DecimalOperators / BigintOperators)."""
+    if isinstance(a, (T.DoubleType,)) or isinstance(b, (T.DoubleType,)):
+        return T.DOUBLE
+    if isinstance(a, T.RealType) or isinstance(b, T.RealType):
+        return T.REAL
+    ints = (T.TinyintType, T.SmallintType, T.IntegerType, T.BigintType)
+    if isinstance(a, ints) and isinstance(b, ints):
+        order = [T.TinyintType, T.SmallintType, T.IntegerType, T.BigintType]
+        win = max(order.index(type(a)), order.index(type(b)))
+        # integer arithmetic stays integer; div is integer division
+        return (T.TINYINT, T.SMALLINT, T.INTEGER, T.BIGINT)[win]
+    da = a if isinstance(a, T.DecimalType) else (
+        _int_as_decimal(a) if isinstance(a, ints) else None)
+    db = b if isinstance(b, T.DecimalType) else (
+        _int_as_decimal(b) if isinstance(b, ints) else None)
+    if da is None or db is None:
+        raise SemanticError(
+            f"cannot apply operator {op} to {a.display()}, {b.display()}")
+    p1, s1, p2, s2 = da.precision, da.scale, db.precision, db.scale
+    if op in ("+", "-"):
+        scale = max(s1, s2)
+        precision = max(p1 - s1, p2 - s2) + scale + 1
+    elif op == "*":
+        precision, scale = p1 + p2, s1 + s2
+    elif op == "/":
+        scale = max(s1, s2)
+        precision = p1 + s2 + max(0, s2 - s1)
+    elif op == "%":
+        scale = max(s1, s2)
+        precision = min(p1 - s1, p2 - s2) + scale
+    else:
+        raise SemanticError(f"unknown operator {op}")
+    return T.DecimalType(min(precision, 18), min(scale, 18))
+
+
+_ARITH_NAMES = {"+": "add", "-": "subtract", "*": "multiply", "/": "divide",
+                "%": "modulus"}
+_CMP_NAMES = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt",
+              ">=": "ge"}
+
+
+def arithmetic_call(op: str, a: T.Type, b: T.Type) -> ResolvedFunction:
+    # date/timestamp ± interval
+    if isinstance(a, T.DateType) and isinstance(
+            b, (T.IntervalDayTimeType, T.IntervalYearMonthType)):
+        name = ("date_add_ym" if isinstance(b, T.IntervalYearMonthType)
+                else "date_add_dt")
+        return ResolvedFunction(name, (a, b), a)
+    if isinstance(b, T.DateType) and isinstance(
+            a, (T.IntervalDayTimeType, T.IntervalYearMonthType)) and op == "+":
+        name = ("date_add_ym" if isinstance(a, T.IntervalYearMonthType)
+                else "date_add_dt")
+        return ResolvedFunction(name, (b, a), b)
+    out = arithmetic_type(op, a, b)
+    # operands coerce to a common computation type; decimal ops rescale inside
+    return ResolvedFunction(_ARITH_NAMES[op], (a, b), out)
+
+
+def comparison_call(op: str, a: T.Type, b: T.Type
+                    ) -> Tuple[ResolvedFunction, T.Type]:
+    """Comparison: (resolved fn, operand coercion target)."""
+    ct = common_type(a, b)
+    if ct is None:
+        raise SemanticError(
+            f"cannot compare {a.display()} with {b.display()}")
+    base = _CMP_NAMES.get(op)
+    if base is None:
+        raise SemanticError(f"unsupported comparison {op}")
+    return ResolvedFunction(base, (ct, ct), T.BOOLEAN), ct
+
+
+# ------------------------------------------------------ scalar signatures
+
+def resolve_scalar(name: str, arg_types: Sequence[T.Type]) -> ResolvedFunction:
+    """FunctionRegistry.resolveFunction analog for scalar calls."""
+    n = name.lower()
+    args = tuple(arg_types)
+
+    def sig(out, coerced=None):
+        return ResolvedFunction(n, tuple(coerced or args), out)
+
+    if n in ("abs", "ceil", "ceiling", "floor", "negate"):
+        if not args or not T.is_numeric(args[0]):
+            raise SemanticError(f"{n}() requires a numeric argument")
+        canonical = "ceil" if n == "ceiling" else n
+        out = args[0]
+        if n in ("ceil", "ceiling", "floor") and isinstance(
+                args[0], T.DecimalType):
+            out = T.DecimalType(args[0].precision - args[0].scale + 1, 0)
+        return ResolvedFunction(canonical, args, out)
+    if n == "round":
+        if len(args) == 1:
+            out = args[0]
+            if isinstance(args[0], T.DecimalType):
+                out = T.DecimalType(args[0].precision - args[0].scale + 1, 0)
+            return ResolvedFunction("round", args, out)
+        return ResolvedFunction("round_digits", args, args[0])
+    if n in ("sqrt", "exp", "ln", "log10", "power", "pow", "cbrt"):
+        canonical = "power" if n == "pow" else n
+        coerced = tuple(T.DOUBLE for _ in args)
+        return ResolvedFunction(canonical, coerced, T.DOUBLE)
+    if n == "sign":
+        return sig(args[0])
+    if n in ("greatest", "least"):
+        ct = args[0]
+        for t2 in args[1:]:
+            nt = common_type(ct, t2)
+            if nt is None:
+                raise SemanticError(f"{n}() mixed argument types")
+            ct = nt
+        return ResolvedFunction(n, tuple(ct for _ in args), ct)
+    if n in ("year", "month", "day", "quarter", "day_of_week", "day_of_year",
+             "week", "hour", "minute", "second"):
+        return sig(T.BIGINT)
+    if n == "date_trunc":
+        return sig(args[1] if len(args) > 1 else T.DATE)
+    if n in ("lower", "upper", "trim", "ltrim", "rtrim", "reverse"):
+        return sig(args[0])
+    if n in ("substr", "substring"):
+        return ResolvedFunction("substr", args, args[0])
+    if n == "replace":
+        return sig(args[0])
+    if n == "concat":
+        return sig(args[0] if T.is_string(args[0]) else T.VarcharType())
+    if n == "length":
+        return sig(T.BIGINT)
+    if n == "like":
+        return sig(T.BOOLEAN)
+    if n == "strpos":
+        return sig(T.BIGINT)
+    raise SemanticError(f"unknown function: {name}()")
+
+
+def resolve_aggregate(name: str, arg_types: Sequence[T.Type]
+                      ) -> ResolvedFunction:
+    """Aggregate output types (mirrors ops/aggregate.get_aggregate)."""
+    n = name.lower()
+    args = tuple(arg_types)
+    if n == "count":
+        return ResolvedFunction("count", args, T.BIGINT)
+    a = args[0] if args else T.UNKNOWN
+    if n == "sum":
+        if isinstance(a, (T.DecimalType,)):
+            return ResolvedFunction("sum", args, T.DecimalType(18, a.scale))
+        if isinstance(a, T.DoubleType):
+            return ResolvedFunction("sum", args, T.DOUBLE)
+        if isinstance(a, T.RealType):
+            return ResolvedFunction("sum", args, T.REAL)
+        if T.is_integral(a):
+            return ResolvedFunction("sum", args, T.BIGINT)
+        raise SemanticError(f"sum() does not accept {a.display()}")
+    if n == "avg":
+        if isinstance(a, T.DecimalType):
+            return ResolvedFunction("avg", args, a)
+        if isinstance(a, T.RealType):
+            return ResolvedFunction("avg", args, T.REAL)
+        if T.is_numeric(a):
+            return ResolvedFunction("avg", args, T.DOUBLE)
+        raise SemanticError(f"avg() does not accept {a.display()}")
+    if n in ("min", "max"):
+        return ResolvedFunction(n, args, a)
+    if n in ("count_if",):
+        return ResolvedFunction("count_if", args, T.BIGINT)
+    if n in ("bool_and", "bool_or", "every"):
+        canonical = "bool_and" if n == "every" else n
+        return ResolvedFunction(canonical, args, T.BOOLEAN)
+    if n in ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp",
+             "var_pop", "geometric_mean"):
+        return ResolvedFunction(n, args, T.DOUBLE)
+    if n in ("arbitrary", "any_value"):
+        return ResolvedFunction("arbitrary", args, a)
+    if n == "approx_distinct":
+        return ResolvedFunction("approx_distinct", args, T.BIGINT)
+    if n == "checksum":
+        return ResolvedFunction("checksum", args, T.BIGINT)
+    if n in ("corr", "covar_pop", "covar_samp", "regr_slope",
+             "regr_intercept"):
+        return ResolvedFunction(n, tuple(T.DOUBLE for _ in args), T.DOUBLE)
+    raise SemanticError(f"unknown aggregate: {name}()")

@@ -160,9 +160,14 @@ class DecimalType(Type):
     scale: int = 0
 
     def __post_init__(self):
+        # Long decimals (precision 19-38 in Trino) need the int128 two-limb
+        # path; fail loudly rather than silently wrapping in int64. Planner
+        # code that derives result types clamps with min(p, 18) explicitly
+        # (sql/analyzer.arithmetic_type), accepting Java-long-overflow
+        # semantics there; a user-declared decimal(>18) is rejected here.
         if self.precision > 18:
             raise NotImplementedError(
-                "long decimals (precision>18) not supported in round 1")
+                "long decimals (precision>18) not supported yet")
 
     @property
     def dtype(self):
