@@ -1,0 +1,51 @@
+"""Benchmark: TPC-H q6 at SF1 end-to-end wall-clock on the real chip.
+
+Measurement ladder config (BASELINE.md): tiny-q6 smoke is covered by tests;
+this times SF1 q6 through the full engine (parse -> plan -> optimize ->
+execute, host paging + device kernels). Prints ONE JSON line.
+
+vs_baseline: the reference repo publishes no numbers (BASELINE.md); the
+denominator used here is 1.0 s — the ballpark single-node Trino q6 SF1
+wall-clock its LocalQueryRunner benchmarks show on server CPUs — so
+vs_baseline > 1 means faster than that estimate.
+"""
+
+import json
+import time
+
+Q6 = """
+SELECT sum(l_extendedprice * l_discount) AS revenue
+FROM lineitem
+WHERE l_shipdate >= DATE '1994-01-01'
+  AND l_shipdate < DATE '1994-01-01' + INTERVAL '1' YEAR
+  AND l_discount BETWEEN 0.06 - 0.01 AND 0.06 + 0.01
+  AND l_quantity < 24
+"""
+
+BASELINE_ESTIMATE_S = 1.0
+
+
+def main():
+    from trino_tpu.exec import LocalQueryRunner
+
+    runner = LocalQueryRunner.tpch("sf1")
+    # generation + warm-up (compile) run, untimed
+    warm = runner.execute(Q6)
+    assert len(warm.rows) == 1
+
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = runner.execute(Q6)
+        times.append(time.perf_counter() - t0)
+    wall = sorted(times)[1]  # median of 3
+    print(json.dumps({
+        "metric": "tpch_q6_sf1_wall_s",
+        "value": round(wall, 4),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_ESTIMATE_S / wall, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

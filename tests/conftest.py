@@ -16,3 +16,6 @@ if "--xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_enable_x64", True)
+# The axon sitecustomize registers the TPU backend at interpreter startup and
+# overrides JAX_PLATFORMS from the env; the config knob still wins.
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,120 @@
+"""sqlite-backed correctness oracle.
+
+Reference parity: testing/trino-testing H2QueryRunner.java — run the same SQL
+on the same data in a second engine and diff rows. sqlite is the stdlib
+stand-in for H2 (duckdb is not in the image).
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+import math
+import sqlite3
+from typing import List, Tuple
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.connector import tpch
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+def _sql_value(v, typ: T.Type):
+    if isinstance(typ, T.DecimalType):
+        # keep scaled ints; sqlite works in exact integers then
+        return int(v)
+    if isinstance(typ, (T.DateType,)):
+        return int(v)
+    return v.item() if isinstance(v, np.generic) else v
+
+
+def load_tpch_sqlite(sf: float = 0.01) -> sqlite3.Connection:
+    """Load the generated TPC-H data into sqlite, decimals as scaled ints
+    (exact integer arithmetic; tests rescale in SQL)."""
+    conn = sqlite3.connect(":memory:")
+    for table, (cols, _) in tpch.TABLES.items():
+        data = tpch.get_table(table, sf)
+        names = [c for c, _ in cols]
+        conn.execute(f"CREATE TABLE {table} ({', '.join(names)})")
+        arrays = [data[c] for c in names]
+        typs = [ty for _, ty in cols]
+        rows = zip(*[
+            [_sql_value(v, ty) for v in arr]
+            for arr, ty in zip(arrays, typs)])
+        conn.executemany(
+            f"INSERT INTO {table} VALUES ({', '.join('?' * len(names))})",
+            rows)
+    conn.commit()
+    return conn
+
+
+def normalize(rows: List[Tuple], sort: bool = False) -> List[Tuple]:
+    """Canonical form for comparison: Decimal -> scaled int where exact,
+    floats rounded, dates -> ordinal ints."""
+    out = []
+    for row in rows:
+        canon = []
+        for v in row:
+            if isinstance(v, decimal.Decimal):
+                canon.append(("dec", int(v.scaleb(
+                    -v.as_tuple().exponent)) if v.as_tuple().exponent < 0
+                    else int(v)))
+            elif isinstance(v, float):
+                if math.isnan(v):
+                    canon.append(("f", "nan"))
+                else:
+                    canon.append(("f", round(v, 6)))
+            elif isinstance(v, datetime.date):
+                canon.append(("d", (v - _EPOCH).days))
+            else:
+                canon.append(v)
+        out.append(tuple(canon))
+    if sort:
+        out.sort(key=repr)
+    return out
+
+
+def assert_same(engine_rows: List[Tuple], oracle_rows: List[Tuple],
+                ordered: bool):
+    a = normalize(engine_rows, sort=not ordered)
+    b = normalize(oracle_rows, sort=not ordered)
+    assert len(a) == len(b), \
+        f"row count mismatch: engine {len(a)} vs oracle {len(b)}\n" \
+        f"engine[:5]={a[:5]}\noracle[:5]={b[:5]}"
+    for i, (ra, rb) in enumerate(zip(a, b)):
+        assert _row_eq(ra, rb), \
+            f"row {i} differs:\n  engine: {ra}\n  oracle: {rb}"
+
+
+def _row_eq(a: Tuple, b: Tuple) -> bool:
+    if len(a) != len(b):
+        return False
+    for x, y in zip(a, b):
+        if isinstance(x, tuple) and x and x[0] == "f":
+            if not (isinstance(y, tuple) and y and y[0] == "f"):
+                # oracle may return int where engine returns float
+                y = ("f", round(float(y[1] if isinstance(y, tuple) else y), 6))
+            xa, ya = x[1], y[1]
+            if xa == "nan" or ya == "nan":
+                if xa != ya:
+                    return False
+                continue
+            if ya == 0:
+                if abs(xa) > 1e-9:
+                    return False
+            elif abs(xa - ya) / max(abs(xa), abs(ya)) > 1e-9:
+                return False
+        elif isinstance(x, tuple) and x and x[0] == "dec":
+            yv = y[1] if isinstance(y, tuple) else y
+            if int(x[1]) != int(yv):
+                return False
+        elif isinstance(x, tuple) and x and x[0] == "d":
+            yv = y[1] if isinstance(y, tuple) else y
+            if int(x[1]) != int(yv):
+                return False
+        else:
+            if x != y:
+                return False
+    return True

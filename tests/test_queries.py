@@ -1,0 +1,399 @@
+"""End-to-end SQL correctness vs the sqlite oracle.
+
+Reference parity: testing/trino-testing AbstractTestQueries +
+AbstractTestAggregations + AbstractTestJoinQueries, instantiated over the
+tpch tiny schema with H2-style oracle comparison (QueryAssertions.java).
+Engine and oracle read the SAME generated data; oracle SQL is adapted for
+scaled-int decimals (see tests/oracle.py).
+"""
+
+import pytest
+
+from trino_tpu.exec import LocalQueryRunner
+from trino_tpu.expr.functions import days_from_civil
+
+from oracle import assert_same, load_tpch_sqlite
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch("tiny")
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    conn = load_tpch_sqlite(SF)
+    yield conn
+    conn.close()
+
+
+def d(text: str) -> int:
+    y, m, dd = text.split("-")
+    return days_from_civil(int(y), int(m), int(dd))
+
+
+def check(runner, oracle, engine_sql, oracle_sql=None, ordered=False):
+    got = runner.execute(engine_sql)
+    cur = oracle.execute(oracle_sql or engine_sql)
+    expected = cur.fetchall()
+    assert_same(got.rows, expected, ordered)
+    return got
+
+
+# ----------------------------------------------------------- basic queries
+
+def test_select_constants(runner):
+    assert runner.execute("SELECT 1, 'x', true, 1.5e0").rows == \
+        [(1, "x", True, 1.5)]
+
+
+def test_scan_and_filter(runner, oracle):
+    check(runner, oracle,
+          "SELECT n_nationkey, n_name FROM nation WHERE n_regionkey = 1")
+
+
+def test_arithmetic_and_aliases(runner, oracle):
+    check(runner, oracle,
+          "SELECT n_nationkey + 100, n_nationkey * 2 FROM nation")
+
+
+def test_order_by_limit(runner, oracle):
+    check(runner, oracle,
+          "SELECT n_name FROM nation ORDER BY n_name DESC LIMIT 5",
+          ordered=True)
+
+
+def test_distinct(runner, oracle):
+    check(runner, oracle,
+          "SELECT DISTINCT n_regionkey FROM nation")
+
+
+def test_in_list_and_between(runner, oracle):
+    check(runner, oracle,
+          "SELECT n_name FROM nation WHERE n_regionkey IN (0, 3) "
+          "AND n_nationkey BETWEEN 5 AND 20")
+
+
+def test_case_expression(runner, oracle):
+    check(runner, oracle,
+          "SELECT n_name, CASE WHEN n_regionkey = 0 THEN 'africa' "
+          "WHEN n_regionkey = 1 THEN 'america' ELSE 'other' END FROM nation")
+
+
+def test_string_functions(runner, oracle):
+    check(runner, oracle,
+          "SELECT upper(n_name), length(n_name), substr(n_name, 1, 3) "
+          "FROM nation",
+          "SELECT upper(n_name), length(n_name), substr(n_name, 1, 3) "
+          "FROM nation")
+
+
+def test_like(runner, oracle):
+    check(runner, oracle,
+          "SELECT n_name FROM nation WHERE n_name LIKE '%IA'")
+
+
+def test_null_handling(runner):
+    rows = runner.execute(
+        "SELECT NULL IS NULL, 1 + CAST(NULL AS bigint), "
+        "coalesce(NULL, 7)").rows
+    assert rows == [(True, None, 7)]
+
+
+def test_aggregations(runner, oracle):
+    check(runner, oracle,
+          "SELECT count(*), sum(n_regionkey), min(n_name), max(n_name) "
+          "FROM nation")
+
+
+def test_group_by_having(runner, oracle):
+    check(runner, oracle,
+          "SELECT n_regionkey, count(*) FROM nation GROUP BY n_regionkey "
+          "HAVING count(*) >= 5")
+
+
+def test_agg_filter_clause(runner, oracle):
+    check(runner, oracle,
+          "SELECT count(*) FILTER (WHERE n_regionkey = 2) FROM nation",
+          "SELECT count(CASE WHEN n_regionkey = 2 THEN 1 END) FROM nation")
+
+
+def test_join_inner(runner, oracle):
+    check(runner, oracle,
+          "SELECT n_name, r_name FROM nation JOIN region "
+          "ON n_regionkey = r_regionkey")
+
+
+def test_join_left_with_condition(runner, oracle):
+    check(runner, oracle,
+          "SELECT r_name, n_name FROM region LEFT JOIN nation "
+          "ON r_regionkey = n_regionkey AND n_name LIKE 'A%'")
+
+
+def test_implicit_join(runner, oracle):
+    check(runner, oracle,
+          "SELECT s_name, n_name FROM supplier, nation "
+          "WHERE s_nationkey = n_nationkey AND n_regionkey = 2")
+
+
+def test_union(runner, oracle):
+    check(runner, oracle,
+          "SELECT n_regionkey FROM nation UNION SELECT r_regionkey + 3 "
+          "FROM region")
+    check(runner, oracle,
+          "SELECT n_regionkey FROM nation UNION ALL SELECT r_regionkey "
+          "FROM region")
+
+
+def test_subquery_in(runner, oracle):
+    check(runner, oracle,
+          "SELECT n_name FROM nation WHERE n_regionkey IN "
+          "(SELECT r_regionkey FROM region WHERE r_name LIKE 'A%')")
+
+
+def test_scalar_subquery(runner, oracle):
+    check(runner, oracle,
+          "SELECT n_name FROM nation "
+          "WHERE n_nationkey > (SELECT avg(n_nationkey) FROM nation)",
+          "SELECT n_name FROM nation "
+          "WHERE n_nationkey > (SELECT avg(n_nationkey) FROM nation)")
+
+
+def test_exists_correlated(runner, oracle):
+    check(runner, oracle,
+          "SELECT r_name FROM region WHERE EXISTS "
+          "(SELECT 1 FROM nation WHERE n_regionkey = r_regionkey "
+          "AND n_name LIKE 'I%')")
+
+
+def test_not_exists_correlated(runner, oracle):
+    check(runner, oracle,
+          "SELECT c_custkey FROM customer WHERE NOT EXISTS "
+          "(SELECT 1 FROM orders WHERE o_custkey = c_custkey) "
+          "ORDER BY c_custkey LIMIT 20", ordered=True)
+
+
+def test_cte(runner, oracle):
+    check(runner, oracle,
+          "WITH r AS (SELECT r_regionkey k FROM region WHERE r_regionkey < 3) "
+          "SELECT n_name FROM nation, r WHERE n_regionkey = k")
+
+
+def test_values(runner):
+    rows = runner.execute("SELECT * FROM (VALUES (1, 'a'), (2, 'b')) "
+                          "t(x, y) ORDER BY x DESC").rows
+    assert rows == [(2, "b"), (1, "a")]
+
+
+def test_rollup(runner, oracle):
+    check(runner, oracle,
+          "SELECT n_regionkey, count(*) FROM nation GROUP BY ROLLUP "
+          "(n_regionkey)",
+          "SELECT n_regionkey, count(*) FROM nation GROUP BY n_regionkey "
+          "UNION ALL SELECT NULL, count(*) FROM nation")
+
+
+def test_date_functions(runner, oracle):
+    check(runner, oracle,
+          "SELECT o_orderkey, year(o_orderdate) FROM orders "
+          "WHERE o_orderkey <= 50",
+          f"SELECT o_orderkey, CAST(strftime('%Y', o_orderdate * 86400, "
+          f"'unixepoch') AS INTEGER) FROM orders WHERE o_orderkey <= 50")
+
+
+def test_ctas_insert_memory(runner):
+    runner.execute("CREATE TABLE memory.default.t_ctas AS "
+                   "SELECT n_nationkey, n_name FROM nation "
+                   "WHERE n_regionkey = 0")
+    out = runner.execute("SELECT count(*) FROM memory.default.t_ctas")
+    assert out.only_value() == 5
+    runner.execute("INSERT INTO memory.default.t_ctas "
+                   "SELECT n_nationkey, n_name FROM nation "
+                   "WHERE n_regionkey = 1")
+    out = runner.execute(
+        "SELECT count(*), min(n_name) FROM memory.default.t_ctas")
+    assert out.rows[0][0] == 10
+    runner.execute("DROP TABLE memory.default.t_ctas")
+
+
+def test_show_and_explain(runner):
+    tables = runner.execute("SHOW TABLES")
+    assert ("lineitem",) in tables.rows
+    out = runner.execute("EXPLAIN (TYPE LOGICAL) SELECT count(*) FROM nation")
+    assert "Aggregation" in out.only_value()
+    out = runner.execute("EXPLAIN SELECT sum(l_quantity) FROM lineitem")
+    assert "Fragment" in out.only_value()
+
+
+# ------------------------------------------------------------ TPC-H queries
+
+def test_tpch_q1(runner, oracle):
+    engine = """
+SELECT l_returnflag, l_linestatus, sum(l_quantity) AS sum_qty,
+       sum(l_extendedprice) AS sum_base_price,
+       sum(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+       count(*) AS count_order
+FROM lineitem
+WHERE l_shipdate <= DATE '1998-12-01' - INTERVAL '90' DAY
+GROUP BY l_returnflag, l_linestatus
+ORDER BY l_returnflag, l_linestatus"""
+    # decimals are scaled ints in the oracle: discount/tax scale 2 -> the
+    # literal 1 is 100; products accumulate scale 4 and 6
+    o = f"""
+SELECT l_returnflag, l_linestatus, sum(l_quantity),
+       sum(l_extendedprice),
+       sum(l_extendedprice * (100 - l_discount)),
+       sum(l_extendedprice * (100 - l_discount) * (100 + l_tax)),
+       count(*)
+FROM lineitem
+WHERE l_shipdate <= {d('1998-12-01') - 90}
+GROUP BY l_returnflag, l_linestatus
+ORDER BY l_returnflag, l_linestatus"""
+    check(runner, oracle, engine, o, ordered=True)
+
+
+def test_tpch_q3(runner, oracle):
+    engine = """
+SELECT l_orderkey, sum(l_extendedprice * (1 - l_discount)) AS revenue,
+       o_orderdate, o_shippriority
+FROM customer, orders, lineitem
+WHERE c_mktsegment = 'BUILDING' AND c_custkey = o_custkey
+  AND l_orderkey = o_orderkey AND o_orderdate < DATE '1995-03-15'
+  AND l_shipdate > DATE '1995-03-15'
+GROUP BY l_orderkey, o_orderdate, o_shippriority
+ORDER BY revenue DESC, o_orderdate, l_orderkey
+LIMIT 10"""
+    o = f"""
+SELECT l_orderkey, sum(l_extendedprice * (100 - l_discount)),
+       o_orderdate, o_shippriority
+FROM customer, orders, lineitem
+WHERE c_mktsegment = 'BUILDING' AND c_custkey = o_custkey
+  AND l_orderkey = o_orderkey AND o_orderdate < {d('1995-03-15')}
+  AND l_shipdate > {d('1995-03-15')}
+GROUP BY l_orderkey, o_orderdate, o_shippriority
+ORDER BY 2 DESC, o_orderdate, l_orderkey
+LIMIT 10"""
+    check(runner, oracle, engine, o, ordered=True)
+
+
+def test_tpch_q5(runner, oracle):
+    engine = """
+SELECT n_name, sum(l_extendedprice * (1 - l_discount)) AS revenue
+FROM customer, orders, lineitem, supplier, nation, region
+WHERE c_custkey = o_custkey AND l_orderkey = o_orderkey
+  AND l_suppkey = s_suppkey AND c_nationkey = s_nationkey
+  AND s_nationkey = n_nationkey AND n_regionkey = r_regionkey
+  AND r_name = 'ASIA' AND o_orderdate >= DATE '1994-01-01'
+  AND o_orderdate < DATE '1994-01-01' + INTERVAL '1' YEAR
+GROUP BY n_name ORDER BY revenue DESC, n_name"""
+    o = f"""
+SELECT n_name, sum(l_extendedprice * (100 - l_discount))
+FROM customer, orders, lineitem, supplier, nation, region
+WHERE c_custkey = o_custkey AND l_orderkey = o_orderkey
+  AND l_suppkey = s_suppkey AND c_nationkey = s_nationkey
+  AND s_nationkey = n_nationkey AND n_regionkey = r_regionkey
+  AND r_name = 'ASIA' AND o_orderdate >= {d('1994-01-01')}
+  AND o_orderdate < {d('1995-01-01')}
+GROUP BY n_name ORDER BY 2 DESC, n_name"""
+    check(runner, oracle, engine, o, ordered=True)
+
+
+def test_tpch_q6(runner, oracle):
+    engine = """
+SELECT sum(l_extendedprice * l_discount) AS revenue
+FROM lineitem
+WHERE l_shipdate >= DATE '1994-01-01'
+  AND l_shipdate < DATE '1994-01-01' + INTERVAL '1' YEAR
+  AND l_discount BETWEEN 0.06 - 0.01 AND 0.06 + 0.01
+  AND l_quantity < 24"""
+    o = f"""
+SELECT sum(l_extendedprice * l_discount)
+FROM lineitem
+WHERE l_shipdate >= {d('1994-01-01')} AND l_shipdate < {d('1995-01-01')}
+  AND l_discount BETWEEN 5 AND 7 AND l_quantity < 2400"""
+    check(runner, oracle, engine, o)
+
+
+def test_tpch_q13(runner, oracle):
+    engine = """
+SELECT c_count, count(*) AS custdist
+FROM (SELECT c_custkey, count(o_orderkey) AS c_count
+      FROM customer LEFT OUTER JOIN orders
+        ON c_custkey = o_custkey AND o_comment NOT LIKE '%special%'
+      GROUP BY c_custkey) AS c_orders
+GROUP BY c_count ORDER BY custdist DESC, c_count DESC"""
+    o = """
+SELECT c_count, count(*) AS custdist
+FROM (SELECT c_custkey, count(o_orderkey) AS c_count
+      FROM customer LEFT OUTER JOIN orders
+        ON c_custkey = o_custkey AND o_comment NOT LIKE '%special%'
+      GROUP BY c_custkey) AS c_orders
+GROUP BY c_count ORDER BY custdist DESC, c_count DESC"""
+    check(runner, oracle, engine, o, ordered=True)
+
+
+def test_tpch_q14(runner, oracle):
+    engine = """
+SELECT sum(CASE WHEN p_type LIKE 'PROMO%'
+                THEN l_extendedprice * (1 - l_discount) ELSE 0 END) AS promo,
+       sum(l_extendedprice * (1 - l_discount)) AS total
+FROM lineitem, part
+WHERE l_partkey = p_partkey AND l_shipdate >= DATE '1995-09-01'
+  AND l_shipdate < DATE '1995-10-01'"""
+    o = f"""
+SELECT sum(CASE WHEN p_type LIKE 'PROMO%'
+                THEN l_extendedprice * (100 - l_discount) ELSE 0 END),
+       sum(l_extendedprice * (100 - l_discount))
+FROM lineitem, part
+WHERE l_partkey = p_partkey AND l_shipdate >= {d('1995-09-01')}
+  AND l_shipdate < {d('1995-10-01')}"""
+    check(runner, oracle, engine, o)
+
+
+def test_tpch_q18(runner, oracle):
+    engine = """
+SELECT c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+       sum(l_quantity)
+FROM customer, orders, lineitem
+WHERE o_orderkey IN (SELECT l_orderkey FROM lineitem
+                     GROUP BY l_orderkey HAVING sum(l_quantity) > 200)
+  AND c_custkey = o_custkey AND o_orderkey = l_orderkey
+GROUP BY c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+ORDER BY o_totalprice DESC, o_orderdate, o_orderkey LIMIT 100"""
+    o = """
+SELECT c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+       sum(l_quantity)
+FROM customer, orders, lineitem
+WHERE o_orderkey IN (SELECT l_orderkey FROM lineitem
+                     GROUP BY l_orderkey HAVING sum(l_quantity) > 20000)
+  AND c_custkey = o_custkey AND o_orderkey = l_orderkey
+GROUP BY c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+ORDER BY o_totalprice DESC, o_orderdate, o_orderkey LIMIT 100"""
+    check(runner, oracle, engine, o, ordered=True)
+
+
+def test_tpch_q22(runner, oracle):
+    engine = """
+SELECT cntrycode, count(*) AS numcust, sum(c_acctbal) AS totacctbal
+FROM (SELECT substring(c_phone, 1, 2) AS cntrycode, c_acctbal
+      FROM customer
+      WHERE substring(c_phone, 1, 2) IN ('13', '31', '23', '29', '30')
+        AND c_acctbal > (SELECT avg(c_acctbal) FROM customer
+                         WHERE c_acctbal > 0.00)
+        AND NOT EXISTS (SELECT * FROM orders WHERE o_custkey = c_custkey))
+     AS custsale
+GROUP BY cntrycode ORDER BY cntrycode"""
+    o = """
+SELECT cntrycode, count(*) AS numcust, sum(c_acctbal) AS totacctbal
+FROM (SELECT substr(c_phone, 1, 2) AS cntrycode, c_acctbal
+      FROM customer
+      WHERE substr(c_phone, 1, 2) IN ('13', '31', '23', '29', '30')
+        AND c_acctbal > (SELECT avg(c_acctbal) FROM customer
+                         WHERE c_acctbal > 0)
+        AND NOT EXISTS (SELECT * FROM orders WHERE o_custkey = c_custkey))
+     AS custsale
+GROUP BY cntrycode ORDER BY cntrycode"""
+    check(runner, oracle, engine, o, ordered=True)

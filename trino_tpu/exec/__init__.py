@@ -1,0 +1,9 @@
+"""Execution engine: plan -> operator pipelines over device Pages.
+
+Reference parity: sql/planner/LocalExecutionPlanner.java:420 (plan fragment ->
+DriverFactories) + operator/Driver.java's page loop. The TPU design replaces
+the time-sliced operator interpreter with composed, jitted per-page device
+functions (XLA fuses each chain; SURVEY §2.5 'TPU build' column).
+"""
+
+from trino_tpu.exec.runner import LocalQueryRunner  # noqa: F401

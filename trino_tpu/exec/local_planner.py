@@ -1,0 +1,623 @@
+"""Local execution: lower a logical plan to streaming page pipelines.
+
+Reference parity: sql/planner/LocalExecutionPlanner.java:420 — each plan node
+maps to an operator implementation over Pages (visitTableScan:1733,
+visitFilter/visitProject via ScanFilterAndProject:1606, visitAggregation:1534,
+visitJoin:2109, visitTopN, visitSort, visitLimit, visitSemiJoin, ...).
+
+Execution model (Driver.java replacement): a node executes to an iterator of
+fixed-capacity Pages plus a symbol layout. Device work per page runs under
+jit — traces cache on (capacity, dtypes), so steady-state streaming is one
+compiled XLA call per page per pipeline stage. Blocking operators (agg, sort,
+join build) consume their input eagerly, as their Java counterparts do across
+addInput/finish.
+
+Dynamic row counts under static shapes (SURVEY §7 hard part 1): operators
+carry a true-total scalar; when an output overflows its static capacity the
+executor doubles the capacity bucket and re-runs (hash_join contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.connector.spi import Split
+from trino_tpu.expr.compiler import compile_expression, compile_filter
+from trino_tpu.expr.ir import (Call, InputRef, Literal, RowExpression,
+                               SpecialForm, SpecialKind, SymbolRef)
+from trino_tpu.metadata import Metadata, Session
+from trino_tpu.ops import (AggSpec, JoinType, SortKey, Step, hash_aggregate,
+                           hash_join, order_by, top_n)
+from trino_tpu.page import Column, Page, concat_pages
+from trino_tpu.planner.nodes import (
+    AggregationNode, AggStep, DistinctLimitNode, EnforceSingleRowNode,
+    ExchangeNode, FilterNode, GroupIdNode, JoinClause, JoinKind, JoinNode,
+    LimitNode, OffsetNode, OutputNode, PlanNode, ProjectNode, SemiJoinNode,
+    SortNode, Symbol, TableScanNode, TopNNode, UnionNode, ValuesNode,
+    WindowNode, TableWriterNode)
+
+
+class ExecutionError(Exception):
+    pass
+
+
+def lower_expr(e: RowExpression, layout: Dict[str, int],
+               types: Dict[str, T.Type]) -> RowExpression:
+    """SymbolRef -> InputRef against a page layout (the compiled-PageProcessor
+    channel mapping step)."""
+    if isinstance(e, SymbolRef):
+        if e.name not in layout:
+            raise ExecutionError(f"symbol {e.name} not in layout")
+        return InputRef(layout[e.name], types[e.name])
+    if isinstance(e, Call):
+        return Call(e.name, tuple(lower_expr(a, layout, types)
+                                  for a in e.args), e.type)
+    if isinstance(e, SpecialForm):
+        return SpecialForm(e.kind, tuple(lower_expr(a, layout, types)
+                                         for a in e.args), e.type)
+    return e
+
+
+def _layout(symbols: Sequence[Symbol]) -> Tuple[Dict[str, int],
+                                                Dict[str, T.Type]]:
+    lay = {s.name: i for i, s in enumerate(symbols)}
+    typ = {s.name: s.type for s in symbols}
+    return lay, typ
+
+
+def _next_pow2(n: int) -> int:
+    out = 1024
+    while out < n:
+        out *= 2
+    return out
+
+
+@dataclasses.dataclass
+class PageStream:
+    pages: Iterator[Page]
+    symbols: Tuple[Symbol, ...]
+
+
+class LocalExecutionPlanner:
+    """Single-process executor over one device (LocalQueryRunner's engine)."""
+
+    def __init__(self, metadata: Metadata, session: Session):
+        self.metadata = metadata
+        self.session = session
+        self.page_capacity = int(session.get("page_capacity"))
+
+    # ------------------------------------------------------------ dispatch
+
+    def execute(self, node: PlanNode) -> PageStream:
+        name = type(node).__name__
+        method = getattr(self, f"_exec_{name}", None)
+        if method is None:
+            raise ExecutionError(f"no executor for {name}")
+        return method(node)
+
+    # ---------------------------------------------------------------- leaf
+
+    def _exec_TableScanNode(self, node: TableScanNode) -> PageStream:
+        conn = self.metadata.connector(node.catalog)
+        columns = [c for _, c in node.assignments]
+        splits = conn.split_manager.get_splits(node.table, target_splits=1)
+
+        def gen():
+            for split in splits:
+                yield from conn.page_source.pages(split, columns,
+                                                  self.page_capacity)
+        return PageStream(gen(), tuple(s for s, _ in node.assignments))
+
+    def _exec_ValuesNode(self, node: ValuesNode) -> PageStream:
+        cols = []
+        n = len(node.rows)
+        cap = max(_next_pow2(n), 8)
+        for i, sym in enumerate(node.symbols):
+            typ = sym.type
+            vals = []
+            valid = []
+            for row in node.rows:
+                lit = row[i]
+                if not isinstance(lit, Literal):
+                    raise ExecutionError("VALUES row is not literal")
+                vals.append(0 if lit.value is None else lit.value)
+                valid.append(lit.value is not None)
+            if T.is_string(typ):
+                from trino_tpu.page import Dictionary
+                d, codes = Dictionary.build(np.asarray(
+                    [v if isinstance(v, str) else "" for v in vals],
+                    dtype=object))
+                arr = np.zeros(cap, dtype=np.int32)
+                arr[:n] = codes
+                col = Column(jnp.asarray(arr), _valid_arr(valid, cap), typ, d)
+            else:
+                arr = np.zeros(cap, dtype=T.to_numpy_dtype(typ))
+                arr[:n] = vals
+                col = Column(jnp.asarray(arr), _valid_arr(valid, cap), typ,
+                             None)
+            cols.append(col)
+        page = Page(tuple(cols), n)
+        return PageStream(iter([page]), node.symbols)
+
+    # ----------------------------------------------------------- streaming
+
+    def _exec_FilterNode(self, node: FilterNode) -> PageStream:
+        # Filter(SemiJoin) fuses into semi/anti probe (LocalExecutionPlanner
+        # visitFilter's special-cased semi-join consumption)
+        if isinstance(node.source, SemiJoinNode):
+            return self._exec_semijoin_filter(node)
+        src = self.execute(node.source)
+        lay, typ = _layout(src.symbols)
+        pred = lower_expr(node.predicate, lay, typ)
+        fn = jax.jit(lambda p, f=compile_filter(pred): p.filter(f(p)))
+
+        def gen():
+            for page in src.pages:
+                yield fn(page)
+        return PageStream(gen(), src.symbols)
+
+    def _exec_ProjectNode(self, node: ProjectNode) -> PageStream:
+        src = self.execute(node.source)
+        lay, typ = _layout(src.symbols)
+        exprs = [lower_expr(e, lay, typ) for _, e in node.assignments]
+        fns = [compile_expression(e) for e in exprs]
+
+        @jax.jit
+        def run(page):
+            return Page(tuple(fn(page) for fn in fns), page.num_rows)
+
+        def gen():
+            for page in src.pages:
+                yield run(page)
+        return PageStream(gen(), tuple(s for s, _ in node.assignments))
+
+    def _exec_LimitNode(self, node: LimitNode) -> PageStream:
+        src = self.execute(node.source)
+
+        def gen():
+            remaining = node.count
+            for page in src.pages:
+                n = int(page.num_rows)
+                if n >= remaining:
+                    yield Page(page.columns, remaining)
+                    return
+                remaining -= n
+                yield page
+        return PageStream(gen(), src.symbols)
+
+    def _exec_OffsetNode(self, node: OffsetNode) -> PageStream:
+        src = self.execute(node.source)
+
+        def gen():
+            to_skip = node.count
+            for page in src.pages:
+                n = int(page.num_rows)
+                if to_skip >= n:
+                    to_skip -= n
+                    continue
+                if to_skip > 0:
+                    idx = jnp.arange(page.capacity, dtype=jnp.int32) + to_skip
+                    gathered = tuple(c.gather(idx) for c in page.columns)
+                    page = Page(gathered, n - to_skip)
+                    to_skip = 0
+                yield page
+        return PageStream(gen(), src.symbols)
+
+    # ------------------------------------------------------------ blocking
+
+    def _collect(self, stream: PageStream) -> Optional[Page]:
+        pages = [p for p in stream.pages if int(p.num_rows) > 0]
+        if not pages:
+            return None
+        if len(pages) == 1:
+            return pages[0]
+        return concat_pages(pages)
+
+    def _exec_AggregationNode(self, node: AggregationNode) -> PageStream:
+        src = self.execute(node.source)
+        lay, typ = _layout(src.symbols)
+        key_channels = [lay[s.name] for s in node.group_by]
+        specs = []
+        for out_sym, call in node.aggregations:
+            if call.args:
+                arg = call.args[0]
+                assert isinstance(arg, SymbolRef)
+                input_ch: Optional[int] = lay[arg.name]
+                in_type: Optional[T.Type] = typ[arg.name]
+            else:
+                input_ch, in_type = None, None
+            mask_ch = None
+            if call.filter is not None:
+                assert isinstance(call.filter, SymbolRef)
+                mask_ch = lay[call.filter.name]
+            specs.append(AggSpec(call.name, input_ch, in_type, mask_ch,
+                                 call.distinct))
+
+        partial_op = jax.jit(hash_aggregate(key_channels, specs, Step.PARTIAL))
+
+        # FINAL consumes the partial layout: keys first, then each agg's
+        # state columns in sequence
+        from trino_tpu.ops.aggregate import get_aggregate
+        nkeys = len(key_channels)
+        state_channels = []
+        ch = nkeys
+        for spec in specs:
+            fn = get_aggregate(spec.name, spec.input_type)
+            k = len(fn.state(spec.input_type))
+            state_channels.append(list(range(ch, ch + k)))
+            ch += k
+        final_keys = list(range(nkeys))
+        final_op = jax.jit(hash_aggregate(final_keys, specs, Step.FINAL,
+                                          state_channels))
+
+        def gen():
+            partials = []
+            for page in src.pages:
+                if int(page.num_rows) == 0:
+                    continue
+                partials.append(partial_op(page))
+            if not partials:
+                # empty input: global agg still emits one row
+                if not key_channels:
+                    yield self._empty_global_agg(node, specs)
+                return
+            merged = concat_pages(partials) if len(partials) > 1 \
+                else partials[0]
+            yield final_op(merged)
+        return PageStream(gen(), node.outputs)
+
+    def _empty_global_agg(self, node: AggregationNode, specs) -> Page:
+        cols = []
+        for (sym, call), spec in zip(node.aggregations, specs):
+            typ = sym.type
+            if call.name == "count":
+                cols.append(Column(jnp.zeros(8, typ.dtype), None, typ, None))
+            else:
+                cols.append(Column(jnp.zeros(8, typ.dtype),
+                                   jnp.zeros(8, dtype=jnp.bool_), typ, None))
+        return Page(tuple(cols), 1)
+
+    def _exec_GroupIdNode(self, node: GroupIdNode) -> PageStream:
+        src = self.execute(node.source)
+        lay, typ = _layout(src.symbols)
+        out_syms = node.outputs
+        all_group = tuple(dict.fromkeys(
+            s for gs in node.grouping_sets for s in gs))
+
+        def gen():
+            for page in src.pages:
+                for set_idx, gset in enumerate(node.grouping_sets):
+                    in_set = {s.name for s in gset}
+                    cols = []
+                    for sym in all_group + node.passthrough:
+                        c = page.column(lay[sym.name])
+                        if sym in all_group and sym.name not in in_set:
+                            # null out keys excluded from this grouping set
+                            c = Column(c.values,
+                                       jnp.zeros(page.capacity, jnp.bool_),
+                                       c.type, c.dictionary)
+                        cols.append(c)
+                    gid = Column(
+                        jnp.full(page.capacity, set_idx, dtype=jnp.int64),
+                        None, T.BIGINT, None)
+                    cols.append(gid)
+                    yield Page(tuple(cols), page.num_rows)
+        return PageStream(gen(), out_syms)
+
+    def _exec_SortNode(self, node: SortNode) -> PageStream:
+        src = self.execute(node.source)
+        lay, _ = _layout(src.symbols)
+        keys = [SortKey(lay[o.symbol.name], o.ascending, o.nulls_first)
+                for o in node.order_by]
+
+        def gen():
+            page = self._collect(PageStream(src.pages, src.symbols))
+            if page is None:
+                return
+            yield jax.jit(order_by(keys))(page)
+        return PageStream(gen(), src.symbols)
+
+    def _exec_TopNNode(self, node: TopNNode) -> PageStream:
+        src = self.execute(node.source)
+        lay, _ = _layout(src.symbols)
+        keys = [SortKey(lay[o.symbol.name], o.ascending, o.nulls_first)
+                for o in node.order_by]
+        per_page = jax.jit(top_n(node.count, keys))
+
+        def gen():
+            # partial top-n per page bounds the concat size at
+            # count * n_pages (GroupedTopN-builder analog)
+            partials = []
+            for page in src.pages:
+                if int(page.num_rows) == 0:
+                    continue
+                partials.append(per_page(page))
+            if not partials:
+                return
+            merged = concat_pages(partials) if len(partials) > 1 \
+                else partials[0]
+            yield jax.jit(top_n(node.count, keys))(merged)
+        return PageStream(gen(), src.symbols)
+
+    def _exec_JoinNode(self, node: JoinNode) -> PageStream:
+        if node.kind == JoinKind.CROSS and not node.criteria:
+            return self._exec_cross_join(node)
+        if node.kind in (JoinKind.RIGHT, JoinKind.FULL):
+            raise ExecutionError(f"{node.kind} join execution not supported "
+                                 "yet")
+        probe_stream = self.execute(node.left)
+        build_stream = self.execute(node.right)
+        probe_lay, probe_typ = _layout(probe_stream.symbols)
+        build_lay, _ = _layout(build_stream.symbols)
+        probe_keys = [probe_lay[c.left.name] for c in node.criteria]
+        build_keys = [build_lay[c.right.name] for c in node.criteria]
+        build_page = self._collect(build_stream)
+        out_symbols = node.left.outputs + node.right.outputs
+        join_kind = JoinType.INNER if node.kind == JoinKind.INNER \
+            else JoinType.LEFT
+
+        # residual non-equi filter evaluated over joined layout — valid for
+        # INNER only (LEFT would wrongly drop null-extended rows; planner
+        # rejects such plans)
+        post_filter = None
+        if node.filter is not None:
+            if join_kind != JoinType.INNER:
+                raise ExecutionError(
+                    "non-inner join with residual filter not supported")
+            lay, typ = _layout(out_symbols)
+            post_filter = compile_filter(lower_expr(node.filter, lay, typ))
+
+        def gen():
+            nonlocal build_page
+            if build_page is None:
+                if join_kind == JoinType.INNER:
+                    return
+                # LEFT join with empty build: emit null-extended probe rows
+                build_page = self._null_build_page(node.right.outputs)
+            cap0 = self.page_capacity
+            ops: Dict[int, object] = {}
+            for probe_page in probe_stream.pages:
+                if int(probe_page.num_rows) == 0:
+                    continue
+                cap = max(cap0, probe_page.capacity)
+                while True:
+                    if cap not in ops:
+                        op = hash_join(probe_keys, build_keys, join_kind,
+                                       output_capacity=cap)
+                        if post_filter is None:
+                            ops[cap] = jax.jit(
+                                lambda p, b, o=op: o(p, b))
+                        else:
+                            def run(p, b, o=op):
+                                out, total = o(p, b)
+                                out = out.filter(post_filter(out))
+                                return out, total
+                            ops[cap] = jax.jit(run)
+                    out, total = ops[cap](probe_page, build_page)
+                    if int(total) <= cap:
+                        break
+                    cap = _next_pow2(int(total))  # re-run bigger (SURVEY §7)
+                if int(out.num_rows) > 0:
+                    yield out
+        return PageStream(gen(), out_symbols)
+
+    def _null_build_page(self, symbols: Tuple[Symbol, ...]) -> Page:
+        cols = []
+        for s in symbols:
+            cols.append(Column(jnp.zeros(8, dtype=s.type.dtype),
+                               jnp.zeros(8, dtype=jnp.bool_), s.type, None))
+        return Page(tuple(cols), 0)
+
+    def _exec_cross_join(self, node: JoinNode) -> PageStream:
+        probe_stream = self.execute(node.left)
+        build_stream = self.execute(node.right)
+        build_page = self._collect(build_stream)
+        out_symbols = node.left.outputs + node.right.outputs
+
+        def gen():
+            if build_page is None:
+                return
+            nb = int(build_page.num_rows)
+            if nb == 1:
+                # scalar-subquery path: broadcast the single build row
+                def attach(p):
+                    bcols = tuple(
+                        Column(jnp.broadcast_to(c.values[:1], (p.capacity,)),
+                               None if c.valid is None else
+                               jnp.broadcast_to(c.valid[:1], (p.capacity,)),
+                               c.type, c.dictionary)
+                        for c in build_page.columns)
+                    return Page(tuple(p.columns) + bcols, p.num_rows)
+                run = jax.jit(attach)
+                for page in probe_stream.pages:
+                    if int(page.num_rows):
+                        yield run(page)
+                return
+            # general cross join: bounded expansion
+            for page in probe_stream.pages:
+                np_rows = int(page.num_rows)
+                if np_rows == 0:
+                    continue
+                total = np_rows * nb
+                if total > 4 * 1024 * 1024:
+                    raise ExecutionError(
+                        f"cross join too large ({total} rows)")
+                cap = _next_pow2(total)
+                idx = jnp.arange(cap, dtype=jnp.int32)
+                pi = jnp.minimum(idx // nb, page.capacity - 1)
+                bi = jnp.minimum(idx % nb, build_page.capacity - 1)
+                pcols = tuple(c.gather(pi) for c in page.columns)
+                bcols = tuple(c.gather(bi) for c in build_page.columns)
+                yield Page(pcols + bcols, total)
+        return PageStream(gen(), out_symbols)
+
+    def _exec_semijoin_filter(self, node: FilterNode) -> PageStream:
+        semi: SemiJoinNode = node.source
+        match_name = semi.match_symbol.name
+        mode: Optional[str] = None
+        rest: List[RowExpression] = []
+        from trino_tpu.planner.optimizer import conjuncts, combine
+        for c in conjuncts(node.predicate):
+            if isinstance(c, SymbolRef) and c.name == match_name:
+                mode = "semi"
+            elif isinstance(c, SpecialForm) and c.kind is SpecialKind.NOT \
+                    and isinstance(c.args[0], SymbolRef) \
+                    and c.args[0].name == match_name:
+                mode = "anti"
+            elif match_name in _symbol_names(c):
+                raise ExecutionError(
+                    "complex semi-join match usage not supported")
+            else:
+                rest.append(c)
+        if mode is None:
+            raise ExecutionError("semi-join match symbol unused in filter")
+
+        probe_stream = self.execute(semi.source)
+        build_stream = self.execute(semi.filtering_source)
+        probe_lay, probe_typ = _layout(probe_stream.symbols)
+        build_lay, _ = _layout(build_stream.symbols)
+        probe_keys = [probe_lay[s.name] for s in semi.source_keys]
+        build_keys = [build_lay[s.name] for s in semi.filtering_keys]
+        build_page = self._collect(build_stream)
+        jt = JoinType.SEMI if mode == "semi" else JoinType.ANTI
+        rest_pred = combine(rest)
+        rest_fn = None
+        if rest_pred is not None:
+            rest_fn = compile_filter(
+                lower_expr(rest_pred, probe_lay, probe_typ))
+
+        def gen():
+            bp = build_page
+            if bp is None:
+                if jt == JoinType.SEMI:
+                    return
+                bp = self._null_build_page(semi.filtering_source.outputs)
+            ops: Dict[int, object] = {}
+            for page in probe_stream.pages:
+                if int(page.num_rows) == 0:
+                    continue
+                cap = max(self.page_capacity, page.capacity)
+                while True:
+                    if cap not in ops:
+                        op = hash_join(probe_keys, build_keys, jt,
+                                       output_capacity=cap)
+
+                        def run(p, b, o=op):
+                            out, total = o(p, b)
+                            if rest_fn is not None:
+                                out = out.filter(rest_fn(out))
+                            return out, total
+                        ops[cap] = jax.jit(run)
+                    out, total = ops[cap](page, bp)
+                    if int(total) <= cap:
+                        break
+                    cap = _next_pow2(int(total))
+                if int(out.num_rows) > 0:
+                    yield out
+        return PageStream(gen(), semi.source.outputs)
+
+    def _exec_SemiJoinNode(self, node: SemiJoinNode) -> PageStream:
+        raise ExecutionError(
+            "bare SemiJoinNode (match symbol escaping into projections) "
+            "not supported; expected Filter(match) above")
+
+    def _exec_EnforceSingleRowNode(self, node) -> PageStream:
+        src = self.execute(node.source)
+
+        def gen():
+            page = self._collect(PageStream(src.pages, src.symbols))
+            if page is None:
+                # zero rows -> one all-null row (EnforceSingleRowOperator)
+                yield Page(self._null_build_page(node.outputs).columns, 1)
+                return
+            n = int(page.num_rows)
+            if n > 1:
+                raise ExecutionError(
+                    "Scalar sub-query has returned multiple rows")
+            yield page
+        return PageStream(gen(), node.outputs)
+
+    def _exec_UnionNode(self, node: UnionNode) -> PageStream:
+        def gen():
+            for j, child in enumerate(node.children):
+                stream = self.execute(child)
+                lay, _ = _layout(stream.symbols)
+                order = [lay[node.mappings[i][j].name]
+                         for i in range(len(node.symbols))]
+                for page in stream.pages:
+                    if int(page.num_rows) == 0:
+                        continue
+                    cols = tuple(page.column(ch) for ch in order)
+                    yield Page(cols, page.num_rows)
+        return PageStream(gen(), node.symbols)
+
+    def _exec_ExchangeNode(self, node: ExchangeNode) -> PageStream:
+        # single-device execution: exchanges are pass-through (the
+        # distributed executor lowers them to collectives)
+        return self.execute(node.source)
+
+    def _exec_WindowNode(self, node: WindowNode) -> PageStream:
+        raise ExecutionError("window function execution lands with the "
+                             "window operator (planned)")
+
+    def _exec_OutputNode(self, node: OutputNode) -> PageStream:
+        src = self.execute(node.source)
+        lay, _ = _layout(src.symbols)
+        order = [lay[s.name] for s in node.symbols]
+        if order == list(range(len(src.symbols))):
+            return PageStream(src.pages, node.symbols)
+
+        def gen():
+            for page in src.pages:
+                yield Page(tuple(page.column(c) for c in order),
+                           page.num_rows)
+        return PageStream(gen(), node.symbols)
+
+    def _exec_TableWriterNode(self, node: TableWriterNode) -> PageStream:
+        src = self.execute(node.source)
+        lay, _ = _layout(src.symbols)
+        order = [lay[s.name] for s in node.column_symbols]
+        conn = self.metadata.connector(node.catalog)
+        sink = conn.page_sink(node.table)
+
+        def gen():
+            written = 0
+            for page in src.pages:
+                n = int(page.num_rows)
+                if n == 0:
+                    continue
+                out = Page(tuple(page.column(c) for c in order), n)
+                sink.append_page(out)
+                written += n
+            sink.finish()
+            col = Column(jnp.asarray(np.array([written] * 8,
+                                              dtype=np.int64)),
+                         None, T.BIGINT, None)
+            yield Page((col,), 1)
+        return PageStream(gen(), node.outputs)
+
+
+def _valid_arr(valid: List[bool], cap: int) -> Optional[jnp.ndarray]:
+    if all(valid):
+        return None
+    arr = np.zeros(cap, dtype=bool)
+    arr[:len(valid)] = valid
+    return jnp.asarray(arr)
+
+
+def _symbol_names(e: RowExpression) -> set:
+    out = set()
+
+    def visit(x):
+        if isinstance(x, SymbolRef):
+            out.add(x.name)
+        for c in x.children():
+            visit(c)
+    visit(e)
+    return out

@@ -1,0 +1,316 @@
+"""LocalQueryRunner: SQL in, rows out, one process, one device.
+
+Reference parity: core/trino-main testing/LocalQueryRunner.java:230 — full
+parse/analyze/plan/optimize/execute without the HTTP scheduler, the workhorse
+of engine tests and operator benchmarks. Also handles the session-level
+statements (USE, SET SESSION, EXPLAIN, SHOW ...) the way the reference's
+coordinator resources do.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import decimal
+from typing import Any, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.connector import blackhole, memory, tpch
+from trino_tpu.connector.spi import (CatalogManager, ColumnMetadata,
+                                     SchemaTableName, TableMetadata)
+from trino_tpu.exec.local_planner import ExecutionError, LocalExecutionPlanner
+from trino_tpu.metadata import Metadata, Session
+from trino_tpu.planner import LogicalPlanner
+from trino_tpu.planner.nodes import (OutputNode, TableWriterNode, Symbol,
+                                     format_plan)
+from trino_tpu.planner.optimizer import fragment_plan, optimize
+from trino_tpu.sql import parse_statement
+from trino_tpu.sql import tree as t
+from trino_tpu.sql.analyzer import SemanticError
+
+
+@dataclasses.dataclass
+class MaterializedResult:
+    """testing/MaterializedResult.java analog."""
+
+    column_names: List[str]
+    column_types: List[T.Type]
+    rows: List[Tuple[Any, ...]]
+
+    def __len__(self):
+        return len(self.rows)
+
+    def only_value(self):
+        assert len(self.rows) == 1 and len(self.rows[0]) == 1
+        return self.rows[0][0]
+
+
+def _to_python(value, typ: T.Type):
+    if value is None:
+        return None
+    if isinstance(typ, T.DecimalType):
+        return decimal.Decimal(int(value)).scaleb(-typ.scale)
+    if isinstance(typ, T.DateType):
+        return datetime.date(1970, 1, 1) + datetime.timedelta(days=int(value))
+    if isinstance(typ, T.TimestampType):
+        return (datetime.datetime(1970, 1, 1)
+                + datetime.timedelta(microseconds=int(value)))
+    if isinstance(typ, T.BooleanType):
+        return bool(value)
+    if isinstance(typ, (T.DoubleType, T.RealType)):
+        return float(value)
+    if isinstance(typ, (T.VarcharType, T.CharType)):
+        return str(value)
+    if isinstance(typ, (T.IntervalDayTimeType, T.IntervalYearMonthType)):
+        return int(value)
+    return int(value)
+
+
+class LocalQueryRunner:
+    def __init__(self, session: Optional[Session] = None):
+        self.catalogs = CatalogManager()
+        self.metadata = Metadata(self.catalogs)
+        self.session = session or Session()
+        self._prepared = {}
+
+    @classmethod
+    def tpch(cls, schema: str = "tiny") -> "LocalQueryRunner":
+        """Runner with tpch/memory/blackhole catalogs (TpchQueryRunner)."""
+        runner = cls(Session(catalog="tpch", schema=schema))
+        runner.catalogs.register("tpch", tpch.create_connector())
+        runner.catalogs.register("memory", memory.create_connector())
+        runner.catalogs.register("blackhole", blackhole.create_connector())
+        return runner
+
+    # ------------------------------------------------------------- execute
+
+    def execute(self, sql: str) -> MaterializedResult:
+        stmt = parse_statement(sql)
+        return self._execute_statement(stmt)
+
+    def _execute_statement(self, stmt: t.Statement) -> MaterializedResult:
+        if isinstance(stmt, t.Query):
+            return self._execute_query(stmt)
+        if isinstance(stmt, t.Explain):
+            return self._explain(stmt)
+        if isinstance(stmt, t.ShowTables):
+            return self._show_tables(stmt)
+        if isinstance(stmt, t.ShowSchemas):
+            return self._show_schemas(stmt)
+        if isinstance(stmt, t.ShowCatalogs):
+            return MaterializedResult(
+                ["Catalog"], [T.VARCHAR],
+                [(c,) for c in self.catalogs.catalogs()])
+        if isinstance(stmt, t.ShowColumns):
+            return self._show_columns(stmt)
+        if isinstance(stmt, t.ShowSession):
+            from trino_tpu.metadata import SESSION_PROPERTY_DEFAULTS
+            rows = [(k, str(self.session.get(k)), str(v))
+                    for k, v in sorted(SESSION_PROPERTY_DEFAULTS.items())]
+            return MaterializedResult(
+                ["Name", "Value", "Default"], [T.VARCHAR] * 3, rows)
+        if isinstance(stmt, t.SetSession):
+            name = str(stmt.name)
+            value = _literal_value(stmt.value)
+            self.session.set(name, value)
+            return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, t.ResetSession):
+            self.session.properties.pop(str(stmt.name), None)
+            return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, t.Use):
+            if stmt.catalog is not None:
+                self.session.catalog = stmt.catalog.value
+            self.session.schema = stmt.schema.value
+            return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, t.CreateTable):
+            return self._create_table(stmt)
+        if isinstance(stmt, t.CreateTableAsSelect):
+            return self._create_table_as(stmt)
+        if isinstance(stmt, t.Insert):
+            return self._insert(stmt)
+        if isinstance(stmt, t.DropTable):
+            return self._drop_table(stmt)
+        if isinstance(stmt, t.Prepare):
+            self._prepared[stmt.name.value] = stmt.statement
+            return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, t.ExecuteStatement):
+            if stmt.name.value not in self._prepared:
+                raise SemanticError(
+                    f"prepared statement not found: {stmt.name.value}")
+            if stmt.parameters:
+                raise SemanticError("EXECUTE parameters not supported yet")
+            return self._execute_statement(self._prepared[stmt.name.value])
+        if isinstance(stmt, t.Deallocate):
+            self._prepared.pop(stmt.name.value, None)
+            return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, (t.Commit, t.Rollback, t.StartTransaction)):
+            return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
+        raise SemanticError(
+            f"unsupported statement: {type(stmt).__name__}")
+
+    def _plan(self, query: t.Statement) -> OutputNode:
+        plan = LogicalPlanner(self.metadata, self.session).plan(query)
+        return optimize(plan, self.metadata, self.session)
+
+    def _execute_query(self, query: t.Query) -> MaterializedResult:
+        plan = self._plan(query)
+        return self._run_plan(plan)
+
+    def _run_plan(self, plan: OutputNode) -> MaterializedResult:
+        executor = LocalExecutionPlanner(self.metadata, self.session)
+        stream = executor.execute(plan)
+        types = [s.type for s in plan.symbols]
+        rows: List[Tuple[Any, ...]] = []
+        for page in stream.pages:
+            n = int(page.num_rows)
+            if n == 0:
+                continue
+            cols = [c.to_numpy(n) for c in page.columns]
+            for i in range(n):
+                rows.append(tuple(
+                    _to_python(cols[j][i], types[j])
+                    for j in range(len(cols))))
+        return MaterializedResult(list(plan.column_names), types, rows)
+
+    # --------------------------------------------------------------- DDL
+
+    def _resolve(self, name: t.QualifiedName):
+        return self.metadata.resolve_table_name(name.parts, self.session)
+
+    def _create_table(self, stmt: t.CreateTable) -> MaterializedResult:
+        qname = self._resolve(stmt.name)
+        conn = self.catalogs.get(qname.catalog)
+        cols = tuple(ColumnMetadata(c.name.value, T.parse_type(c.type))
+                     for c in stmt.elements)
+        conn.metadata.create_table(
+            TableMetadata(qname.schema_table, cols), stmt.not_exists)
+        return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
+
+    def _create_table_as(self, stmt: t.CreateTableAsSelect
+                         ) -> MaterializedResult:
+        qname = self._resolve(stmt.name)
+        conn = self.catalogs.get(qname.catalog)
+        plan = self._plan(stmt.query)
+        cols = tuple(
+            ColumnMetadata(name, sym.type)
+            for name, sym in zip(plan.column_names, plan.symbols))
+        conn.metadata.create_table(
+            TableMetadata(qname.schema_table, cols), stmt.not_exists)
+        if not stmt.with_data:
+            return MaterializedResult(["rows"], [T.BIGINT], [(0,)])
+        handle = conn.metadata.get_table_handle(qname.schema_table)
+        writer = TableWriterNode(
+            plan.source, qname.catalog, handle, plan.symbols,
+            Symbol("rows", T.BIGINT))
+        out = OutputNode(writer, ("rows",), (Symbol("rows", T.BIGINT),))
+        return self._run_plan(out)
+
+    def _insert(self, stmt: t.Insert) -> MaterializedResult:
+        qname = self._resolve(stmt.target)
+        conn = self.catalogs.get(qname.catalog)
+        handle = conn.metadata.get_table_handle(qname.schema_table)
+        if handle is None:
+            raise SemanticError(f"table not found: {qname}")
+        meta = conn.metadata.get_table_metadata(handle)
+        if stmt.columns:
+            raise SemanticError("INSERT with column list not supported yet")
+        plan = self._plan(stmt.query)
+        if len(plan.symbols) != len(meta.columns):
+            raise SemanticError(
+                f"INSERT has {len(plan.symbols)} columns but table has "
+                f"{len(meta.columns)}")
+        writer = TableWriterNode(
+            plan.source, qname.catalog, handle, plan.symbols,
+            Symbol("rows", T.BIGINT))
+        out = OutputNode(writer, ("rows",), (Symbol("rows", T.BIGINT),))
+        return self._run_plan(out)
+
+    def _drop_table(self, stmt: t.DropTable) -> MaterializedResult:
+        qname = self._resolve(stmt.name)
+        conn = self.catalogs.get(qname.catalog)
+        handle = conn.metadata.get_table_handle(qname.schema_table)
+        if handle is None:
+            if stmt.exists:
+                return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
+            raise SemanticError(f"table not found: {qname}")
+        conn.metadata.drop_table(handle)
+        return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
+
+    # -------------------------------------------------------------- SHOW
+
+    def _explain(self, stmt: t.Explain) -> MaterializedResult:
+        if not isinstance(stmt.statement, t.Query):
+            raise SemanticError("EXPLAIN requires a query")
+        plan = self._plan(stmt.statement)
+        if stmt.explain_type == "DISTRIBUTED":
+            from trino_tpu.planner.optimizer import add_exchanges, \
+                OptimizerContext, StatsEstimator
+            ctx = OptimizerContext(self.metadata, self.session,
+                                   StatsEstimator(self.metadata))
+            plan = add_exchanges(plan, ctx)
+            frag = fragment_plan(plan)
+            text = _format_fragments(frag)
+        else:
+            text = format_plan(plan)
+        return MaterializedResult(["Query Plan"], [T.VARCHAR], [(text,)])
+
+    def _show_tables(self, stmt: t.ShowTables) -> MaterializedResult:
+        catalog = self.session.catalog
+        schema = self.session.schema
+        if stmt.schema is not None:
+            parts = stmt.schema.parts
+            if len(parts) == 2:
+                catalog, schema = parts
+            else:
+                schema = parts[0]
+        conn = self.catalogs.get(catalog)
+        tables = [n.table for n in conn.metadata.list_tables(schema)]
+        if stmt.like:
+            import re
+            from trino_tpu.expr.functions import like_pattern_to_regex
+            rx = re.compile(like_pattern_to_regex(stmt.like))
+            tables = [x for x in tables if rx.match(x)]
+        return MaterializedResult(["Table"], [T.VARCHAR],
+                                  [(x,) for x in tables])
+
+    def _show_schemas(self, stmt: t.ShowSchemas) -> MaterializedResult:
+        catalog = stmt.catalog or self.session.catalog
+        conn = self.catalogs.get(catalog)
+        return MaterializedResult(
+            ["Schema"], [T.VARCHAR],
+            [(s,) for s in conn.metadata.list_schemas()])
+
+    def _show_columns(self, stmt: t.ShowColumns) -> MaterializedResult:
+        qname = self._resolve(stmt.table)
+        conn = self.catalogs.get(qname.catalog)
+        handle = conn.metadata.get_table_handle(qname.schema_table)
+        if handle is None:
+            raise SemanticError(f"table not found: {qname}")
+        meta = conn.metadata.get_table_metadata(handle)
+        return MaterializedResult(
+            ["Column", "Type"], [T.VARCHAR, T.VARCHAR],
+            [(c.name, c.type.display()) for c in meta.columns])
+
+
+def _literal_value(e: t.Expression):
+    if isinstance(e, t.StringLiteral):
+        return e.value
+    if isinstance(e, t.LongLiteral):
+        return e.value
+    if isinstance(e, t.BooleanLiteral):
+        return e.value
+    if isinstance(e, t.DoubleLiteral):
+        return e.value
+    raise SemanticError("SET SESSION value must be a literal")
+
+
+def _format_fragments(frag, indent: int = 0) -> str:
+    pad = " " * indent
+    lines = [f"{pad}Fragment {frag.fragment_id} [{frag.partitioning}]"]
+    for line in format_plan(frag.root).splitlines():
+        lines.append(pad + "  " + line)
+    for child in frag.children:
+        lines.append(_format_fragments(child, indent + 2))
+    return "\n".join(lines)

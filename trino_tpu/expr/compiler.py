@@ -47,10 +47,12 @@ def _lit_column(lit: Literal) -> Column:
         return Column(jnp.zeros((), dtype=typ.dtype),
                       jnp.zeros((), dtype=jnp.bool_), typ, None)
     if T.is_string(typ):
-        # bare string literal with no dictionary context; comparisons fold it
-        # against the other side's dictionary before this is ever materialized
-        raise NotImplementedError(
-            "free-standing string literal needs dictionary context")
+        # projected string literal: singleton dictionary, every row code 0
+        # (comparisons never reach here — they fold against the column's
+        # dictionary first)
+        import numpy as np
+        d = Dictionary(np.asarray([lit.value], dtype=object))
+        return Column(jnp.zeros((), dtype=jnp.int32), None, typ, d)
     value = lit.value
     if isinstance(typ, T.DecimalType):
         # literals carried as ints already scaled by the frontend
@@ -288,6 +290,11 @@ def _if_merge(cond: Column, then: Column, els: Column, out_type) -> Column:
     take_then = cond.values
     if cond.valid is not None:
         take_then = take_then & cond.valid
+    if (then.dictionary is not None and els.dictionary is not None
+            and then.dictionary is not els.dictionary):
+        # distinct string pools (e.g. CASE emitting literals): union the
+        # pools at trace time and remap both sides' codes
+        then, els = _merge_dictionaries(then, els)
     values = jnp.where(take_then, then.values, els.values)
     if then.valid is None and els.valid is None:
         valid = None
@@ -295,12 +302,23 @@ def _if_merge(cond: Column, then: Column, els: Column, out_type) -> Column:
         tv = then.valid if then.valid is not None else jnp.ones((), jnp.bool_)
         ev = els.valid if els.valid is not None else jnp.ones((), jnp.bool_)
         valid = jnp.where(take_then, tv, ev)
-    if (then.dictionary is not None and els.dictionary is not None
-            and then.dictionary is not els.dictionary):
-        raise NotImplementedError("IF/CASE over distinct dictionaries")
     dictionary = then.dictionary if then.dictionary is not None \
         else els.dictionary
     return Column(values, valid, out_type, dictionary)
+
+
+def _merge_dictionaries(a: Column, b: Column):
+    """Rebase two dictionary columns onto one union pool (host-side, static)."""
+    import numpy as np
+    merged = Dictionary(np.unique(np.concatenate(
+        [a.dictionary.values, b.dictionary.values])))
+    ra = jnp.asarray(np.searchsorted(merged.values, a.dictionary.values)
+                     .astype(np.int32))
+    rb = jnp.asarray(np.searchsorted(merged.values, b.dictionary.values)
+                     .astype(np.int32))
+    a2 = Column(jnp.take(ra, a.values, mode="clip"), a.valid, a.type, merged)
+    b2 = Column(jnp.take(rb, b.values, mode="clip"), b.valid, b.type, merged)
+    return a2, b2
 
 
 def _kleene_and(args, out_type) -> Column:

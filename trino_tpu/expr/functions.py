@@ -427,12 +427,16 @@ def _dict_cache(d: Dictionary) -> Dict:
     return cache
 
 
-def dictionary_table(d: Dictionary, key, fn) -> jnp.ndarray:
-    """Memoized host map over the string pool -> device array (index by code)."""
+def dictionary_table(d: Dictionary, key, fn) -> np.ndarray:
+    """Memoized host map over the string pool, indexed by code.
+
+    Cached as HOST numpy (jnp.asarray under an active jit trace would cache a
+    tracer and poison later traces); jnp ops at the use sites embed it as a
+    compile-time constant per trace.
+    """
     cache = _dict_cache(d)
     if key not in cache:
-        table = np.asarray([fn(s) for s in d.values])
-        cache[key] = jnp.asarray(table)
+        cache[key] = np.asarray([fn(s) for s in d.values])
     return cache[key]
 
 
@@ -473,5 +477,6 @@ def transform_dictionary(d: Dictionary, key, fn) -> Tuple[Dictionary, jnp.ndarra
         transformed = np.asarray([fn(s) for s in d.values], dtype=object)
         new_vals, remap = np.unique(transformed, return_inverse=True)
         nd = Dictionary(new_vals)
-        cache[ck] = (nd, jnp.asarray(remap.astype(np.int32)))
+        # host numpy, not jnp: see dictionary_table
+        cache[ck] = (nd, remap.astype(np.int32))
     return cache[ck]

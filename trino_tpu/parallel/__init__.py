@@ -1,0 +1,14 @@
+"""Distributed execution over a TPU mesh.
+
+Reference parity: Trino's data plane (execution/buffer/ + ExchangeClient +
+PartitionedOutputOperator, SURVEY §2.8/§2.11) re-designed TPU-first: instead
+of serialized pages pulled over HTTP, stages run as shard_map programs over a
+jax.sharding.Mesh and REMOTE exchanges lower to ICI collectives —
+  FIXED_HASH_DISTRIBUTION  -> radix bucketing + all_to_all
+  FIXED_BROADCAST          -> all_gather
+  SINGLE / gather          -> all_gather (+ shard-0 read)
+"""
+
+from trino_tpu.parallel.mesh import QueryMesh  # noqa: F401
+from trino_tpu.parallel.exchange import (  # noqa: F401
+    all_to_all_by_key, broadcast_page, gather_page)

@@ -1,0 +1,159 @@
+"""Collective exchanges: the shuffle data plane as ICI collectives.
+
+Reference parity (SURVEY §2.8): PartitionedOutputOperator + OutputBuffer +
+HttpPageBufferClient + ExchangeClient — all replaced by in-program
+collectives. These functions run INSIDE a shard_map over QueryMesh.AXIS:
+
+  all_to_all_by_key : FIXED_HASH_DISTRIBUTION repartition. Rows are radix-
+                      bucketed by key hash, compacted per destination, and
+                      exchanged with lax.all_to_all. Fixed per-peer bucket
+                      capacity keeps shapes static; the returned overflow
+                      count is psum'd so the host can re-run with a larger
+                      bucket (same contract as the join/page capacity ladder).
+  broadcast_page    : FIXED_BROADCAST — all_gather the build side.
+  gather_page       : SINGLE distribution — all_gather + shard-0 consumption
+                      (coordinator-only stages read one replica).
+
+Hash function matches ops/join._mix64 (splitmix64) so co-partitioned joins
+land build/probe rows of one key on one shard.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trino_tpu.ops.join import _key_u64, _mix64
+from trino_tpu.page import Column, Page
+
+AXIS = "workers"
+
+
+def _partition_of(page: Page, key_channels: Sequence[int],
+                  n_parts: int) -> jnp.ndarray:
+    key, is_null = _key_u64(page, key_channels)
+    part = (_mix64(key) % jnp.uint64(n_parts)).astype(jnp.int32)
+    # null keys route to shard 0 (they never match joins/group as equals is
+    # handled downstream; they just need a deterministic home)
+    part = jnp.where(is_null, 0, part)
+    return jnp.where(page.row_mask(), part, n_parts)  # dead rows -> dropped
+
+
+def all_to_all_by_key(page: Page, key_channels: Sequence[int],
+                      bucket_capacity: int, axis: str = AXIS
+                      ) -> Tuple[Page, jnp.ndarray]:
+    """Hash-repartition rows across the mesh axis.
+
+    Returns (page_of_rows_now_owned_by_this_shard, global_overflow_count).
+    Overflow > 0 means some source shard had more than bucket_capacity rows
+    for one destination; the host re-runs the stage with a bigger bucket.
+    """
+    n = jax.lax.psum(1, axis)
+    part = _partition_of(page, key_channels, n)
+
+    # stable sort rows by destination, then slot rows into per-destination
+    # fixed-capacity buckets: position within bucket = rank within partition
+    order = jnp.argsort(part, stable=True)
+    part_sorted = jnp.take(part, order)
+    idx = jnp.arange(page.capacity, dtype=jnp.int32)
+    # rank within run of equal destinations
+    start_of_run = jnp.searchsorted(part_sorted, jnp.arange(
+        n + 1, dtype=part_sorted.dtype))
+    rank = idx - jnp.take(start_of_run,
+                          part_sorted.astype(jnp.int32).clip(0, n))
+    counts = jnp.diff(start_of_run)  # rows per destination
+    overflow_local = jnp.sum(jnp.maximum(counts - bucket_capacity, 0))
+
+    live = (part_sorted < n) & (rank < bucket_capacity)
+    slot = part_sorted.astype(jnp.int32).clip(0, n - 1) * bucket_capacity + \
+        jnp.minimum(rank, bucket_capacity - 1)
+    # dead/overflow rows must not clobber occupied slots: send them
+    # out-of-bounds where scatter mode="drop" discards them
+    slot = jnp.where(live, slot, n * bucket_capacity)
+
+    send_rows = jnp.take(order, idx)  # row index per sorted position
+
+    def scatter_col(col: Column) -> Column:
+        vals = jnp.take(col.values, send_rows)
+        buf = jnp.zeros((n * bucket_capacity,), dtype=col.values.dtype)
+        buf = buf.at[slot].set(vals, mode="drop")
+        valid_buf = jnp.zeros((n * bucket_capacity,), dtype=jnp.bool_)
+        src_valid = live
+        if col.valid is not None:
+            src_valid = live & jnp.take(col.valid, send_rows)
+        valid_buf = valid_buf.at[slot].set(src_valid, mode="drop")
+        return Column(buf, valid_buf, col.type, col.dictionary)
+
+    # occupancy mask rides as an extra column so receivers know live rows
+    occ = jnp.zeros((n * bucket_capacity,), dtype=jnp.bool_)
+    occ = occ.at[slot].set(live, mode="drop")
+
+    cols = [scatter_col(c) for c in page.columns]
+
+    def a2a(x):
+        return jax.lax.all_to_all(
+            x.reshape(n, bucket_capacity, *x.shape[1:]), axis,
+            split_axis=0, concat_axis=0).reshape(n * bucket_capacity,
+                                                 *x.shape[1:])
+
+    occ_recv = a2a(occ)
+    out_cols = []
+    for c in cols:
+        vals = a2a(c.values)
+        valid = a2a(c.valid) & occ_recv
+        out_cols.append(Column(vals, valid if c.valid is not None else None,
+                               c.type, c.dictionary))
+
+    # compact received rows to a dense prefix so downstream operators see a
+    # normal page (live rows first, num_rows scalar)
+    perm = jnp.argsort(~occ_recv, stable=True)
+    num = jnp.sum(occ_recv).astype(jnp.int32)
+    out_cols = [Column(jnp.take(c.values, perm),
+                       None if c.valid is None else jnp.take(c.valid, perm),
+                       c.type, c.dictionary)
+                for c in out_cols]
+    out = Page(tuple(out_cols), num)
+    total_overflow = jax.lax.psum(overflow_local, axis)
+    return out, total_overflow
+
+
+def broadcast_page(page: Page, axis: str = AXIS) -> Page:
+    """Replicate every shard's rows to all shards (build-side broadcast).
+
+    Output capacity = n * input capacity; rows keep their liveness via the
+    row-count scalar recomputed from per-shard counts.
+    """
+    n = jax.lax.psum(1, axis)
+    my_rows = page.num_rows
+
+    def gather(x):
+        g = jax.lax.all_gather(x, axis)  # (n, cap, ...)
+        return g.reshape(n * x.shape[0], *x.shape[1:])
+
+    rows_per_shard = jax.lax.all_gather(my_rows, axis)  # (n,)
+    cap = page.capacity
+    idx = jnp.arange(n * cap, dtype=jnp.int32)
+    shard_of = idx // cap
+    within = idx % cap
+    live = within < jnp.take(rows_per_shard, shard_of)
+    cols = []
+    for c in page.columns:
+        vals = gather(c.values)
+        valid = None
+        if c.valid is not None:
+            valid = gather(c.valid) & live
+        cols.append(Column(vals, valid, c.type, c.dictionary))
+    # compact live rows to the front
+    perm = jnp.argsort(~live, stable=True)
+    cols = [Column(jnp.take(c.values, perm),
+                   None if c.valid is None else jnp.take(c.valid, perm),
+                   c.type, c.dictionary) for c in cols]
+    return Page(tuple(cols), jnp.sum(rows_per_shard).astype(jnp.int32))
+
+
+def gather_page(page: Page, axis: str = AXIS) -> Page:
+    """SINGLE distribution: every shard receives all rows; the host reads
+    shard 0's replica (coordinator-only consumption)."""
+    return broadcast_page(page, axis)

@@ -1,0 +1,78 @@
+"""Query mesh: device topology + sharded page placement.
+
+Reference parity: the scheduler's node topology (NodeScheduler/
+InternalNodeManager) collapses, TPU-first, into a jax.sharding.Mesh with a
+single 'workers' axis; split->node assignment (SURVEY §2.10 'source
+parallelism') becomes host pages placed shard-by-shard onto the mesh.
+Multi-host pods extend the same mesh across processes (single-controller
+JAX); DCN boundaries stay outside this module.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from trino_tpu.page import Column, Page
+
+
+class QueryMesh:
+    """One query-engine worker per device along axis 'workers'."""
+
+    AXIS = "workers"
+
+    def __init__(self, devices: Optional[Sequence] = None):
+        devices = list(devices if devices is not None else jax.devices())
+        self.mesh = Mesh(np.array(devices), (self.AXIS,))
+        self.n = len(devices)
+
+    # ---------------------------------------------------------- placement
+
+    def replicated(self, tree):
+        spec = NamedSharding(self.mesh, P())
+        return jax.device_put(tree, spec)
+
+    def shard_pages(self, pages: List[Page]) -> Page:
+        """Stack n per-worker pages into one global Page whose leading axis is
+        sharded over the mesh (the split->node assignment step)."""
+        assert len(pages) == self.n, f"need {self.n} pages, got {len(pages)}"
+        sharding = NamedSharding(self.mesh, P(self.AXIS))
+
+        def stack(*leaves):
+            stacked = jnp.stack(leaves)
+            return jax.device_put(stacked, sharding)
+
+        return jax.tree_util.tree_map(stack, *pages)
+
+    def shard_map(self, fn: Callable, *, in_specs=None, out_specs=None,
+                  check_rep: bool = False) -> Callable:
+        """Wrap fn as a per-shard program over the mesh (one Trino 'task'
+        per device; collectives inside fn are the exchange data plane).
+
+        Inputs stacked by shard_pages arrive as (1, ...) blocks per shard;
+        fn sees them squeezed to per-worker shapes and its outputs are
+        re-expanded so the global result keeps the sharded leading axis.
+        """
+        in_specs = in_specs if in_specs is not None else P(self.AXIS)
+        out_specs = out_specs if out_specs is not None else P(self.AXIS)
+
+        def wrapped(*args):
+            squeezed = jax.tree_util.tree_map(
+                lambda x: jnp.squeeze(x, axis=0), args)
+            out = fn(*squeezed)
+            return jax.tree_util.tree_map(
+                lambda x: jnp.expand_dims(x, axis=0), out)
+
+        return shard_map(wrapped, mesh=self.mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=check_rep)
+
+    def unshard(self, tree):
+        """Fetch a sharded tree to host as per-shard list (axis 0)."""
+        gathered = jax.device_get(tree)
+        return gathered

@@ -246,6 +246,15 @@ class LogicalPlanner:
 
         kind = {"INNER": JoinKind.INNER, "LEFT": JoinKind.LEFT,
                 "RIGHT": JoinKind.RIGHT, "FULL": JoinKind.FULL}[rel.join_type]
+        swapped = False
+        if kind == JoinKind.RIGHT:
+            # normalize RIGHT to LEFT by swapping inputs (Trino AstBuilder
+            # keeps RIGHT; its LocalExecutionPlanner flips — we flip early).
+            # join_scope above was built pre-swap, preserving SELECT * order;
+            # the USING branch below rebuilds fields orientation-aware.
+            left, right = right, left
+            kind = JoinKind.LEFT
+            swapped = True
 
         criteria: List[JoinClause] = []
         residual: List[RowExpression] = []
@@ -270,14 +279,40 @@ class LogicalPlanner:
                     right, rsym2 = self._append_projection(right, rx)
                 criteria.append(JoinClause(lsym2, rsym2))
                 using_cols.append(name)
-            # USING scope: shared column appears once (left side)
-            fields = (left.scope.fields +
-                      [f for f in right.scope.fields
+            # USING scope: join columns once, then remaining columns of the
+            # ORIGINAL left, then remaining right (Trino output order; the
+            # key value comes from the preserved/probe side = post-swap left)
+            key_fields = [f for f in left.scope.fields
+                          if f.name in using_cols]
+            first, second = (right, left) if swapped else (left, right)
+            fields = (key_fields +
+                      [f for f in first.scope.fields
+                       if f.name not in using_cols] +
+                      [f for f in second.scope.fields
                        if f.name not in using_cols])
             join_scope = Scope(fields, outer)
         elif isinstance(rel.criteria, t.JoinOn):
             criteria, residual, left, right = self._extract_equi_criteria(
                 rel.criteria.expression, left, right, join_scope)
+        if kind == JoinKind.LEFT and residual:
+            # ON conditions over the build side only restrict which build
+            # rows can match -> pre-filter the build side (outer semantics
+            # preserved). Mixed-side non-equi LEFT conditions need operator
+            # filter support (tracked; q21-class queries).
+            right_syms = {f.symbol.name for f in right.scope.fields}
+            kept = []
+            for p in residual:
+                syms = _symbols_in(p)
+                if syms and syms <= right_syms:
+                    right = RelationPlan(FilterNode(right.node, p),
+                                         right.scope)
+                else:
+                    kept.append(p)
+            residual = kept
+            if residual:
+                raise SemanticError(
+                    "LEFT JOIN with non-equi conditions across both sides "
+                    "is not supported yet")
         node = JoinNode(kind, left.node, right.node, tuple(criteria),
                         combine_conjuncts(residual) if residual else None)
         return RelationPlan(node, Scope(join_scope.fields, outer))
@@ -621,7 +656,8 @@ class _PlanBuilder:
             key = tr.aggregate_key(fc)
             self.substitutions[key] = out_sym
 
-        self.node = ProjectNode(self.node, tuple(pre_assigns))
+        if pre_assigns:  # count(*) with no keys needs no pre-projection
+            self.node = ProjectNode(self.node, tuple(pre_assigns))
 
         group_symbols = tuple(key_syms[e] for e in uniq)
         if not simple and grouping_sets:

@@ -69,6 +69,11 @@ class Scope:
 def cast_to(expr: RowExpression, target: T.Type) -> RowExpression:
     if expr.type == target:
         return expr
+    # varchar(n) length coercions are representation no-ops (dictionary
+    # codes / host strings carry no length) — and a cast Call would defeat
+    # the compiler's dictionary-folded string comparisons
+    if T.is_string(expr.type) and T.is_string(target):
+        return expr
     if isinstance(expr, Literal) and expr.value is None:
         return Literal(None, target)
     # fold literal int -> decimal casts at plan time (LiteralEncoder analog)
