@@ -166,7 +166,10 @@ def test_in_between_coalesce_nullif():
     assert run(e_bt, page) == [True, True, None]
     e_co = SpecialForm(SpecialKind.COALESCE, (col, Literal(-1, T.BIGINT)), T.BIGINT)
     assert run(e_co, page) == [1, 5, -1]
-    e_nullif = SpecialForm(SpecialKind.NULLIF, (col, Literal(5, T.BIGINT)), T.BIGINT)
+    # NULLIF lowers to IF(a = b, null, a) at translation time
+    e_nullif = SpecialForm(SpecialKind.IF, (
+        Call("eq", (col, Literal(5, T.BIGINT)), T.BOOLEAN),
+        Literal(None, T.BIGINT), col), T.BIGINT)
     assert run(e_nullif, page) == [1, None, None]
 
 

@@ -397,3 +397,50 @@ FROM (SELECT substr(c_phone, 1, 2) AS cntrycode, c_acctbal
      AS custsale
 GROUP BY cntrycode ORDER BY cntrycode"""
     check(runner, oracle, engine, o, ordered=True)
+
+
+# ----------------------------------------------- round-2 regression fixes
+
+def test_correlated_count_subquery_zero(runner, oracle):
+    # count over an empty correlated group must be 0, not NULL
+    # (TransformCorrelatedScalarAggregationToJoin semantics)
+    check(runner, oracle,
+          "SELECT n_name, (SELECT count(*) FROM supplier"
+          " WHERE s_nationkey = n_nationkey) FROM nation")
+
+
+def test_correlated_count_in_predicate(runner, oracle):
+    check(runner, oracle,
+          "SELECT n_name FROM nation WHERE "
+          "(SELECT count(*) FROM supplier WHERE s_nationkey = n_nationkey)"
+          " = 0")
+
+
+def test_exists_with_having_rejected(runner):
+    import pytest as _pytest
+    from trino_tpu.sql.analyzer import SemanticError
+    with _pytest.raises(SemanticError):
+        runner.execute(
+            "SELECT n_name FROM nation WHERE EXISTS (SELECT s_nationkey "
+            "FROM supplier WHERE s_nationkey = n_nationkey "
+            "GROUP BY s_nationkey HAVING count(*) > 5)")
+
+
+def test_union_mixed_dictionaries_sorted(runner, oracle):
+    # varchar columns from different tables have different dictionaries;
+    # the union must re-encode before the blocking sort
+    check(runner, oracle,
+          "SELECT name FROM (SELECT n_name AS name FROM nation "
+          "UNION ALL SELECT r_name AS name FROM region) t ORDER BY name",
+          ordered=True)
+
+
+def test_union_mixed_dictionaries_groupby(runner, oracle):
+    check(runner, oracle,
+          "SELECT name, count(*) FROM (SELECT n_name AS name FROM nation "
+          "UNION ALL SELECT r_name AS name FROM region) t GROUP BY name")
+
+
+def test_nullif_keeps_first_arg_type(runner):
+    out = runner.execute("SELECT NULLIF(1, 1), NULLIF(2, 3)")
+    assert out.rows == [(None, 2)]

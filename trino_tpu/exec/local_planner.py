@@ -544,17 +544,41 @@ class LocalExecutionPlanner:
         return PageStream(gen(), node.outputs)
 
     def _exec_UnionNode(self, node: UnionNode) -> PageStream:
+        nsyms = len(node.symbols)
+
         def gen():
+            # start every child and peek one page each: string columns from
+            # different tables carry different dictionaries, and blocking
+            # consumers (sort/agg/join build) concat across children — so
+            # re-encode onto a shared union dictionary. Pages of one child
+            # stream share a per-column dictionary, so one peek suffices.
+            children = []
             for j, child in enumerate(node.children):
                 stream = self.execute(child)
                 lay, _ = _layout(stream.symbols)
-                order = [lay[node.mappings[i][j].name]
-                         for i in range(len(node.symbols))]
-                for page in stream.pages:
+                order = [lay[node.mappings[i][j].name] for i in range(nsyms)]
+                it = iter(stream.pages)
+                first = next(it, None)
+                children.append([it, first, order])
+            remaps = _union_dictionary_remaps(node.symbols, children)
+            for it, first, order in children:
+                for page in _chain_first(first, it):
                     if int(page.num_rows) == 0:
                         continue
-                    cols = tuple(page.column(ch) for ch in order)
-                    yield Page(cols, page.num_rows)
+                    cols = []
+                    for i, ch in enumerate(order):
+                        col = page.column(ch)
+                        remap = remaps[i].get(id(col.dictionary)) \
+                            if remaps[i] else None
+                        if remap is not None:
+                            table, union_dict = remap
+                            codes = jnp.take(table,
+                                             jnp.clip(col.values, 0),
+                                             mode="clip")
+                            col = Column(codes, col.valid, col.type,
+                                         union_dict)
+                        cols.append(col)
+                    yield Page(tuple(cols), page.num_rows)
         return PageStream(gen(), node.symbols)
 
     def _exec_ExchangeNode(self, node: ExchangeNode) -> PageStream:
@@ -601,6 +625,35 @@ class LocalExecutionPlanner:
                          None, T.BIGINT, None)
             yield Page((col,), 1)
         return PageStream(gen(), node.outputs)
+
+
+def _chain_first(first: Optional[Page], rest: Iterator[Page]) -> Iterator[Page]:
+    if first is not None:
+        yield first
+    yield from rest
+
+
+def _union_dictionary_remaps(symbols, children):
+    """Per output column: None when all children already share a dictionary,
+    else {id(child_dict): (code_remap_device_array, union_dictionary)}."""
+    from trino_tpu.page import union_dictionaries
+    remaps: List[Optional[Dict[int, tuple]]] = []
+    for i, sym in enumerate(symbols):
+        dicts = []
+        for it, first, order in children:
+            if first is None:
+                continue
+            d = first.column(order[i]).dictionary
+            if d is not None:
+                dicts.append(d)
+        uniq = {id(d): d for d in dicts}
+        if len(uniq) <= 1:
+            remaps.append(None)
+            continue
+        union, tables = union_dictionaries(list(uniq.values()))
+        remaps.append({did: (tbl, union)
+                       for did, tbl in zip(uniq, tables)})
+    return remaps
 
 
 def _valid_arr(valid: List[bool], cap: int) -> Optional[jnp.ndarray]:

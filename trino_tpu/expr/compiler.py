@@ -273,15 +273,6 @@ def _eval_special(expr: SpecialForm, page: Page) -> Column:
             Call("ge", (value, low), T.BOOLEAN),
             Call("le", (value, high), T.BOOLEAN)), T.BOOLEAN)
         return _eval(conj, page)
-    if kind is SpecialKind.NULLIF:
-        a = _eval(expr.args[0], page)
-        b_eq = _eval(Call("eq", (expr.args[0], expr.args[1]), T.BOOLEAN), page)
-        equal = b_eq.values
-        if b_eq.valid is not None:
-            equal = equal & b_eq.valid
-        base_valid = a.valid if a.valid is not None else jnp.ones((), jnp.bool_)
-        valid = jnp.broadcast_to(base_valid & ~equal, jnp.shape(equal))
-        return Column(a.values, valid, expr.type, a.dictionary)
     raise TypeError(f"unknown special form: {kind}")
 
 
@@ -309,13 +300,8 @@ def _if_merge(cond: Column, then: Column, els: Column, out_type) -> Column:
 
 def _merge_dictionaries(a: Column, b: Column):
     """Rebase two dictionary columns onto one union pool (host-side, static)."""
-    import numpy as np
-    merged = Dictionary(np.unique(np.concatenate(
-        [a.dictionary.values, b.dictionary.values])))
-    ra = jnp.asarray(np.searchsorted(merged.values, a.dictionary.values)
-                     .astype(np.int32))
-    rb = jnp.asarray(np.searchsorted(merged.values, b.dictionary.values)
-                     .astype(np.int32))
+    from trino_tpu.page import union_dictionaries
+    merged, (ra, rb) = union_dictionaries([a.dictionary, b.dictionary])
     a2 = Column(jnp.take(ra, a.values, mode="clip"), a.valid, a.type, merged)
     b2 = Column(jnp.take(rb, b.values, mode="clip"), b.valid, b.type, merged)
     return a2, b2

@@ -86,7 +86,6 @@ class SpecialKind(enum.Enum):
     IN = "in"            # args: needle, value1..valueN (literals or exprs)
     BETWEEN = "between"  # args: value, low, high
     SWITCH = "switch"    # searched CASE: [cond1, val1, ..., condN, valN, default]
-    NULLIF = "nullif"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -282,6 +282,19 @@ class Page:
         return [tuple(col[i] for col in cols) for i in range(n)]
 
 
+def union_dictionaries(dicts: Sequence[Dictionary]
+                       ) -> Tuple[Dictionary, list]:
+    """Rebase N dictionaries onto one union pool.
+
+    Returns (union_dictionary, [int32 device remap array per input dict]):
+    new_code = remap[i][old_code]. Host-side, static — callers cache per
+    dictionary identity (DictionaryBlock 'compact to shared pool' analog)."""
+    union = Dictionary(np.unique(np.concatenate([d.values for d in dicts])))
+    remaps = [jnp.asarray(np.searchsorted(union.values, d.values)
+                          .astype(np.int32)) for d in dicts]
+    return union, remaps
+
+
 def concat_pages(pages: Sequence[Page]) -> Page:
     """Host-side page concatenation (not jit-safe; used at stage boundaries)."""
     if not pages:

@@ -738,13 +738,19 @@ class _PlanBuilder:
 
     # -------------------------------------------------------------- SELECT
 
-    def plan_select(self, select_items) -> List[Field]:
+    def plan_select(self, select_items, keep: Sequence[Symbol] = ()
+                    ) -> List[Field]:
+        """Project the select items; `keep` carries extra symbols (e.g.
+        decorrelation join keys) through the projection."""
         tr = self.translator()
-        available = {s.name for s in self.node.outputs}
-        assigns: List[Tuple[Symbol, RowExpression]] = []
+        assigns: List[Tuple[Symbol, RowExpression]] = [
+            (s, s.ref()) for s in keep]
         fields: List[Field] = []
         for expr_ast, name in select_items:
             rx = tr.translate(expr_ast)
+            # recompute after translate: select-list subqueries join extra
+            # sources onto self.node as a translation side effect
+            available = {s.name for s in self.node.outputs}
             missing = _symbols_in(rx) - available
             if missing:
                 raise SemanticError(
@@ -872,8 +878,8 @@ class _PlanBuilder:
         (TransformCorrelatedScalarAggregationToJoin)."""
         spec = query.body
         if not isinstance(spec, t.QuerySpecification) or query.with_ or \
-                spec.group_by or spec.limit or spec.order_by or \
-                spec.from_ is None:
+                spec.group_by or spec.having or spec.limit or spec.offset \
+                or spec.order_by or spec.from_ is None:
             return None
         split = self._split_correlation(spec)
         if split is None or not split[0]:
@@ -891,14 +897,24 @@ class _PlanBuilder:
                 if is_aggregate(fc.name.suffix)]
         if len(aggs) == 0:
             return None
+        # count-like aggregates yield 0 (not NULL) over an empty group; the
+        # pre-aggregate-then-LEFT-join shape null-extends unmatched outer
+        # rows, so a bare count must be COALESCE'd after the join. A count
+        # buried in a larger select expression would need post-join
+        # re-projection (the reference aggregates after the join instead) —
+        # bail to the fail-loud path rather than return wrong results.
+        _COUNT_LIKE = ("count", "count_if", "approx_distinct")
+        has_count = any(fc.name.suffix.lower() in _COUNT_LIKE for fc in aggs)
+        bare_agg = len(aggs) == 1 and items[0][0] is aggs[0]
+        if has_count and not bare_agg:
+            return None
         # inner grouping keys = inner sides of the correlation equalities
         inner_tr = ib.translator()
         inner_keys = [inner_tr.translate(ast) for _, ast in corr_pairs]
-        group_elements = ()
         # manually build aggregation grouped by correlation keys
         ib.plan_aggregation_with_keys(inner_keys, aggs, items)
-        out_fields = ib.plan_select(items)
         key_syms = ib.group_key_symbols
+        out_fields = ib.plan_select(items, keep=key_syms)
         # LEFT join outer plan to the aggregated inner on the keys; the outer
         # side is cast to the inner key type (keys come from the same column
         # family in practice, so inner-type wins)
@@ -917,7 +933,13 @@ class _PlanBuilder:
         self.node = JoinNode(JoinKind.LEFT, probe.node, build,
                              tuple(criteria))
         self._scope = Scope(probe.scope.fields, self._scope.parent)
-        return out_fields[0].symbol.ref()
+        out = out_fields[0].symbol.ref()
+        if has_count:
+            # TransformCorrelatedScalarAggregationToJoin semantics: outer
+            # rows with no matching inner rows see count(...) = 0
+            out = SpecialForm(SpecialKind.COALESCE,
+                              (out, Literal(0, out.type)), out.type)
+        return out
 
     def _split_correlation(self, spec: t.QuerySpecification):
         """WHERE -> ([(outer_ast, inner_ast)], local_where_ast or None).
@@ -988,8 +1010,10 @@ class _PlanBuilder:
         if isinstance(e, t.ComparisonExpression) and e.op == "=":
             ls = self._classify(e.left, probe)
             rs = self._classify(e.right, probe)
-            if {ls, rs} == {"local", "outer_only"} or (
-                    ls == "local") != (rs == "local"):
+            # only a clean inner=outer split is a correlation key; a mixed
+            # side (references both scopes) would silently rebind an
+            # unqualified inner column against the outer scope
+            if {ls, rs} == {"local", "outer_only"}:
                 return "corr_eq"
         if not refs_inner:
             return "outer_only"
@@ -999,6 +1023,17 @@ class _PlanBuilder:
         spec = query.body
         if not isinstance(spec, t.QuerySpecification) or spec.from_ is None:
             raise SemanticError("unsupported EXISTS subquery")
+        # GROUP BY / HAVING / LIMIT / aggregates change EXISTS cardinality
+        # semantics (e.g. HAVING count(*) > 5, LIMIT 0, global agg always
+        # emitting one row); the translation below would silently drop them
+        if spec.group_by or spec.having or spec.limit or spec.offset or any(
+                is_aggregate(fc.name.suffix)
+                for fc in _find_calls([i.expression
+                                       for i in spec.select.items
+                                       if isinstance(i, t.SingleColumn)])):
+            raise SemanticError(
+                "EXISTS subquery with GROUP BY/HAVING/LIMIT/OFFSET/"
+                "aggregates not supported")
         split = self._split_correlation(spec)
         if split is None:
             raise SemanticError(

@@ -278,13 +278,17 @@ class ExpressionTranslator:
             args = tuple(cast_to(a, ct) for a in args)
             return SpecialForm(SpecialKind.COALESCE, args, ct)
         if isinstance(node, t.NullIfExpression):
+            # Trino contract: the comparison runs at the common type but the
+            # result keeps the FIRST argument's type and (uncast) value, so
+            # the IR type always agrees with the produced dtype
             a = self._translate(node.first)
             b = self._translate(node.second)
             ct = common_type(a.type, b.type)
             if ct is None:
                 raise SemanticError("NULLIF argument types differ")
-            return SpecialForm(SpecialKind.NULLIF,
-                               (cast_to(a, ct), cast_to(b, ct)), a.type)
+            cond = Call("eq", (cast_to(a, ct), cast_to(b, ct)), T.BOOLEAN)
+            return SpecialForm(SpecialKind.IF,
+                               (cond, Literal(None, a.type), a), a.type)
         # ----------------------------------------------------------- casts
         if isinstance(node, t.Cast):
             a = self._translate(node.value)
