@@ -1,13 +1,15 @@
-"""Benchmark: TPC-H q6 at SF1 end-to-end wall-clock on the real chip.
+"""Benchmark: TPC-H SF1 end-to-end wall-clock on the real chip.
 
-Measurement ladder config (BASELINE.md): tiny-q6 smoke is covered by tests;
-this times SF1 q6 through the full engine (parse -> plan -> optimize ->
-execute, host paging + device kernels). Prints ONE JSON line.
+Measurement ladder (BASELINE.md): configs 1-3 — q6 (scan+filter+agg), q1
+(lineitem hash aggregation), q3 (3-way join customer x orders x lineitem) at
+SF1 through the full engine (parse -> plan -> optimize -> execute). Prints
+ONE JSON line; the headline metric stays q6 SF1 wall-clock, with the other
+ladder rungs in "extra".
 
 vs_baseline: the reference repo publishes no numbers (BASELINE.md); the
-denominator used here is 1.0 s — the ballpark single-node Trino q6 SF1
-wall-clock its LocalQueryRunner benchmarks show on server CPUs — so
-vs_baseline > 1 means faster than that estimate.
+denominator is 1.0 s — the ballpark single-node Trino q6 SF1 wall-clock its
+LocalQueryRunner benchmarks show on server CPUs — so vs_baseline > 1 means
+faster than that estimate.
 """
 
 import json
@@ -22,28 +24,60 @@ WHERE l_shipdate >= DATE '1994-01-01'
   AND l_quantity < 24
 """
 
+Q1 = """
+SELECT l_returnflag, l_linestatus, sum(l_quantity) AS sum_qty,
+       sum(l_extendedprice) AS sum_base_price,
+       sum(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+       avg(l_quantity) AS avg_qty, avg(l_extendedprice) AS avg_price,
+       avg(l_discount) AS avg_disc, count(*) AS count_order
+FROM lineitem
+WHERE l_shipdate <= DATE '1998-12-01' - INTERVAL '90' DAY
+GROUP BY l_returnflag, l_linestatus
+ORDER BY l_returnflag, l_linestatus
+"""
+
+Q3 = """
+SELECT l_orderkey, sum(l_extendedprice * (1 - l_discount)) AS revenue,
+       o_orderdate, o_shippriority
+FROM customer, orders, lineitem
+WHERE c_mktsegment = 'BUILDING' AND c_custkey = o_custkey
+  AND l_orderkey = o_orderkey AND o_orderdate < DATE '1995-03-15'
+  AND l_shipdate > DATE '1995-03-15'
+GROUP BY l_orderkey, o_orderdate, o_shippriority
+ORDER BY revenue DESC, o_orderdate LIMIT 10
+"""
+
 BASELINE_ESTIMATE_S = 1.0
+
+
+def _time_query(runner, sql, iters=3):
+    rows = runner.execute(sql).rows  # warm-up (compile) run, untimed
+    assert rows, "query returned no rows"
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        runner.execute(sql)
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[len(times) // 2]  # median
 
 
 def main():
     from trino_tpu.exec import LocalQueryRunner
 
     runner = LocalQueryRunner.tpch("sf1")
-    # generation + warm-up (compile) run, untimed
-    warm = runner.execute(Q6)
-    assert len(warm.rows) == 1
-
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        out = runner.execute(Q6)
-        times.append(time.perf_counter() - t0)
-    wall = sorted(times)[1]  # median of 3
+    q6 = _time_query(runner, Q6)
+    q1 = _time_query(runner, Q1)
+    q3 = _time_query(runner, Q3)
     print(json.dumps({
         "metric": "tpch_q6_sf1_wall_s",
-        "value": round(wall, 4),
+        "value": round(q6, 4),
         "unit": "s",
-        "vs_baseline": round(BASELINE_ESTIMATE_S / wall, 3),
+        "vs_baseline": round(BASELINE_ESTIMATE_S / q6, 3),
+        "extra": {
+            "tpch_q1_sf1_wall_s": round(q1, 4),
+            "tpch_q3_sf1_wall_s": round(q3, 4),
+        },
     }))
 
 

@@ -444,3 +444,124 @@ def test_union_mixed_dictionaries_groupby(runner, oracle):
 def test_nullif_keeps_first_arg_type(runner):
     out = runner.execute("SELECT NULLIF(1, 1), NULLIF(2, 3)")
     assert out.rows == [(None, 2)]
+
+
+def test_tpch_q9(runner, oracle):
+    # 6-way implicit join: requires cross-join elimination + reordering
+    # (BASELINE ladder config #4; ReorderJoins.java:96 analog)
+    sql = """
+SELECT nation, o_year, sum(amount) AS sum_profit FROM (
+  SELECT n_name AS nation, extract(year FROM o_orderdate) AS o_year,
+         l_extendedprice * (1 - l_discount) - ps_supplycost * l_quantity
+           AS amount
+  FROM part, supplier, lineitem, partsupp, orders, nation
+  WHERE s_suppkey = l_suppkey AND ps_suppkey = l_suppkey
+    AND ps_partkey = l_partkey AND p_partkey = l_partkey
+    AND o_orderkey = l_orderkey AND s_nationkey = n_nationkey
+    AND p_name LIKE '%green%'
+) profit GROUP BY nation, o_year ORDER BY nation, o_year DESC
+"""
+    oracle_sql = """
+SELECT nation, o_year, sum(amount) AS sum_profit FROM (
+  SELECT n_name AS nation,
+         CAST(strftime('%Y', o_orderdate * 86400, 'unixepoch') AS INTEGER)
+           AS o_year,
+         l_extendedprice * (100 - l_discount) - ps_supplycost * l_quantity
+           AS amount
+  FROM part, supplier, lineitem, partsupp, orders, nation
+  WHERE s_suppkey = l_suppkey AND ps_suppkey = l_suppkey
+    AND ps_partkey = l_partkey AND p_partkey = l_partkey
+    AND o_orderkey = l_orderkey AND s_nationkey = n_nationkey
+    AND p_name LIKE '%green%'
+) profit GROUP BY nation, o_year ORDER BY nation, o_year DESC
+"""
+    check(runner, oracle, sql, oracle_sql, ordered=True)
+
+
+def test_join_reorder_no_cross(runner):
+    # the q9 join graph must plan with zero cross joins
+    plan = runner.execute("""EXPLAIN
+SELECT count(*) FROM part, supplier, lineitem, partsupp, orders, nation
+WHERE s_suppkey = l_suppkey AND ps_suppkey = l_suppkey
+  AND ps_partkey = l_partkey AND p_partkey = l_partkey
+  AND o_orderkey = l_orderkey AND s_nationkey = n_nationkey
+""").rows[0][0]
+    assert "cross" not in plan.lower(), plan
+
+
+def test_tpch_q21(runner, oracle):
+    # general correlated EXISTS/NOT EXISTS with non-equality correlation
+    sql = """
+SELECT s_name, count(*) AS numwait
+FROM supplier, lineitem l1, orders, nation
+WHERE s_suppkey = l1.l_suppkey AND o_orderkey = l1.l_orderkey
+  AND o_orderstatus = 'F' AND l1.l_receiptdate > l1.l_commitdate
+  AND EXISTS (SELECT * FROM lineitem l2
+              WHERE l2.l_orderkey = l1.l_orderkey
+                AND l2.l_suppkey <> l1.l_suppkey)
+  AND NOT EXISTS (SELECT * FROM lineitem l3
+                  WHERE l3.l_orderkey = l1.l_orderkey
+                    AND l3.l_suppkey <> l1.l_suppkey
+                    AND l3.l_receiptdate > l3.l_commitdate)
+  AND s_nationkey = n_nationkey AND n_name = 'SAUDI ARABIA'
+GROUP BY s_name ORDER BY numwait DESC, s_name LIMIT 100
+"""
+    check(runner, oracle, sql, sql, ordered=True)
+
+
+# -------------------------------------------------------- window functions
+
+def test_window_ranking(runner, oracle):
+    check(runner, oracle,
+          "SELECT n_name, row_number() OVER (PARTITION BY n_regionkey "
+          "ORDER BY n_name), rank() OVER (PARTITION BY n_regionkey "
+          "ORDER BY n_name), dense_rank() OVER (PARTITION BY n_regionkey "
+          "ORDER BY n_name) FROM nation")
+
+
+def test_window_rank_with_ties(runner, oracle):
+    check(runner, oracle,
+          "SELECT s_suppkey, rank() OVER (ORDER BY s_nationkey), "
+          "dense_rank() OVER (ORDER BY s_nationkey) FROM supplier")
+
+
+def test_window_running_agg(runner, oracle):
+    check(runner, oracle,
+          "SELECT n_name, sum(n_nationkey) OVER (PARTITION BY n_regionkey "
+          "ORDER BY n_name), count(*) OVER (PARTITION BY n_regionkey "
+          "ORDER BY n_name), min(n_name) OVER (PARTITION BY n_regionkey "
+          "ORDER BY n_name), max(n_nationkey) OVER (PARTITION BY "
+          "n_regionkey ORDER BY n_name) FROM nation")
+
+
+def test_window_whole_partition(runner, oracle):
+    check(runner, oracle,
+          "SELECT n_name, sum(n_nationkey) OVER (PARTITION BY n_regionkey), "
+          "count(*) OVER () FROM nation")
+
+
+def test_window_lead_lag(runner, oracle):
+    check(runner, oracle,
+          "SELECT n_name, lead(n_name) OVER (ORDER BY n_name), "
+          "lag(n_name) OVER (ORDER BY n_name), "
+          "lag(n_nationkey, 2) OVER (ORDER BY n_name) FROM nation")
+
+
+def test_window_first_last_value(runner, oracle):
+    check(runner, oracle,
+          "SELECT n_name, first_value(n_name) OVER (PARTITION BY "
+          "n_regionkey ORDER BY n_name), last_value(n_name) OVER "
+          "(PARTITION BY n_regionkey ORDER BY n_name) FROM nation")
+
+
+def test_window_pct_cume_ntile(runner, oracle):
+    check(runner, oracle,
+          "SELECT s_suppkey, percent_rank() OVER (ORDER BY s_nationkey), "
+          "cume_dist() OVER (ORDER BY s_nationkey), "
+          "ntile(3) OVER (ORDER BY s_suppkey) FROM supplier")
+
+
+def test_window_rows_frame(runner, oracle):
+    check(runner, oracle,
+          "SELECT n_name, sum(n_nationkey) OVER (ORDER BY n_name "
+          "ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) FROM nation")

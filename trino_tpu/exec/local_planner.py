@@ -28,6 +28,7 @@ import numpy as np
 
 from trino_tpu import types as T
 from trino_tpu.connector.spi import Split
+from trino_tpu.exec.jit_cache import cached_kernel
 from trino_tpu.expr.compiler import compile_expression, compile_filter
 from trino_tpu.expr.ir import (Call, InputRef, Literal, RowExpression,
                                SpecialForm, SpecialKind, SymbolRef)
@@ -80,8 +81,57 @@ def _next_pow2(n: int) -> int:
 
 @dataclasses.dataclass
 class PageStream:
+    """Stream of pages + a lazy chain of per-page device transforms.
+
+    WorkProcessor-style (operator/WorkProcessor.java:31): streaming operators
+    (filter/project/column-select) don't dispatch device work themselves —
+    they append (cache_key, kernel_builder) entries to `pending`. Consumers
+    drain via iter_pages(), which compiles ONE composed kernel for the whole
+    chain (cached), so a scan->filter->project pipeline is a single XLA
+    program per page, and blocking operators can fuse the chain into their
+    own kernel (ScanFilterAndProjectOperator's fusion, compile-once).
+    """
+
     pages: Iterator[Page]
     symbols: Tuple[Symbol, ...]
+    pending: Tuple[Tuple[object, object], ...] = ()
+
+    def with_op(self, key, builder) -> "PageStream":
+        return PageStream(self.pages, self.symbols,
+                          self.pending + ((key, builder),))
+
+    def iter_pages(self) -> Iterator[Page]:
+        fn = compose_chain(self.pending)
+        if fn is None:
+            yield from self.pages
+        else:
+            for p in self.pages:
+                yield fn(p)
+
+
+def chain_keys(pending) -> Tuple:
+    return tuple(k for k, _ in pending)
+
+
+def compose_chain(pending, tail_key=None, tail_builder=None):
+    """One cached jitted kernel running every pending transform (+ optional
+    tail op, e.g. a partial aggregation) in a single device program."""
+    if not pending and tail_builder is None:
+        return None
+    key = ("chain",) + chain_keys(pending) + \
+        ((tail_key,) if tail_key is not None else ())
+
+    def build():
+        fns = [b() for _, b in pending]
+        if tail_builder is not None:
+            fns.append(tail_builder())
+
+        def run(page):
+            for f in fns:
+                page = f(page)
+            return page
+        return run
+    return cached_kernel(key, build)
 
 
 class LocalExecutionPlanner:
@@ -106,13 +156,30 @@ class LocalExecutionPlanner:
     def _exec_TableScanNode(self, node: TableScanNode) -> PageStream:
         conn = self.metadata.connector(node.catalog)
         columns = [c for _, c in node.assignments]
+        cap = self._scan_capacity(conn, node)
         splits = conn.split_manager.get_splits(node.table, target_splits=1)
 
         def gen():
             for split in splits:
-                yield from conn.page_source.pages(split, columns,
-                                                  self.page_capacity)
+                yield from conn.page_source.pages(split, columns, cap)
         return PageStream(gen(), tuple(s for s, _ in node.assignments))
+
+    def _scan_capacity(self, conn, node: TableScanNode) -> int:
+        """Size scan pages to the table: one big page per split keeps the
+        steady state at a handful of device calls instead of a Python loop
+        over thousands of 64Ki pages (ScanFilterAndProjectOperator's whole
+        point is amortizing per-page overhead; on TPU the analog is fewer,
+        larger fused kernel launches)."""
+        cap = self.page_capacity
+        try:
+            stats = conn.metadata.get_table_statistics(node.table)
+            rows = int(stats.row_count) if stats and stats.row_count else 0
+        except Exception:
+            rows = 0
+        if rows > cap:
+            max_cap = int(self.session.get("scan_page_capacity"))
+            cap = min(_next_pow2(rows), max_cap)
+        return cap
 
     def _exec_ValuesNode(self, node: ValuesNode) -> PageStream:
         cols = []
@@ -155,34 +222,30 @@ class LocalExecutionPlanner:
         src = self.execute(node.source)
         lay, typ = _layout(src.symbols)
         pred = lower_expr(node.predicate, lay, typ)
-        fn = jax.jit(lambda p, f=compile_filter(pred): p.filter(f(p)))
-
-        def gen():
-            for page in src.pages:
-                yield fn(page)
-        return PageStream(gen(), src.symbols)
+        return PageStream(
+            src.pages, src.symbols,
+            src.pending + ((("filter", pred),
+                            lambda: lambda p, f=compile_filter(pred):
+                            p.filter(f(p))),))
 
     def _exec_ProjectNode(self, node: ProjectNode) -> PageStream:
         src = self.execute(node.source)
         lay, typ = _layout(src.symbols)
-        exprs = [lower_expr(e, lay, typ) for _, e in node.assignments]
-        fns = [compile_expression(e) for e in exprs]
+        exprs = tuple(lower_expr(e, lay, typ) for _, e in node.assignments)
 
-        @jax.jit
-        def run(page):
-            return Page(tuple(fn(page) for fn in fns), page.num_rows)
-
-        def gen():
-            for page in src.pages:
-                yield run(page)
-        return PageStream(gen(), tuple(s for s, _ in node.assignments))
+        def builder():
+            fns = [compile_expression(e) for e in exprs]
+            return lambda page: Page(tuple(fn(page) for fn in fns),
+                                     page.num_rows)
+        return PageStream(src.pages, tuple(s for s, _ in node.assignments),
+                          src.pending + ((("project", exprs), builder),))
 
     def _exec_LimitNode(self, node: LimitNode) -> PageStream:
         src = self.execute(node.source)
 
         def gen():
             remaining = node.count
-            for page in src.pages:
+            for page in src.iter_pages():
                 n = int(page.num_rows)
                 if n >= remaining:
                     yield Page(page.columns, remaining)
@@ -196,7 +259,7 @@ class LocalExecutionPlanner:
 
         def gen():
             to_skip = node.count
-            for page in src.pages:
+            for page in src.iter_pages():
                 n = int(page.num_rows)
                 if to_skip >= n:
                     to_skip -= n
@@ -212,7 +275,7 @@ class LocalExecutionPlanner:
     # ------------------------------------------------------------ blocking
 
     def _collect(self, stream: PageStream) -> Optional[Page]:
-        pages = [p for p in stream.pages if int(p.num_rows) > 0]
+        pages = [p for p in stream.iter_pages() if int(p.num_rows) > 0]
         if not pages:
             return None
         if len(pages) == 1:
@@ -239,7 +302,14 @@ class LocalExecutionPlanner:
             specs.append(AggSpec(call.name, input_ch, in_type, mask_ch,
                                  call.distinct))
 
-        partial_op = jax.jit(hash_aggregate(key_channels, specs, Step.PARTIAL))
+        key_channels_t = tuple(key_channels)
+        specs_t = tuple(specs)
+        # fuse the upstream filter/project chain into the partial-agg kernel:
+        # scan -> filter -> project -> partial agg is ONE device program per
+        # page (ScanFilterAndProjectOperator + partial-agg fusion)
+        partial_op = compose_chain(
+            src.pending, ("agg-partial", key_channels_t, specs_t),
+            lambda: hash_aggregate(key_channels, specs, Step.PARTIAL))
 
         # FINAL consumes the partial layout: keys first, then each agg's
         # state columns in sequence
@@ -253,15 +323,16 @@ class LocalExecutionPlanner:
             state_channels.append(list(range(ch, ch + k)))
             ch += k
         final_keys = list(range(nkeys))
-        final_op = jax.jit(hash_aggregate(final_keys, specs, Step.FINAL,
-                                          state_channels))
+        final_op = cached_kernel(
+            ("agg-final", nkeys, specs_t),
+            lambda: hash_aggregate(final_keys, specs, Step.FINAL,
+                                   state_channels))
 
         def gen():
-            partials = []
-            for page in src.pages:
-                if int(page.num_rows) == 0:
-                    continue
-                partials.append(partial_op(page))
+            # no per-page num_rows sync: empty pages produce neutral partial
+            # states that merge correctly (the sync was a tunnel round-trip
+            # per page on remote TPU)
+            partials = [partial_op(page) for page in src.pages]
             if not partials:
                 # empty input: global agg still emits one row
                 if not key_channels:
@@ -269,6 +340,12 @@ class LocalExecutionPlanner:
                 return
             merged = concat_pages(partials) if len(partials) > 1 \
                 else partials[0]
+            if int(merged.num_rows) == 0:
+                # every input page was empty (grouped agg -> no output;
+                # global agg partials always carry one state row)
+                if not key_channels:
+                    yield self._empty_global_agg(node, specs)
+                return
             yield final_op(merged)
         return PageStream(gen(), node.outputs)
 
@@ -291,7 +368,7 @@ class LocalExecutionPlanner:
             s for gs in node.grouping_sets for s in gs))
 
         def gen():
-            for page in src.pages:
+            for page in src.iter_pages():
                 for set_idx, gset in enumerate(node.grouping_sets):
                     in_set = {s.name for s in gset}
                     cols = []
@@ -316,11 +393,14 @@ class LocalExecutionPlanner:
         keys = [SortKey(lay[o.symbol.name], o.ascending, o.nulls_first)
                 for o in node.order_by]
 
+        sort_op = cached_kernel(("sort", tuple(keys)),
+                                lambda: order_by(keys))
+
         def gen():
-            page = self._collect(PageStream(src.pages, src.symbols))
+            page = self._collect(src)
             if page is None:
                 return
-            yield jax.jit(order_by(keys))(page)
+            yield sort_op(page)
         return PageStream(gen(), src.symbols)
 
     def _exec_TopNNode(self, node: TopNNode) -> PageStream:
@@ -328,21 +408,24 @@ class LocalExecutionPlanner:
         lay, _ = _layout(src.symbols)
         keys = [SortKey(lay[o.symbol.name], o.ascending, o.nulls_first)
                 for o in node.order_by]
-        per_page = jax.jit(top_n(node.count, keys))
+        # per-page partial top-n fused with the upstream chain
+        partial_topn = compose_chain(
+            src.pending, ("topn", node.count, tuple(keys)),
+            lambda: top_n(node.count, keys))
+        merge_topn = cached_kernel(("topn", node.count, tuple(keys)),
+                                   lambda: top_n(node.count, keys))
 
         def gen():
             # partial top-n per page bounds the concat size at
             # count * n_pages (GroupedTopN-builder analog)
-            partials = []
-            for page in src.pages:
-                if int(page.num_rows) == 0:
-                    continue
-                partials.append(per_page(page))
+            partials = [partial_topn(page) for page in src.pages]
             if not partials:
                 return
             merged = concat_pages(partials) if len(partials) > 1 \
                 else partials[0]
-            yield jax.jit(top_n(node.count, keys))(merged)
+            if int(merged.num_rows) == 0:
+                return
+            yield merge_topn(merged)
         return PageStream(gen(), src.symbols)
 
     def _exec_JoinNode(self, node: JoinNode) -> PageStream:
@@ -365,13 +448,29 @@ class LocalExecutionPlanner:
         # residual non-equi filter evaluated over joined layout — valid for
         # INNER only (LEFT would wrongly drop null-extended rows; planner
         # rejects such plans)
-        post_filter = None
+        post_pred = None
         if node.filter is not None:
             if join_kind != JoinType.INNER:
                 raise ExecutionError(
                     "non-inner join with residual filter not supported")
             lay, typ = _layout(out_symbols)
-            post_filter = compile_filter(lower_expr(node.filter, lay, typ))
+            post_pred = lower_expr(node.filter, lay, typ)
+
+        def join_op(cap: int):
+            def build():
+                op = hash_join(probe_keys, build_keys, join_kind,
+                               output_capacity=cap)
+                if post_pred is None:
+                    return lambda p, b: op(p, b)
+                post_filter = compile_filter(post_pred)
+
+                def run(p, b):
+                    out, total = op(p, b)
+                    return out.filter(post_filter(out)), total
+                return run
+            return cached_kernel(
+                ("join", tuple(probe_keys), tuple(build_keys), join_kind,
+                 cap, post_pred), build)
 
         def gen():
             nonlocal build_page
@@ -380,31 +479,8 @@ class LocalExecutionPlanner:
                     return
                 # LEFT join with empty build: emit null-extended probe rows
                 build_page = self._null_build_page(node.right.outputs)
-            cap0 = self.page_capacity
-            ops: Dict[int, object] = {}
-            for probe_page in probe_stream.pages:
-                if int(probe_page.num_rows) == 0:
-                    continue
-                cap = max(cap0, probe_page.capacity)
-                while True:
-                    if cap not in ops:
-                        op = hash_join(probe_keys, build_keys, join_kind,
-                                       output_capacity=cap)
-                        if post_filter is None:
-                            ops[cap] = jax.jit(
-                                lambda p, b, o=op: o(p, b))
-                        else:
-                            def run(p, b, o=op):
-                                out, total = o(p, b)
-                                out = out.filter(post_filter(out))
-                                return out, total
-                            ops[cap] = jax.jit(run)
-                    out, total = ops[cap](probe_page, build_page)
-                    if int(total) <= cap:
-                        break
-                    cap = _next_pow2(int(total))  # re-run bigger (SURVEY §7)
-                if int(out.num_rows) > 0:
-                    yield out
+            yield from _run_with_overflow(
+                probe_stream, build_page, join_op, self.page_capacity)
         return PageStream(gen(), out_symbols)
 
     def _null_build_page(self, symbols: Tuple[Symbol, ...]) -> Page:
@@ -426,21 +502,24 @@ class LocalExecutionPlanner:
             nb = int(build_page.num_rows)
             if nb == 1:
                 # scalar-subquery path: broadcast the single build row
-                def attach(p):
-                    bcols = tuple(
-                        Column(jnp.broadcast_to(c.values[:1], (p.capacity,)),
-                               None if c.valid is None else
-                               jnp.broadcast_to(c.valid[:1], (p.capacity,)),
-                               c.type, c.dictionary)
-                        for c in build_page.columns)
-                    return Page(tuple(p.columns) + bcols, p.num_rows)
-                run = jax.jit(attach)
-                for page in probe_stream.pages:
-                    if int(page.num_rows):
-                        yield run(page)
+                def build():
+                    def attach(p, b):
+                        bcols = tuple(
+                            Column(jnp.broadcast_to(c.values[:1],
+                                                    (p.capacity,)),
+                                   None if c.valid is None else
+                                   jnp.broadcast_to(c.valid[:1],
+                                                    (p.capacity,)),
+                                   c.type, c.dictionary)
+                            for c in b.columns)
+                        return Page(tuple(p.columns) + bcols, p.num_rows)
+                    return attach
+                run = cached_kernel(("cross-attach",), build)
+                for page in probe_stream.iter_pages():
+                    yield run(page, build_page)
                 return
             # general cross join: bounded expansion
-            for page in probe_stream.pages:
+            for page in probe_stream.iter_pages():
                 np_rows = int(page.num_rows)
                 if np_rows == 0:
                     continue
@@ -487,10 +566,24 @@ class LocalExecutionPlanner:
         build_page = self._collect(build_stream)
         jt = JoinType.SEMI if mode == "semi" else JoinType.ANTI
         rest_pred = combine(rest)
-        rest_fn = None
-        if rest_pred is not None:
-            rest_fn = compile_filter(
-                lower_expr(rest_pred, probe_lay, probe_typ))
+        rest_lowered = None if rest_pred is None else \
+            lower_expr(rest_pred, probe_lay, probe_typ)
+
+        def semi_op(cap: int):
+            def build():
+                op = hash_join(probe_keys, build_keys, jt,
+                               output_capacity=cap)
+                if rest_lowered is None:
+                    return lambda p, b: op(p, b)
+                fn = compile_filter(rest_lowered)
+
+                def run(p, b):
+                    out, total = op(p, b)
+                    return out.filter(fn(out)), total
+                return run
+            return cached_kernel(
+                ("semijoin", tuple(probe_keys), tuple(build_keys), jt,
+                 cap, rest_lowered), build)
 
         def gen():
             bp = build_page
@@ -498,40 +591,76 @@ class LocalExecutionPlanner:
                 if jt == JoinType.SEMI:
                     return
                 bp = self._null_build_page(semi.filtering_source.outputs)
-            ops: Dict[int, object] = {}
-            for page in probe_stream.pages:
-                if int(page.num_rows) == 0:
-                    continue
-                cap = max(self.page_capacity, page.capacity)
-                while True:
-                    if cap not in ops:
-                        op = hash_join(probe_keys, build_keys, jt,
-                                       output_capacity=cap)
-
-                        def run(p, b, o=op):
-                            out, total = o(p, b)
-                            if rest_fn is not None:
-                                out = out.filter(rest_fn(out))
-                            return out, total
-                        ops[cap] = jax.jit(run)
-                    out, total = ops[cap](page, bp)
-                    if int(total) <= cap:
-                        break
-                    cap = _next_pow2(int(total))
-                if int(out.num_rows) > 0:
-                    yield out
+            yield from _run_with_overflow(
+                probe_stream, bp, semi_op, self.page_capacity)
         return PageStream(gen(), semi.source.outputs)
 
     def _exec_SemiJoinNode(self, node: SemiJoinNode) -> PageStream:
-        raise ExecutionError(
-            "bare SemiJoinNode (match symbol escaping into projections) "
-            "not supported; expected Filter(match) above")
+        """Bare semi join: emit probe rows + boolean match channel
+        (HashSemiJoinOperator). Used when the match symbol escapes a direct
+        Filter (e.g. stacked EXISTS predicates)."""
+        probe_stream = self.execute(node.source)
+        build_stream = self.execute(node.filtering_source)
+        probe_lay, _ = _layout(probe_stream.symbols)
+        build_lay, _ = _layout(build_stream.symbols)
+        probe_keys = [probe_lay[s.name] for s in node.source_keys]
+        build_keys = [build_lay[s.name] for s in node.filtering_keys]
+        build_page = self._collect(build_stream)
+        out_symbols = node.source.outputs + (node.match_symbol,)
+
+        def mark_op(cap: int):
+            return cached_kernel(
+                ("markjoin", tuple(probe_keys), tuple(build_keys), cap),
+                lambda: hash_join(probe_keys, build_keys, JoinType.MARK,
+                                  output_capacity=cap))
+
+        def no_match(page: Page) -> Page:
+            mark = Column(jnp.zeros(page.capacity, dtype=jnp.bool_), None,
+                          T.BOOLEAN, None)
+            return Page(tuple(page.columns) + (mark,), page.num_rows)
+
+        def gen():
+            bp = build_page
+            if bp is None:
+                for page in probe_stream.iter_pages():
+                    yield no_match(page)
+                return
+            yield from _run_with_overflow(
+                probe_stream, bp, mark_op, self.page_capacity)
+        return PageStream(gen(), out_symbols)
+
+    def _exec_AssignUniqueIdNode(self, node) -> PageStream:
+        """AssignUniqueIdOperator: tag rows with a stable unique id.
+
+        Ids are the global row position in stream order; the scan order is
+        deterministic, so re-executing the same subtree (shared by a
+        decorrelated EXISTS) reproduces identical ids."""
+        src = self.execute(node.source)
+
+        def build():
+            def tag(page, offset):
+                idx = (jnp.arange(page.capacity, dtype=jnp.int64)
+                       + offset)
+                col = Column(idx, None, T.BIGINT, None)
+                return Page(tuple(page.columns) + (col,), page.num_rows)
+            return tag
+        tag = cached_kernel(("assign-unique-id",), build)
+
+        def gen():
+            offset = 0
+            for page in src.iter_pages():
+                n = int(page.num_rows)
+                if n == 0:
+                    continue
+                yield tag(page, jnp.int64(offset))
+                offset += n
+        return PageStream(gen(), node.source.outputs + (node.id_symbol,))
 
     def _exec_EnforceSingleRowNode(self, node) -> PageStream:
         src = self.execute(node.source)
 
         def gen():
-            page = self._collect(PageStream(src.pages, src.symbols))
+            page = self._collect(src)
             if page is None:
                 # zero rows -> one all-null row (EnforceSingleRowOperator)
                 yield Page(self._null_build_page(node.outputs).columns, 1)
@@ -557,7 +686,7 @@ class LocalExecutionPlanner:
                 stream = self.execute(child)
                 lay, _ = _layout(stream.symbols)
                 order = [lay[node.mappings[i][j].name] for i in range(nsyms)]
-                it = iter(stream.pages)
+                it = iter(stream.iter_pages())
                 first = next(it, None)
                 children.append([it, first, order])
             remaps = _union_dictionary_remaps(node.symbols, children)
@@ -587,21 +716,54 @@ class LocalExecutionPlanner:
         return self.execute(node.source)
 
     def _exec_WindowNode(self, node: WindowNode) -> PageStream:
-        raise ExecutionError("window function execution lands with the "
-                             "window operator (planned)")
+        """WindowOperator: blocking sort-partitioned evaluation
+        (operator/window/WindowOperator.java; ops/window.py kernel)."""
+        from trino_tpu.ops.window import WindowSpec, window
+        src = self.execute(node.source)
+        lay, typ = _layout(src.symbols)
+        part = tuple(lay[s.name] for s in node.partition_by)
+        okeys = tuple(SortKey(lay[o.symbol.name], o.ascending, o.nulls_first)
+                      for o in node.order_by)
+        specs = []
+        for out_sym, wf in node.functions:
+            if wf.start_value is not None or wf.end_value is not None or \
+                    wf.start_type != "UNBOUNDED_PRECEDING":
+                raise ExecutionError(
+                    "bounded window frames (<n> PRECEDING/FOLLOWING) not "
+                    "supported yet")
+            whole = (not node.order_by) or \
+                wf.end_type == "UNBOUNDED_FOLLOWING"
+            args = []
+            for a in wf.args:
+                if not isinstance(a, SymbolRef):
+                    raise ExecutionError("window args must be pre-projected")
+                args.append(lay[a.name])
+            specs.append(WindowSpec(wf.name.lower(), tuple(args),
+                                    out_sym.type, whole,
+                                    wf.frame_type == "ROWS"))
+        win = cached_kernel(
+            ("window", part, okeys, tuple(specs)),
+            lambda: window(part, okeys, specs))
+
+        def gen():
+            page = self._collect(src)
+            if page is None:
+                return
+            yield win(page)
+        return PageStream(gen(), node.outputs)
 
     def _exec_OutputNode(self, node: OutputNode) -> PageStream:
         src = self.execute(node.source)
         lay, _ = _layout(src.symbols)
-        order = [lay[s.name] for s in node.symbols]
-        if order == list(range(len(src.symbols))):
-            return PageStream(src.pages, node.symbols)
-
-        def gen():
-            for page in src.pages:
-                yield Page(tuple(page.column(c) for c in order),
-                           page.num_rows)
-        return PageStream(gen(), node.symbols)
+        order = tuple(lay[s.name] for s in node.symbols)
+        if order == tuple(range(len(src.symbols))):
+            return PageStream(src.pages, node.symbols, src.pending)
+        return PageStream(
+            src.pages, node.symbols,
+            src.pending + ((("select", order),
+                            lambda: lambda p: Page(
+                                tuple(p.columns[c] for c in order),
+                                p.num_rows)),))
 
     def _exec_TableWriterNode(self, node: TableWriterNode) -> PageStream:
         src = self.execute(node.source)
@@ -612,7 +774,7 @@ class LocalExecutionPlanner:
 
         def gen():
             written = 0
-            for page in src.pages:
+            for page in src.iter_pages():
                 n = int(page.num_rows)
                 if n == 0:
                     continue
@@ -625,6 +787,29 @@ class LocalExecutionPlanner:
                          None, T.BIGINT, None)
             yield Page((col,), 1)
         return PageStream(gen(), node.outputs)
+
+
+def _run_with_overflow(probe_stream: PageStream, build_page: Page,
+                       make_op, page_capacity: int) -> Iterator[Page]:
+    """Dispatch a capacity-laddered binary page op over every probe page,
+    then resolve ALL overflow counters in one batched device_get (a sync per
+    page costs a full round trip on remote TPUs); only pages that actually
+    overflowed re-run at the next capacity bucket (SURVEY §7 contract)."""
+    probe_pages = list(probe_stream.iter_pages())
+    if not probe_pages:
+        return
+    results = []
+    for page in probe_pages:
+        cap = max(page_capacity, page.capacity)
+        results.append((cap, make_op(cap)(page, build_page)))
+    totals = jax.device_get([t for _, (_, t) in results])
+    for page, (cap, (out, _)), total in zip(probe_pages, results, totals):
+        total = int(total)
+        while total > cap:
+            cap = _next_pow2(total)
+            out, t = make_op(cap)(page, build_page)
+            total = int(t)
+        yield out
 
 
 def _chain_first(first: Optional[Page], rest: Iterator[Page]) -> Iterator[Page]:

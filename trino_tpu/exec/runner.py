@@ -163,11 +163,11 @@ class LocalQueryRunner:
         stream = executor.execute(plan)
         types = [s.type for s in plan.symbols]
         rows: List[Tuple[Any, ...]] = []
-        for page in stream.pages:
+        for page in stream.iter_pages():
             n = int(page.num_rows)
             if n == 0:
                 continue
-            cols = [c.to_numpy(n) for c in page.columns]
+            cols = page.to_host(n)
             for i in range(n):
                 rows.append(tuple(
                     _to_python(cols[j][i], types[j])

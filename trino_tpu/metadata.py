@@ -25,6 +25,7 @@ SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     "task_concurrency": 1,
     "query_max_memory": 16 << 30,
     "page_capacity": 1 << 16,      # rows per device page
+    "scan_page_capacity": 1 << 22,  # max rows per scan page (big fused scans)
     "join_broadcast_threshold_rows": 1_000_000,
     "distributed_sort": True,
     "enable_dynamic_filtering": True,

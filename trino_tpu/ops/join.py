@@ -39,6 +39,9 @@ class JoinType:
     LEFT = "left"          # probe side preserved
     SEMI = "semi"          # probe rows with >=1 match (IN / EXISTS)
     ANTI = "anti"          # probe rows with 0 matches (NOT IN w/o nulls)
+    MARK = "mark"          # all probe rows + bool match channel
+    # (HashSemiJoinOperator appends the semi-join result as a column;
+    # used when the match symbol escapes into projections/other filters)
 
 
 _MIX = jnp.uint64(0x9E3779B97F4A7C15)
@@ -75,6 +78,18 @@ def _key_u64(page: Page, channels: Sequence[int]) -> Tuple[jnp.ndarray, jnp.ndar
         k = to_u64(c.values)
         acc = _mix64(acc ^ _mix64(k) ^ (acc * _MIX))
     return acc, null
+
+
+def _mark_page(probe: Page, matched: jnp.ndarray, pnull: jnp.ndarray,
+               n_live_build: jnp.ndarray) -> Page:
+    """Append the semi-join verdict as a boolean channel.
+
+    3VL: a NULL probe key against a non-empty build side yields NULL (the
+    IN-subquery contract); everything else is a definite true/false."""
+    value = matched & ~pnull
+    valid = ~(pnull & (n_live_build > 0))
+    mark = Column(value, valid, T.BOOLEAN, None)
+    return Page(tuple(probe.columns) + (mark,), probe.num_rows)
 
 
 def hash_join(
@@ -131,9 +146,13 @@ def hash_join(
         hi = jnp.minimum(hi, n_live_build)
         counts = jnp.where(p_dead, 0, hi - lo).astype(jnp.int64)
 
-        if join_type in (JoinType.SEMI, JoinType.ANTI) and not (
-                composite and verify_composite):
+        if join_type in (JoinType.SEMI, JoinType.ANTI, JoinType.MARK) \
+                and not (composite and verify_composite):
             # single-column keys: to_u64 is injective, hash match == key match
+            if join_type == JoinType.MARK:
+                return _mark_page(probe, counts > 0, pnull,
+                                  n_live_build), \
+                    probe.num_rows.astype(jnp.int64)
             if join_type == JoinType.SEMI:
                 out = probe.filter((counts > 0) & ~p_dead)
             else:
@@ -161,7 +180,7 @@ def hash_join(
         slot_live = out_idx < jnp.minimum(total, cap)
         matched = jnp.take(counts, prow_c, mode="clip") > 0
 
-        if join_type in (JoinType.SEMI, JoinType.ANTI):
+        if join_type in (JoinType.SEMI, JoinType.ANTI, JoinType.MARK):
             # composite keys: re-check real key equality on each expanded
             # candidate, then scatter-or back to probe rows. Exact whenever the
             # hash-expansion fits in cap (else total > cap -> executor re-runs
@@ -173,6 +192,10 @@ def hash_join(
                 keep = keep & (pv == bv)
             verified = jnp.zeros(n_probe, dtype=jnp.bool_).at[prow_c].max(
                 keep, mode="drop")
+            if join_type == JoinType.MARK:
+                rows = probe.num_rows.astype(jnp.int64)
+                return _mark_page(probe, verified, pnull, n_live_build), \
+                    jnp.where(total <= cap, rows, total)
             if join_type == JoinType.SEMI:
                 out = probe.filter(verified & ~p_dead)
             else:

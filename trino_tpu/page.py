@@ -173,15 +173,19 @@ class Column:
         return cls(arr, v, typ, dictionary)
 
     def to_numpy(self, num_rows: Optional[int] = None) -> np.ndarray:
-        """Decode back to host values (python objects for strings/nulls)."""
+        """Decode back to host values (python objects for strings/nulls).
+
+        Slices on DEVICE before transfer: pages have large static capacities
+        (scan pages are table-sized), and fetching the full padded array over
+        a remote-TPU link costs capacity/num_rows times the useful bytes."""
         n = self.capacity if num_rows is None else int(num_rows)
-        vals = np.asarray(self.values)[:n]
+        vals = np.asarray(self.values[:n])
         if self.dictionary is not None:
             out = self.dictionary.decode(vals)
         else:
             out = vals.astype(object)
         if self.valid is not None:
-            mask = ~np.asarray(self.valid)[:n]
+            mask = ~np.asarray(self.valid[:n])
             out = out.copy()
             out[mask] = None
         return out
@@ -275,10 +279,32 @@ class Page:
             for a, t, v, d in zip(arrays, typs, valids, dictionaries))
         return cls(cols, jnp.asarray(n, dtype=jnp.int32))
 
+    def to_host(self, num_rows: Optional[int] = None) -> list:
+        """All columns as decoded host arrays in ONE batched transfer."""
+        n = int(self.num_rows) if num_rows is None else num_rows
+        fetch = []
+        for c in self.columns:
+            fetch.append(c.values[:n])
+            fetch.append(c.valid[:n] if c.valid is not None else None)
+        host = jax.device_get(fetch)
+        out = []
+        for ci, c in enumerate(self.columns):
+            vals = host[2 * ci]
+            if c.dictionary is not None:
+                decoded = c.dictionary.decode(vals)
+            else:
+                decoded = vals.astype(object)
+            valid = host[2 * ci + 1]
+            if valid is not None:
+                decoded = decoded.copy()
+                decoded[~valid] = None
+            out.append(decoded)
+        return out
+
     def to_pylist(self) -> list:
         """Rows as python tuples (client-result materialization)."""
         n = int(self.num_rows)
-        cols = [c.to_numpy(n) for c in self.columns]
+        cols = self.to_host(n)
         return [tuple(col[i] for col in cols) for i in range(n)]
 
 
@@ -296,30 +322,52 @@ def union_dictionaries(dicts: Sequence[Dictionary]
 
 
 def concat_pages(pages: Sequence[Page]) -> Page:
-    """Host-side page concatenation (not jit-safe; used at stage boundaries)."""
+    """Host-side page concatenation (not jit-safe; used at stage boundaries).
+
+    Transfer discipline for remote devices (~100ms per round trip through a
+    TPU tunnel): ONE batched device_get for all row counts, then ONE for
+    every column slice of every page — never a fetch per column. Slices are
+    taken on device so only live rows cross the wire, not padded capacity.
+    """
     if not pages:
         raise ValueError("no pages")
     if len(pages) == 1:
         return pages[0]
     ncols = pages[0].num_columns
-    counts = [int(p.num_rows) for p in pages]
+    counts = [int(c) for c in jax.device_get([p.num_rows for p in pages])]
     total = sum(counts)
-    cols = []
     for ci in range(ncols):
         ref = pages[0].column(ci)
         if any(p.column(ci).dictionary is not ref.dictionary for p in pages):
             raise ValueError(
                 f"column {ci}: pages use different dictionaries; re-encode "
                 "to a shared dictionary before concatenating")
-        parts = [np.asarray(p.column(ci).values)[:c]
-                 for p, c in zip(pages, counts)]
-        values = jnp.asarray(np.concatenate(parts)) if total else ref.values[:0]
+    needs_valid = [any(p.column(ci).valid is not None for p in pages)
+                   for ci in range(ncols)]
+    fetch = []
+    for p, c in zip(pages, counts):
+        for ci in range(ncols):
+            col = p.column(ci)
+            fetch.append(col.values[:c])
+            if needs_valid[ci]:
+                fetch.append(col.valid_mask()[:c])
+    host = jax.device_get(fetch)
+    it = iter(host)
+    vparts: list = [[] for _ in range(ncols)]
+    nparts: list = [[] for _ in range(ncols)]
+    for p, c in zip(pages, counts):
+        for ci in range(ncols):
+            vparts[ci].append(next(it))
+            if needs_valid[ci]:
+                nparts[ci].append(next(it))
+    cols = []
+    for ci in range(ncols):
+        ref = pages[0].column(ci)
+        values = jnp.asarray(np.concatenate(vparts[ci])) if total \
+            else ref.values[:0]
         valid = None
-        if any(p.column(ci).valid is not None for p in pages):
-            vparts = [
-                np.asarray(p.column(ci).valid_mask())[:c]
-                for p, c in zip(pages, counts)
-            ]
-            valid = jnp.asarray(np.concatenate(vparts))
+        if needs_valid[ci]:
+            valid = jnp.asarray(np.concatenate(nparts[ci])) if total \
+                else ref.valid_mask()[:0]
         cols.append(Column(values, valid, ref.type, ref.dictionary))
     return Page(tuple(cols), jnp.asarray(total, dtype=jnp.int32))

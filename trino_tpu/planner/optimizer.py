@@ -138,12 +138,14 @@ def run_rules(root: PlanNode, rules: Sequence[Rule], ctx: OptimizerContext,
 class StatsEstimator:
     """Row-count estimation driving join distribution/ordering decisions.
 
-    cost/ in the reference derives full NDV/size stats; here row counts with
-    standard selectivity guesses (FilterStatsCalculator defaults) are enough
-    for broadcast-vs-partitioned and build-side choices.
+    cost/ in the reference derives full NDV/size stats
+    (FilterStatsCalculator.java, JoinStatsRule.java); here per-shape
+    selectivities with NDV for point lookups are enough for
+    broadcast-vs-partitioned, build-side, and greedy join-order choices.
     """
 
     FILTER_SELECTIVITY = 0.33
+    RANGE_SELECTIVITY = 0.3
     SEMI_SELECTIVITY = 0.5
 
     def __init__(self, metadata: Metadata):
@@ -156,6 +158,41 @@ class StatsEstimator:
             self._cache[key] = self._estimate(node)
         return self._cache[key]
 
+    def _scan_selectivity(self, node: TableScanNode, stats) -> float:
+        """Domain-based selectivity per constrained column
+        (FilterStatsCalculator's point/range estimates)."""
+        sel = 1.0
+        domains = node.table.constraint.domains
+        if domains is None:
+            return sel
+        for col, dom in domains.items():
+            ndv = None
+            cstats = (stats.columns or {}).get(col) if stats else None
+            if cstats is not None and cstats.distinct_count:
+                ndv = float(cstats.distinct_count)
+            values = dom.values_if_discrete()
+            if values is not None:
+                k = len(values)
+                sel *= min(1.0, k / ndv) if ndv else 0.1
+            else:
+                sel *= self.RANGE_SELECTIVITY
+        return max(sel, 1e-6)
+
+    def _filter_selectivity(self, pred: RowExpression) -> float:
+        sel = 1.0
+        for p in conjuncts(pred):
+            if isinstance(p, Call) and p.name == "eq":
+                sel *= 0.1
+            elif isinstance(p, Call) and p.name in ("lt", "le", "gt", "ge"):
+                sel *= self.RANGE_SELECTIVITY
+            elif isinstance(p, SpecialForm) and p.kind is SpecialKind.BETWEEN:
+                sel *= self.RANGE_SELECTIVITY
+            elif isinstance(p, SpecialForm) and p.kind is SpecialKind.IN:
+                sel *= min(1.0, 0.1 * (len(p.args) - 1))
+            else:
+                sel *= 0.9  # UNKNOWN_FILTER_COEFFICIENT
+        return max(sel, 1e-6)
+
     def _estimate(self, node: PlanNode) -> float:
         if isinstance(node, TableScanNode):
             stats = self.metadata.get_table_statistics(node.catalog,
@@ -164,12 +201,13 @@ class StatsEstimator:
             if node.table.limit is not None:
                 base = min(base, float(node.table.limit))
             if not node.table.constraint.is_all():
-                base *= self.FILTER_SELECTIVITY
-            return base
+                base *= self._scan_selectivity(node, stats)
+            return max(base, 1.0)
         if isinstance(node, ValuesNode):
             return float(len(node.rows))
         if isinstance(node, FilterNode):
-            return self.rows(node.source) * self.FILTER_SELECTIVITY
+            return max(1.0, self.rows(node.source)
+                       * self._filter_selectivity(node.predicate))
         if isinstance(node, (LimitNode, TopNNode, DistinctLimitNode)):
             return min(self.rows(node.source), float(node.count))
         if isinstance(node, AggregationNode):
@@ -543,6 +581,8 @@ def prune_unreferenced(root: OutputNode) -> OutputNode:
             req = set(required)
             if isinstance(node, TableWriterNode):
                 req |= {s.name for s in node.column_symbols}
+            if isinstance(node, AssignUniqueIdNode):
+                req.discard(node.id_symbol.name)
             return node.with_sources([needed_of(node.sources[0], req)])
         return rewrite_sources(
             node, lambda s: needed_of(s, set(required)))
@@ -677,6 +717,134 @@ class FlipJoinSides(Rule):
             assigns = tuple((s, s.ref()) for s in want)
             return ProjectNode(flipped, assigns)
         return None
+
+
+# ---------------------------------------------------------------------------
+# join reordering (EliminateCrossJoins.java + ReorderJoins.java:96 greedy)
+
+
+def reorder_joins(root: PlanNode, ctx: OptimizerContext) -> PlanNode:
+    """Reassociate each maximal INNER/CROSS join tree along its equality
+    graph so no avoidable cross join remains.
+
+    The reference does DP enumeration over connected subgraphs
+    (ReorderJoins.JoinEnumerator:168, capped at 9 relations) with full cost
+    comparison; a greedy nearest-neighbor over estimated row counts picks the
+    same plans for TPC-H's PK-FK star/snowflake shapes: start from the
+    cheapest connected pair, then always attach the connected source that
+    minimizes the estimated intermediate size. Cross joins only happen when
+    the predicate graph is genuinely disconnected (EliminateCrossJoins'
+    contract)."""
+    if ctx.session.get("join_reordering_strategy") == "NONE":
+        return root
+
+    def walk(node: PlanNode) -> PlanNode:
+        if isinstance(node, JoinNode) and \
+                node.kind in (JoinKind.INNER, JoinKind.CROSS):
+            sources: List[PlanNode] = []
+            edges: List[JoinClause] = []
+            filters: List[RowExpression] = []
+
+            def flatten(n: PlanNode):
+                if isinstance(n, JoinNode) and \
+                        n.kind in (JoinKind.INNER, JoinKind.CROSS):
+                    flatten(n.left)
+                    flatten(n.right)
+                    edges.extend(n.criteria)
+                    if n.filter is not None:
+                        filters.extend(conjuncts(n.filter))
+                else:
+                    sources.append(walk(n))
+
+            flatten(node)
+            if len(sources) < 3:
+                # nothing to reorder (flatten already walked the leaves)
+                return node.with_sources(sources)
+            out = _build_join_tree(sources, edges, filters, ctx)
+            want = node.outputs
+            have = set(s.name for s in out.outputs)
+            assigns = tuple((s, s.ref()) for s in want if s.name in have)
+            return ProjectNode(out, assigns)
+        return rewrite_sources(node, walk)
+
+    return walk(root)
+
+
+def _build_join_tree(sources: List[PlanNode], edges: List[JoinClause],
+                     filters: List[RowExpression],
+                     ctx: OptimizerContext) -> PlanNode:
+    syms_of = [{s.name for s in src.outputs} for src in sources]
+
+    def locate(name: str) -> Optional[int]:
+        for i, syms in enumerate(syms_of):
+            if name in syms:
+                return i
+        return None
+
+    located = []  # (source_a, source_b, clause); a owns clause.left
+    for c in edges:
+        a, b = locate(c.left.name), locate(c.right.name)
+        if a is None or b is None or a == b:
+            # degenerate (same-source equality or unknown symbol): filter
+            filters.append(Call("eq", (c.left.ref(), c.right.ref()),
+                                T.BOOLEAN))
+        else:
+            located.append((a, b, c))
+
+    rows = [ctx.stats.rows(s) for s in sources]
+    n = len(sources)
+
+    # cheapest connected starting pair (fall back: two smallest sources)
+    best: Optional[Tuple[float, int, int]] = None
+    for a, b, _ in located:
+        cost = max(rows[a], rows[b])
+        if best is None or cost < best[0]:
+            best = (cost, a, b)
+    if best is None:
+        order = sorted(range(n), key=lambda i: rows[i])
+        first, second = order[0], order[1]
+    else:
+        _, first, second = best
+
+    used = {first, second}
+    current = _join_step(sources[first], syms_of[first], sources[second],
+                         second, located, used)
+    cur_rows = max(rows[first], rows[second])
+    cur_syms = syms_of[first] | syms_of[second]
+
+    while len(used) < n:
+        candidates = []
+        for j in range(n):
+            if j in used:
+                continue
+            connected = any((a in used and b == j) or (b in used and a == j)
+                            for a, b, _ in located)
+            est = max(cur_rows, rows[j]) if connected else cur_rows * rows[j]
+            candidates.append((not connected, est, j))
+        candidates.sort()
+        _, est, j = candidates[0]
+        current = _join_step(current, cur_syms, sources[j], j, located, used)
+        used.add(j)
+        cur_rows = est
+        cur_syms |= syms_of[j]
+
+    if filters:
+        current = FilterNode(current, combine(filters))
+    return current
+
+
+def _join_step(left: PlanNode, left_syms: Set[str], right: PlanNode,
+               right_idx: int, located, used: Set[int]) -> PlanNode:
+    """Join `right` (source right_idx) onto `left`, consuming every edge
+    between the current set and right_idx, oriented left-first."""
+    criteria = []
+    for a, b, c in located:
+        if a in used and b == right_idx:
+            criteria.append(c)
+        elif b in used and a == right_idx:
+            criteria.append(JoinClause(c.right, c.left))
+    kind = JoinKind.INNER if criteria else JoinKind.CROSS
+    return JoinNode(kind, left, right, tuple(criteria))
 
 
 # ---------------------------------------------------------------------------
@@ -940,8 +1108,10 @@ def optimize(root: OutputNode, metadata: Metadata, session: Session,
     ]
     root = run_rules(root, rules, ctx)
     root = prune_unreferenced(root)
+    root = reorder_joins(root, ctx)
     root = run_rules(root, [
         MergeFilters(), MergeAdjacentProjects(), RemoveIdentityProjections(),
+        PredicatePushDown(),
         PushPredicateIntoTableScan(), PushLimitIntoTableScan(),
         DetermineJoinDistributionType(), FlipJoinSides(),
     ], ctx)

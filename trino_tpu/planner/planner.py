@@ -50,6 +50,24 @@ def _conjuncts(e: t.Expression) -> List[t.Expression]:
     return [e]
 
 
+def _combine_ast(parts: Sequence[t.Expression]) -> t.Expression:
+    out = parts[0]
+    for p in parts[1:]:
+        out = t.LogicalBinary("AND", out, p)
+    return out
+
+
+def _has_subquery(e: t.Expression) -> bool:
+    for n in t.walk(e):
+        if isinstance(n, (t.SubqueryExpression, t.ExistsPredicate,
+                          t.InPredicate)):
+            if isinstance(n, t.InPredicate) and not isinstance(
+                    n.value_list, t.SubqueryExpression):
+                continue
+            return True
+    return False
+
+
 def combine_conjuncts(parts: Sequence[RowExpression]) -> RowExpression:
     out = parts[0]
     for p in parts[1:]:
@@ -559,6 +577,20 @@ class _PlanBuilder:
     # -------------------------------------------------------- WHERE/HAVING
 
     def plan_where(self, where: t.Expression):
+        # apply subquery-free conjuncts FIRST: subquery translation captures
+        # self.node as the probe/outer side (SubqueryPlanner's contract), so
+        # base filters must already be in place or the decorrelated plan
+        # re-executes the unfiltered (possibly cross-join) outer subtree
+        plain: List[t.Expression] = []
+        with_sub: List[t.Expression] = []
+        for conj in _conjuncts(where):
+            (with_sub if _has_subquery(conj) else plain).append(conj)
+        if plain and with_sub:
+            pred = self.translator().translate(_combine_ast(plain))
+            if not isinstance(pred.type, T.BooleanType):
+                raise SemanticError("WHERE clause must be boolean")
+            self.node = FilterNode(self.node, pred)
+            where = _combine_ast(with_sub)
         pred = self.translator().translate(where)
         if not isinstance(pred.type, T.BooleanType):
             raise SemanticError("WHERE clause must be boolean")
@@ -701,8 +733,11 @@ class _PlanBuilder:
             part_exprs = [tr.translate(e) for e in w.partition_by]
             order_items = [(tr.translate(s.key), s.ascending, s.nulls_first)
                            for s in w.order_by]
-            pre = [(f.symbol, f.symbol.ref()) for f in self._scope.fields]
-            have = {e for _, e in pre}
+            # carry ALL current outputs (incl. previously planned window
+            # symbols) through any pre-projection, not just scope fields —
+            # a literal arg (lag(x, 2), ntile(3)) forces a ProjectNode and
+            # must not drop earlier functions' outputs
+            pre = [(s, s.ref()) for s in self.node.outputs]
 
             def sym_for(expr):
                 for s, e in pre:
@@ -1036,8 +1071,9 @@ class _PlanBuilder:
                 "aggregates not supported")
         split = self._split_correlation(spec)
         if split is None:
-            raise SemanticError(
-                "correlated EXISTS requires equality correlation")
+            # correlation beyond clean equalities (e.g. q21's
+            # l2.l_suppkey <> l1.l_suppkey): general decorrelation
+            return self._exists_general(spec, negate)
         corr_pairs, local_where = split
         inner = self.planner._plan_relation(spec.from_, None, self.ctes)
         ib = _PlanBuilder(self.planner, inner, self.ctes)
@@ -1061,6 +1097,59 @@ class _PlanBuilder:
         outer_tr = self.translator()
         outer_keys = [outer_tr.translate(oast) for oast, _ in corr_pairs]
         return self._semi_join(outer_keys, inner_keys, ib, negate)
+
+    def _exists_general(self, spec: t.QuerySpecification,
+                        negate: bool) -> RowExpression:
+        """EXISTS with arbitrary correlated predicates.
+
+        TransformCorrelatedExistsSubquery's general shape: tag each outer row
+        with a unique id, inner-join outer x subquery-FROM under the full
+        correlated predicate (equalities become hash-join criteria via
+        PredicatePushDown; the rest stays a join filter), then semi-join the
+        outer rows against the surviving ids. NOT EXISTS = anti on the same
+        set. Deterministic scan order makes the ids stable across the two
+        traversals of the outer subtree."""
+        planner = self.planner
+        inner = planner._plan_relation(spec.from_, None, self.ctes)
+        ib = _PlanBuilder(planner, inner, self.ctes)
+        probe_names = self._inner_name_probe(spec)
+        local: List[t.Expression] = []
+        mixed: List[t.Expression] = []
+        for conj in _conjuncts(spec.where) if spec.where is not None else []:
+            if self._classify(conj, probe_names) == "local":
+                local.append(conj)
+            else:
+                mixed.append(conj)
+        if local:
+            where = local[0]
+            for c in local[1:]:
+                where = t.LogicalBinary("AND", where, c)
+            ib.plan_where(where)
+        if not mixed:
+            raise SemanticError("unsupported EXISTS subquery")
+        uid = planner.symbols.new("unique", T.BIGINT)
+        probe_node = AssignUniqueIdNode(self.node, uid)
+        joined = JoinNode(JoinKind.CROSS, probe_node, ib.node, ())
+        combined = Scope(list(self._scope.fields) + list(ib.scope().fields),
+                         self._scope.parent)
+        tr = ExpressionTranslator(combined, {},
+                                  subquery_handler=self._handle_subquery,
+                                  session=planner.session)
+        pred = None
+        for conj in mixed:
+            rx = tr.translate(conj)
+            if not isinstance(rx.type, T.BooleanType):
+                raise SemanticError("EXISTS predicate must be boolean")
+            pred = rx if pred is None else SpecialForm(
+                SpecialKind.AND, (pred, rx), T.BOOLEAN)
+        filtered = FilterNode(joined, pred)
+        proj = ProjectNode(filtered, ((uid, uid.ref()),))
+        match = planner.symbols.new("match", T.BOOLEAN)
+        self.node = SemiJoinNode(probe_node, proj, (uid,), (uid,), match,
+                                 negate)
+        out = match.ref()
+        return SpecialForm(SpecialKind.NOT, (out,), T.BOOLEAN) \
+            if negate else out
 
     def _in_subquery(self, value_ast: t.Expression,
                      query: t.Query) -> RowExpression:
