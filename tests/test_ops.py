@@ -258,3 +258,67 @@ def test_composite_join_total_after_collision_filter():
     out, total = jax.jit(op)(probe, build)
     # only (1,10) truly matches; total must reflect the post-verify count
     assert int(out.num_rows) == 1 and int(total) == 1
+
+
+# ---------------------------------------------------------------------------
+# outer joins (FULL/RIGHT) + composite-key verification
+
+def test_full_join_kernel_and_finisher():
+    from trino_tpu.ops.join import unmatched_build_page
+    probe = page_of(([1, 5], T.BIGINT))
+    build = page_of(([1, 7], T.BIGINT), ([11, 77], T.BIGINT))
+    op = hash_join([0], [0], JoinType.FULL, output_capacity=4)
+    out, total, bm = jax.jit(op)(probe, build)
+    assert sorted(out.to_pylist(), key=str) == [(1, 1, 11), (5, None, None)]
+    assert list(np.asarray(bm)) == [True, False]
+    fin = unmatched_build_page(((T.BIGINT, None),))
+    tail = jax.jit(fin)(build, bm)
+    assert tail.to_pylist() == [(None, 7, 77)]
+
+
+def test_full_join_null_keys_both_sides():
+    probe = page_of(([1, 2], T.BIGINT, [1, 0]))
+    build = page_of(([1, 3], T.BIGINT, [0, 1]), ([10, 30], T.BIGINT))
+    op = hash_join([0], [0], JoinType.FULL, output_capacity=8)
+    out, total, bm = jax.jit(op)(probe, build)
+    # null probe key never matches -> both probe rows null-extended
+    assert sorted(out.to_pylist(), key=str) == [
+        (1, None, None), (None, None, None)]
+    assert list(np.asarray(bm)) == [False, False]
+
+
+def test_left_composite_collision_rescue(monkeypatch):
+    # force total hash collision: every composite key hashes identically, so
+    # verification must both drop fabricated matches AND rescue probe rows
+    # whose every candidate was a collision (ADVICE r1/r2 carryover)
+    import trino_tpu.ops.join as J
+    monkeypatch.setattr(J, "_mix64", lambda x: jnp.zeros_like(
+        x.astype(jnp.uint64)))
+    probe = page_of(([1, 2], T.BIGINT), ([10, 20], T.BIGINT))
+    build = page_of(([1, 9], T.BIGINT), ([10, 99], T.BIGINT),
+                    ([111, 999], T.BIGINT))
+    op = hash_join([0, 1], [0, 1], JoinType.LEFT, output_capacity=8)
+    out, total = op(probe, build)  # not jit: monkeypatch must stay visible
+    assert sorted(out.to_pylist(), key=str) == [
+        (1, 10, 1, 10, 111), (2, 20, None, None, None)]
+    assert int(total) == 2
+
+
+def test_mark_join_build_null_3vl():
+    # IN-subquery 3VL: no match + NULL on build side => NULL, not FALSE
+    probe = page_of(([1, 4, 7], T.BIGINT, [1, 1, 0]))
+    build = page_of(([1, 2], T.BIGINT, [1, 0]))
+    op = hash_join([0], [0], JoinType.MARK)
+    out, _ = jax.jit(op)(probe, build)
+    marks = [r[-1] for r in out.to_pylist()]
+    # 1 matches -> TRUE; 4 has no match but build has NULL -> NULL;
+    # NULL probe vs non-empty build -> NULL
+    assert marks == [True, None, None]
+
+
+def test_mark_join_no_build_nulls_definite_false():
+    probe = page_of(([1, 4], T.BIGINT))
+    build = page_of(([1, 2], T.BIGINT))
+    op = hash_join([0], [0], JoinType.MARK)
+    out, _ = jax.jit(op)(probe, build)
+    assert [r[-1] for r in out.to_pylist()] == [True, False]

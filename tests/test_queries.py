@@ -565,3 +565,59 @@ def test_window_rows_frame(runner, oracle):
     check(runner, oracle,
           "SELECT n_name, sum(n_nationkey) OVER (ORDER BY n_name "
           "ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) FROM nation")
+
+
+# ------------------------------------------------- outer joins (round 3)
+
+@pytest.fixture(scope="module")
+def outer_runner():
+    r = LocalQueryRunner.tpch("tiny")
+    r.execute("CREATE TABLE memory.default.lft (k bigint, a varchar)")
+    r.execute("INSERT INTO memory.default.lft VALUES "
+              "(1, 'one'), (2, 'two'), (NULL, 'nil'), (5, 'five')")
+    r.execute("CREATE TABLE memory.default.rgt (k bigint, b varchar)")
+    r.execute("INSERT INTO memory.default.rgt VALUES "
+              "(1, 'uno'), (3, 'tres'), (NULL, 'nul')")
+    return r
+
+
+def test_full_outer_join_sql(outer_runner):
+    rows = sorted(outer_runner.execute(
+        "SELECT l.k, a, r.k, b FROM memory.default.lft l "
+        "FULL OUTER JOIN memory.default.rgt r ON l.k = r.k").rows, key=str)
+    assert rows == sorted([
+        (1, "one", 1, "uno"), (2, "two", None, None),
+        (None, "nil", None, None), (5, "five", None, None),
+        (None, None, 3, "tres"), (None, None, None, "nul")], key=str)
+
+
+def test_right_outer_join_sql(outer_runner):
+    rows = sorted(outer_runner.execute(
+        "SELECT l.k, a, r.k, b FROM memory.default.lft l "
+        "RIGHT JOIN memory.default.rgt r ON l.k = r.k").rows, key=str)
+    assert rows == sorted([
+        (1, "one", 1, "uno"), (None, None, 3, "tres"),
+        (None, None, None, "nul")], key=str)
+
+
+def test_in_subquery_null_build_3vl(outer_runner):
+    # 4 not in rgt, but rgt.k contains NULL -> NULL (filtered out by WHERE,
+    # and visible as NULL when selected)
+    rows = outer_runner.execute(
+        "SELECT k, k IN (SELECT k FROM memory.default.rgt) "
+        "FROM memory.default.lft").rows
+    got = {r[0]: r[1] for r in rows}
+    assert got[1] is True
+    assert got[2] is None        # no match + NULL in subquery -> NULL
+    assert got[None] is None
+    assert got[5] is None
+
+
+def test_lag_varchar_with_default(outer_runner):
+    # dictionary-encoded arg + literal default: codes must be re-encoded
+    # onto a union pool, not decoded through the arg's dictionary
+    rows = outer_runner.execute(
+        "SELECT k, lag(a, 1, 'zzz') OVER (ORDER BY k) "
+        "FROM memory.default.lft WHERE k IS NOT NULL").rows
+    got = sorted([r for r in rows], key=lambda r: r[0])
+    assert got == [(1, "zzz"), (2, "one"), (5, "two")]

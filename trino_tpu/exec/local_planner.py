@@ -431,9 +431,14 @@ class LocalExecutionPlanner:
     def _exec_JoinNode(self, node: JoinNode) -> PageStream:
         if node.kind == JoinKind.CROSS and not node.criteria:
             return self._exec_cross_join(node)
-        if node.kind in (JoinKind.RIGHT, JoinKind.FULL):
-            raise ExecutionError(f"{node.kind} join execution not supported "
-                                 "yet")
+        if node.kind == JoinKind.RIGHT:
+            # execute as LEFT with sides swapped, then restore column order
+            # (the engine always probes with the preserved side; reference
+            # reaches the same shape via LookupJoinOperatorFactory's
+            # probe/build orientation)
+            return self._exec_right_join(node)
+        if node.kind == JoinKind.FULL:
+            return self._exec_full_join(node)
         probe_stream = self.execute(node.left)
         build_stream = self.execute(node.right)
         probe_lay, probe_typ = _layout(probe_stream.symbols)
@@ -481,6 +486,78 @@ class LocalExecutionPlanner:
                 build_page = self._null_build_page(node.right.outputs)
             yield from _run_with_overflow(
                 probe_stream, build_page, join_op, self.page_capacity)
+        return PageStream(gen(), out_symbols)
+
+    def _exec_right_join(self, node: JoinNode) -> PageStream:
+        flipped = JoinNode(
+            JoinKind.LEFT, node.right, node.left,
+            tuple(JoinClause(c.right, c.left) for c in node.criteria),
+            node.filter, node.distribution)
+        stream = self.execute(flipped)
+        return _reorder_stream(stream,
+                               node.left.outputs + node.right.outputs)
+
+    def _exec_full_join(self, node: JoinNode) -> PageStream:
+        """FULL outer: LEFT-join streaming over probe pages while
+        accumulating which build rows matched, then emit the never-matched
+        build rows null-extended (LookupOuterOperator analog)."""
+        from trino_tpu.ops.join import unmatched_build_page
+        if node.filter is not None:
+            raise ExecutionError(
+                "non-inner join with residual filter not supported")
+        probe_stream = self.execute(node.left)
+        build_stream = self.execute(node.right)
+        probe_lay, _ = _layout(probe_stream.symbols)
+        build_lay, _ = _layout(build_stream.symbols)
+        probe_keys = [probe_lay[c.left.name] for c in node.criteria]
+        build_keys = [build_lay[c.right.name] for c in node.criteria]
+        build_page = self._collect(build_stream)
+        out_symbols = node.left.outputs + node.right.outputs
+        probe_meta = tuple((s.type, None) for s in node.left.outputs)
+
+        def full_op(cap: int):
+            return cached_kernel(
+                ("fulljoin", tuple(probe_keys), tuple(build_keys), cap),
+                lambda: hash_join(probe_keys, build_keys, JoinType.FULL,
+                                  output_capacity=cap))
+
+        def gen():
+            import itertools
+            nonlocal probe_meta
+            bp = build_page
+            if bp is None:
+                bp = self._null_build_page(node.right.outputs)
+            matched = jnp.zeros(bp.capacity, dtype=jnp.bool_)
+            it = probe_stream.iter_pages()
+            while True:
+                # lookahead-batched overflow resolution (same transfer
+                # discipline as _run_with_overflow: one device_get per
+                # window, not per page)
+                batch = list(itertools.islice(it, 8))
+                if not batch:
+                    break
+                results = []
+                for page in batch:
+                    probe_meta = tuple(
+                        (c.type, c.dictionary) for c in page.columns)
+                    cap = max(self.page_capacity, page.capacity)
+                    results.append((cap, full_op(cap)(page, bp)))
+                totals = jax.device_get([t for _, (_, t, _) in results])
+                for page, (cap, (out, _, bm)), total in zip(
+                        batch, results, totals):
+                    total = int(total)
+                    while total > cap:
+                        cap = _next_pow2(total)
+                        out, t, bm = full_op(cap)(page, bp)
+                        total = int(t)
+                    matched = matched | bm
+                    yield out
+            if int(bp.num_rows) == 0:
+                return
+            # once-per-query finisher: executed eagerly (its dictionaries
+            # are per-query objects — caching on them would pin string
+            # pools in the process-lifetime kernel cache forever)
+            yield unmatched_build_page(probe_meta)(bp, matched)
         return PageStream(gen(), out_symbols)
 
     def _null_build_page(self, symbols: Tuple[Symbol, ...]) -> Page:
@@ -632,8 +709,9 @@ class LocalExecutionPlanner:
     def _exec_AssignUniqueIdNode(self, node) -> PageStream:
         """AssignUniqueIdOperator: tag rows with a stable unique id.
 
-        Ids are the global row position in stream order; the scan order is
-        deterministic, so re-executing the same subtree (shared by a
+        Ids are page_capacity_offset + row_position (NOT dense: padding rows
+        consume ids too), so they are unique and — because scan order is
+        deterministic — re-executing the same subtree (shared by a
         decorrelated EXISTS) reproduces identical ids."""
         src = self.execute(node.source)
 
@@ -647,13 +725,14 @@ class LocalExecutionPlanner:
         tag = cached_kernel(("assign-unique-id",), build)
 
         def gen():
+            # advance by page CAPACITY, not num_rows: padding rows get ids
+            # too, so live rows of later pages can never collide with them
+            # (uniqueness is this symbol's whole contract), and no per-page
+            # num_rows host sync is needed
             offset = 0
             for page in src.iter_pages():
-                n = int(page.num_rows)
-                if n == 0:
-                    continue
                 yield tag(page, jnp.int64(offset))
-                offset += n
+                offset += page.capacity
         return PageStream(gen(), node.source.outputs + (node.id_symbol,))
 
     def _exec_EnforceSingleRowNode(self, node) -> PageStream:
@@ -754,16 +833,7 @@ class LocalExecutionPlanner:
 
     def _exec_OutputNode(self, node: OutputNode) -> PageStream:
         src = self.execute(node.source)
-        lay, _ = _layout(src.symbols)
-        order = tuple(lay[s.name] for s in node.symbols)
-        if order == tuple(range(len(src.symbols))):
-            return PageStream(src.pages, node.symbols, src.pending)
-        return PageStream(
-            src.pages, node.symbols,
-            src.pending + ((("select", order),
-                            lambda: lambda p: Page(
-                                tuple(p.columns[c] for c in order),
-                                p.num_rows)),))
+        return _reorder_stream(src, node.symbols)
 
     def _exec_TableWriterNode(self, node: TableWriterNode) -> PageStream:
         src = self.execute(node.source)
@@ -789,27 +859,50 @@ class LocalExecutionPlanner:
         return PageStream(gen(), node.outputs)
 
 
+def _reorder_stream(src: PageStream, symbols: Tuple[Symbol, ...]
+                    ) -> PageStream:
+    """Select/reorder a stream's columns to `symbols` (identity is free)."""
+    lay, _ = _layout(src.symbols)
+    order = tuple(lay[s.name] for s in symbols)
+    if order == tuple(range(len(src.symbols))):
+        return PageStream(src.pages, symbols, src.pending)
+    return PageStream(
+        src.pages, symbols,
+        src.pending + ((("select", order),
+                        lambda: lambda p: Page(
+                            tuple(p.columns[c] for c in order),
+                            p.num_rows)),))
+
+
 def _run_with_overflow(probe_stream: PageStream, build_page: Page,
-                       make_op, page_capacity: int) -> Iterator[Page]:
-    """Dispatch a capacity-laddered binary page op over every probe page,
-    then resolve ALL overflow counters in one batched device_get (a sync per
-    page costs a full round trip on remote TPUs); only pages that actually
-    overflowed re-run at the next capacity bucket (SURVEY §7 contract)."""
-    probe_pages = list(probe_stream.iter_pages())
-    if not probe_pages:
-        return
-    results = []
-    for page in probe_pages:
-        cap = max(page_capacity, page.capacity)
-        results.append((cap, make_op(cap)(page, build_page)))
-    totals = jax.device_get([t for _, (_, t) in results])
-    for page, (cap, (out, _)), total in zip(probe_pages, results, totals):
-        total = int(total)
-        while total > cap:
-            cap = _next_pow2(total)
-            out, t = make_op(cap)(page, build_page)
-            total = int(t)
-        yield out
+                       make_op, page_capacity: int,
+                       lookahead: int = 8) -> Iterator[Page]:
+    """Dispatch a capacity-laddered binary page op over probe pages in
+    bounded lookahead windows, resolving each window's overflow counters in
+    one batched device_get (a sync per page costs a full round trip on
+    remote TPUs, but dispatching the whole stream before the first sync
+    would pin every intermediate output in HBM simultaneously); only pages
+    that actually overflowed re-run at the next capacity bucket (SURVEY §7
+    contract)."""
+    import itertools
+    it = probe_stream.iter_pages()
+    while True:
+        probe_pages = list(itertools.islice(it, lookahead))
+        if not probe_pages:
+            return
+        results = []
+        for page in probe_pages:
+            cap = max(page_capacity, page.capacity)
+            results.append((cap, make_op(cap)(page, build_page)))
+        totals = jax.device_get([t for _, (_, t) in results])
+        for page, (cap, (out, _)), total in zip(probe_pages, results,
+                                                totals):
+            total = int(total)
+            while total > cap:
+                cap = _next_pow2(total)
+                out, t = make_op(cap)(page, build_page)
+                total = int(t)
+            yield out
 
 
 def _chain_first(first: Optional[Page], rest: Iterator[Page]) -> Iterator[Page]:

@@ -13,13 +13,13 @@ static-shape: the output page has a planner-chosen capacity; the operator also
 returns the true match total so the executor can detect overflow and re-run
 at a larger capacity bucket (SURVEY §7 hard part 1).
 
-Composite keys collapse to one u64 via a mixing hash; INNER joins verify the
-real key columns post-expansion so collisions are filtered exactly, and
-SEMI/ANTI joins re-check candidates and scatter the verdict back per probe
-row. (LEFT composite joins currently trust the 64-bit hash — collision-
-verification with null-row re-extension is a planned refinement.) SQL semantics: NULL join keys
-never match (including NULL = NULL); LEFT rows without matches emit once with
-build side NULL.
+Composite keys collapse to one u64 via a mixing hash and every join type
+verifies the real key columns post-expansion: INNER/LEFT/FULL filter
+collision slots exactly (LEFT/FULL additionally rescue probe rows whose
+every candidate was a collision as null-extension rows), and SEMI/ANTI/MARK
+re-check candidates and scatter the verdict back per probe row. SQL
+semantics: NULL join keys never match (including NULL = NULL); LEFT/FULL
+rows without matches emit once with the other side NULL.
 """
 
 from __future__ import annotations
@@ -39,6 +39,9 @@ class JoinType:
     LEFT = "left"          # probe side preserved
     SEMI = "semi"          # probe rows with >=1 match (IN / EXISTS)
     ANTI = "anti"          # probe rows with 0 matches (NOT IN w/o nulls)
+    FULL = "full"          # both sides preserved (executor accumulates the
+                           # build-matched mask and emits unmatched build
+                           # rows via unmatched_build_page)
     MARK = "mark"          # all probe rows + bool match channel
     # (HashSemiJoinOperator appends the semi-join result as a column;
     # used when the match symbol escapes into projections/other filters)
@@ -81,13 +84,17 @@ def _key_u64(page: Page, channels: Sequence[int]) -> Tuple[jnp.ndarray, jnp.ndar
 
 
 def _mark_page(probe: Page, matched: jnp.ndarray, pnull: jnp.ndarray,
-               n_live_build: jnp.ndarray) -> Page:
+               n_build_rows: jnp.ndarray,
+               build_has_null: jnp.ndarray) -> Page:
     """Append the semi-join verdict as a boolean channel.
 
-    3VL: a NULL probe key against a non-empty build side yields NULL (the
-    IN-subquery contract); everything else is a definite true/false."""
+    Full IN-subquery 3VL: TRUE on a key match; NULL when the probe key is
+    NULL against a non-empty build side, OR when there is no match but the
+    build side contains a NULL key; FALSE otherwise (incl. any probe against
+    an empty build side)."""
     value = matched & ~pnull
-    valid = ~(pnull & (n_live_build > 0))
+    definite = jnp.where(pnull, n_build_rows == 0, ~build_has_null)
+    valid = matched | definite
     mark = Column(value, valid, T.BOOLEAN, None)
     return Page(tuple(probe.columns) + (mark,), probe.num_rows)
 
@@ -137,6 +144,9 @@ def hash_join(
             num_keys=2)
         bkey_s, b_dead_s, bperm = sort_ops
         n_live_build = jnp.sum(~b_dead_s).astype(jnp.int32)
+        live_b = build.row_mask()
+        n_build_rows = jnp.sum(live_b).astype(jnp.int32)
+        build_has_null = jnp.any(bnull & live_b)
 
         p_dead = ~probe.row_mask() | pnull
         # searchsorted over the live prefix: clamp indices into [0, n_live]
@@ -150,8 +160,8 @@ def hash_join(
                 and not (composite and verify_composite):
             # single-column keys: to_u64 is injective, hash match == key match
             if join_type == JoinType.MARK:
-                return _mark_page(probe, counts > 0, pnull,
-                                  n_live_build), \
+                return _mark_page(probe, counts > 0, pnull, n_build_rows,
+                                  build_has_null), \
                     probe.num_rows.astype(jnp.int64)
             if join_type == JoinType.SEMI:
                 out = probe.filter((counts > 0) & ~p_dead)
@@ -160,7 +170,7 @@ def hash_join(
             return out, out.num_rows.astype(jnp.int64)
 
         emit = counts
-        if join_type == JoinType.LEFT:
+        if join_type in (JoinType.LEFT, JoinType.FULL):
             # unmatched live probe rows (incl. null keys) emit one null-extended row
             live_probe = probe.row_mask()
             emit = jnp.where(live_probe & (counts == 0), 1, counts)
@@ -194,7 +204,8 @@ def hash_join(
                 keep, mode="drop")
             if join_type == JoinType.MARK:
                 rows = probe.num_rows.astype(jnp.int64)
-                return _mark_page(probe, verified, pnull, n_live_build), \
+                return _mark_page(probe, verified, pnull, n_build_rows,
+                                  build_has_null), \
                     jnp.where(total <= cap, rows, total)
             if join_type == JoinType.SEMI:
                 out = probe.filter(verified & ~p_dead)
@@ -203,9 +214,33 @@ def hash_join(
             rows = out.num_rows.astype(jnp.int64)
             return out, jnp.where(total <= cap, rows, total)
 
+        real_match = slot_live & matched      # slot is a real hash candidate
+        build_is_null = slot_live & ~matched  # LEFT/FULL null-extension rows
+
+        # composite keys: re-check real key equality per candidate slot so
+        # hash collisions are filtered exactly (single-key u64 is injective)
+        keep = jnp.ones(cap, dtype=jnp.bool_)
+        if composite and verify_composite:
+            for pk, bk in zip(probe_keys, build_keys):
+                pv = jnp.take(probe.column(pk).values, prow_c, mode="clip")
+                bv = jnp.take(build.column(bk).values, brow, mode="clip")
+                keep = keep & (pv == bv)
+        verified_slot = real_match & keep
+
+        if join_type in (JoinType.LEFT, JoinType.FULL) and composite \
+                and verify_composite:
+            # a probe row whose EVERY candidate was a hash collision must
+            # still emit one null-extended row: rescue its first candidate
+            # slot as the null-extension carrier
+            verified_any = jnp.zeros(n_probe, dtype=jnp.bool_) \
+                .at[prow_c].max(verified_slot, mode="drop")
+            rescue = real_match & (j_within == 0) & \
+                ~jnp.take(verified_any, prow_c, mode="clip")
+            build_is_null = build_is_null | rescue
+            keep = keep | rescue
+
         pcols = tuple(c.gather(prow_c) for c in probe.columns)
         bcols = []
-        build_is_null = slot_live & ~matched  # LEFT null-extension rows
         for c in build.columns:
             g = c.gather(brow)
             valid = g.valid_mask() & ~build_is_null
@@ -213,19 +248,44 @@ def hash_join(
         out_rows = jnp.minimum(total, cap).astype(jnp.int32)
         out_page = Page(pcols + tuple(bcols), out_rows)
 
-        if composite and verify_composite and join_type == JoinType.INNER:
-            # filter hash-collision rows by re-checking real key equality
-            keep = jnp.ones(cap, dtype=jnp.bool_)
-            for pk, bk in zip(probe_keys, build_keys):
-                pv = out_page.column(pk)
-                bv = out_page.column(n_probe_cols + bk)
-                keep = keep & (pv.values == bv.values)
-            out_page = out_page.filter(keep)
+        if composite and verify_composite:
+            # drop collision slots (null-extension slots pass: matched=False
+            # there so keep was never narrowed for them... they start True)
+            keep_final = jnp.where(real_match, keep, True)
+            out_page = out_page.filter(keep_final)
             # overflow contract: if every hash match fit in cap, the filtered
             # count is the exact total; else keep the (over)count so the
             # executor re-plans at a larger capacity
             total = jnp.where(total <= cap,
                               out_page.num_rows.astype(jnp.int64), total)
+
+        if join_type == JoinType.FULL:
+            # which build rows found >=1 verified probe match (accumulated by
+            # the executor across probe pages; unmatched rows emit at end)
+            build_matched = jnp.zeros(n_build, dtype=jnp.bool_) \
+                .at[brow].max(verified_slot, mode="drop")
+            return out_page, total, build_matched
         return out_page, total
+
+    return op
+
+
+def unmatched_build_page(probe_meta: Sequence[Tuple[T.Type, object]],
+                         ) -> Callable[[Page, jnp.ndarray], Page]:
+    """FULL-join finisher (operator/join/LookupOuterOperator.java analog):
+    emit build rows never matched by any probe page, null-extended on the
+    probe side. `matched` is the OR of per-page build_matched masks;
+    `probe_meta` is (type, dictionary) per probe column so null columns keep
+    the stream's dictionaries (concat/union safety downstream)."""
+    probe_meta = tuple(probe_meta)
+
+    def op(build: Page, matched: jnp.ndarray) -> Page:
+        kept = build.filter(~matched & build.row_mask())
+        cap = kept.capacity
+        pcols = tuple(
+            Column(jnp.zeros(cap, dtype=t.dtype),
+                   jnp.zeros(cap, dtype=jnp.bool_), t, d)
+            for t, d in probe_meta)
+        return Page(pcols + tuple(kept.columns), kept.num_rows)
 
     return op

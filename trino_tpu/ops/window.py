@@ -162,13 +162,28 @@ def _eval(spec: WindowSpec, page: Page, live, idx, seg_b, seg_id, seg_start,
         valid = in_seg
         if x.valid is not None:
             valid = valid & jnp.take(x.valid, tgt_c)
+        out_dict = x.dictionary
         if len(spec.arg_channels) > 2:       # explicit default
             dflt = arg(2)
-            vals = jnp.where(in_seg, vals, dflt.values)
+            dvals = dflt.values
+            if x.dictionary is not dflt.dictionary:
+                # dictionary-encoded arg with a differently-encoded default
+                # (e.g. literal singleton pool): re-encode both onto a shared
+                # union pool at trace time (dictionaries are static aux data)
+                if x.dictionary is None or dflt.dictionary is None:
+                    raise NotImplementedError(
+                        "lead/lag mixes dictionary and non-dictionary "
+                        "operands")
+                from trino_tpu.page import union_dictionaries
+                out_dict, (rx, rd) = union_dictionaries(
+                    [x.dictionary, dflt.dictionary])
+                vals = jnp.take(rx, jnp.clip(vals, 0), mode="clip")
+                dvals = jnp.take(rd, jnp.clip(dvals, 0), mode="clip")
+            vals = jnp.where(in_seg, vals, dvals)
             valid = jnp.where(in_seg, valid,
                               dflt.valid if dflt.valid is not None
                               else jnp.ones(n, jnp.bool_))
-        return Column(vals, valid, spec.out_type, x.dictionary)
+        return Column(vals, valid, spec.out_type, out_dict)
 
     if name in ("first_value", "last_value", "nth_value"):
         x = arg(0)
