@@ -1,0 +1,426 @@
+"""Distributed execution: fragmented plans over a device mesh.
+
+Reference parity: execution/scheduler/SqlQueryScheduler.java:112 (stages from
+fragments, dependency-ordered start), PlanFragmenter.java:108 (the fragment
+tree consumed here), execution/scheduler/PhasedExecutionSchedule.java
+(build-before-probe ordering), server/remotetask + execution/buffer (the
+HTTP data plane, replaced wholesale by mesh collectives).
+
+TPU-first design (SURVEY §2.11, §7): a single-controller process drives a
+`QueryMesh`; each PlanFragment executes as N per-shard "tasks" through the
+same operator pipelines as local execution, with leaf scans sharded by split
+(`SourcePartitionedScheduler` analog) and REMOTE exchanges lowered to ONE
+jitted `shard_map` collective program per fragment edge:
+
+  REPARTITION -> all_to_all_by_key (FIXED_HASH_DISTRIBUTION)
+  BROADCAST   -> broadcast_page    (FIXED_BROADCAST_DISTRIBUTION)
+  GATHER      -> broadcast_page, shard 0 consumes (SINGLE distribution)
+  MERGE       -> gather + re-sort  (ordered MergeOperator analog)
+
+Pages cross fragment boundaries without leaving devices: per-shard outputs
+are stacked into one globally-sharded Page (leading axis = workers), the
+collective runs on the mesh, and the result is viewed back per-shard through
+the sharded array's addressable shards. The all_to_all bucket capacity uses
+the same overflow-ladder contract as the join/page kernels: the collective
+psums an overflow count and the host re-runs the exchange with a doubled
+bucket until it fits.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.exec.local_planner import (
+    ExecutionError, LocalExecutionPlanner, PageStream, _layout, _next_pow2,
+    compose_chain)
+from trino_tpu.exec.jit_cache import cached_kernel
+from trino_tpu.exec.runner import LocalQueryRunner, MaterializedResult
+from trino_tpu.metadata import Metadata, Session
+from trino_tpu.ops import AggSpec, SortKey, Step, hash_aggregate, order_by
+from trino_tpu.ops.aggregate import get_aggregate
+from trino_tpu.page import Column, Page, concat_pages, union_dictionaries
+from trino_tpu.parallel.exchange import (all_to_all_by_key, broadcast_page)
+from trino_tpu.parallel.mesh import QueryMesh
+from trino_tpu.planner.nodes import (
+    AggregationNode, AggStep, ExchangeKind, OutputNode, Symbol,
+    TableScanNode, ValuesNode)
+from trino_tpu.planner.optimizer import (
+    PlanFragment, RemoteSourceNode, fragment_plan, optimize)
+from trino_tpu.sql import tree as t
+
+
+class ShardExecutionPlanner(LocalExecutionPlanner):
+    """One distributed 'task': the local operator pipelines, executing shard
+    `shard` of `n_shards` (execution/SqlTaskExecution.java analog).
+
+    Differences from local execution:
+      - leaf scans read only this shard's splits (split.part % n == shard);
+      - RemoteSourceNodes read the post-collective input staged for this
+        shard by the DistributedQueryRunner;
+      - VALUES (SINGLE-distribution leaves) materialize on shard 0 only;
+      - PARTIAL/FINAL aggregation steps execute as written instead of being
+        fused into one operator (the exchange sits between them);
+      - unique ids are disjoint across shards.
+    """
+
+    def __init__(self, metadata: Metadata, session: Session, shard: int,
+                 n_shards: int,
+                 exchange_inputs: Dict[int, List[Optional[Page]]]):
+        super().__init__(metadata, session)
+        self.shard = shard
+        self.n_shards = n_shards
+        self.exchange_inputs = exchange_inputs
+
+    # ------------------------------------------------------------- leaves
+
+    def _exec_TableScanNode(self, node: TableScanNode) -> PageStream:
+        conn = self.metadata.connector(node.catalog)
+        columns = [c for _, c in node.assignments]
+        splits = conn.split_manager.get_splits(
+            node.table, target_splits=self.n_shards)
+        mine = [s for s in splits if s.part % self.n_shards == self.shard]
+        cap = self._split_capacity(conn, node, splits)
+
+        def gen():
+            for split in mine:
+                yield from conn.page_source.pages(split, columns, cap)
+        return PageStream(gen(), tuple(s for s, _ in node.assignments))
+
+    def _split_capacity(self, conn, node: TableScanNode, splits) -> int:
+        cap = self.page_capacity
+        try:
+            stats = conn.metadata.get_table_statistics(node.table)
+            rows = int(stats.row_count) if stats and stats.row_count else 0
+        except Exception:
+            rows = 0
+        per_split = math.ceil(rows / max(1, len(splits)))
+        if per_split > cap:
+            max_cap = int(self.session.get("scan_page_capacity"))
+            cap = min(_next_pow2(per_split), max_cap)
+        return cap
+
+    def _exec_ValuesNode(self, node: ValuesNode) -> PageStream:
+        if self.shard != 0:
+            return PageStream(iter(()), node.symbols)
+        return super()._exec_ValuesNode(node)
+
+    def _exec_RemoteSourceNode(self, node: RemoteSourceNode) -> PageStream:
+        pages = self.exchange_inputs.get(node.fragment_id)
+        page = None if pages is None else pages[self.shard]
+        if page is None:
+            return PageStream(iter(()), node.symbols)
+        return PageStream(iter([page]), node.symbols)
+
+    # -------------------------------------------------------- aggregation
+
+    def _exec_AggregationNode(self, node: AggregationNode) -> PageStream:
+        if node.step == AggStep.SINGLE:
+            return super()._exec_AggregationNode(node)
+        if node.step == AggStep.PARTIAL:
+            return self._exec_partial_agg(node)
+        return self._exec_final_agg(node)
+
+    def _agg_specs(self, node: AggregationNode, lay, typ) -> List[AggSpec]:
+        specs = []
+        for out_sym, call in node.aggregations:
+            if call.args:
+                arg = call.args[0]
+                input_ch: Optional[int] = lay[arg.name] if lay else None
+                in_type: Optional[T.Type] = call.input_type
+            else:
+                input_ch, in_type = None, None
+            mask_ch = None
+            if call.filter is not None:
+                mask_ch = lay[call.filter.name]
+            specs.append(AggSpec(call.name, input_ch, in_type, mask_ch,
+                                 call.distinct))
+        return specs
+
+    def _exec_partial_agg(self, node: AggregationNode) -> PageStream:
+        src = self.execute(node.source)
+        lay, typ = _layout(src.symbols)
+        key_channels = tuple(lay[s.name] for s in node.group_by)
+        specs = tuple(self._agg_specs(node, lay, typ))
+        partial_op = compose_chain(
+            src.pending, ("agg-partial", key_channels, specs),
+            lambda: hash_aggregate(list(key_channels), list(specs),
+                                   Step.PARTIAL))
+
+        def gen():
+            for page in src.pages:
+                yield partial_op(page)
+        return PageStream(gen(), node.outputs)
+
+    def _exec_final_agg(self, node: AggregationNode) -> PageStream:
+        src = self.execute(node.source)
+        specs = tuple(self._agg_specs(node, None, None))
+        nkeys = len(node.group_by)
+        state_channels = []
+        ch = nkeys
+        for spec in specs:
+            fn = get_aggregate(spec.name, spec.input_type)
+            k = len(fn.state(spec.input_type))
+            state_channels.append(list(range(ch, ch + k)))
+            ch += k
+        final_op = cached_kernel(
+            ("agg-final", nkeys, specs),
+            lambda: hash_aggregate(list(range(nkeys)), list(specs),
+                                   Step.FINAL, state_channels))
+
+        def gen():
+            page = self._collect(src)
+            if page is None or int(page.num_rows) == 0:
+                if not node.group_by:
+                    yield self._empty_global_agg(node, specs)
+                return
+            yield final_op(page)
+        return PageStream(gen(), node.outputs)
+
+    # ------------------------------------------------------------- unique
+
+    def _exec_AssignUniqueIdNode(self, node) -> PageStream:
+        stream = super()._exec_AssignUniqueIdNode(node)
+        base = jnp.int64(self.shard) << jnp.int64(44)
+        if self.shard == 0:
+            return stream
+
+        def gen():
+            for page in stream.iter_pages():
+                col = page.columns[-1]
+                shifted = Column(col.values + base, col.valid, col.type,
+                                 None)
+                yield Page(page.columns[:-1] + (shifted,), page.num_rows)
+        return PageStream(gen(), stream.symbols)
+
+
+class DistributedQueryRunner(LocalQueryRunner):
+    """Multi-shard engine over a QueryMesh.
+
+    Reference parity: testing/DistributedQueryRunner.java:72 — the same SQL
+    surface as LocalQueryRunner, but SELECT queries plan with
+    `distributed=True`, fragment at REMOTE exchanges, and execute stage-by-
+    stage over the mesh with collective exchanges. DDL/DML and session
+    statements run through the local path (coordinator-only work).
+    """
+
+    def __init__(self, session: Optional[Session] = None,
+                 devices: Optional[Sequence] = None):
+        super().__init__(session)
+        self.mesh = QueryMesh(devices)
+        self._exchange_jits: Dict[tuple, object] = {}
+
+    @classmethod
+    def tpch(cls, schema: str = "tiny",
+             devices: Optional[Sequence] = None) -> "DistributedQueryRunner":
+        from trino_tpu.connector import blackhole, memory, tpch as tpch_conn
+        runner = cls(Session(catalog="tpch", schema=schema), devices)
+        runner.catalogs.register("tpch", tpch_conn.create_connector())
+        runner.catalogs.register("memory", memory.create_connector())
+        runner.catalogs.register("blackhole", blackhole.create_connector())
+        return runner
+
+    # ------------------------------------------------------------ execute
+
+    def _execute_query(self, query: t.Query) -> MaterializedResult:
+        plan = self._plan_distributed(query)
+        frag = fragment_plan(plan)
+        exchange_inputs = self._schedule_children(frag)
+        executor = ShardExecutionPlanner(
+            self.metadata, self.session, 0, self.mesh.n, exchange_inputs)
+        root_stream = executor.execute(frag.root)
+        types = [s.type for s in plan.symbols]
+        rows = []
+        for page in root_stream.iter_pages():
+            n = int(page.num_rows)
+            if n == 0:
+                continue
+            cols = page.to_host(n)
+            from trino_tpu.exec.runner import _to_python
+            for i in range(n):
+                rows.append(tuple(_to_python(cols[j][i], types[j])
+                                  for j in range(len(cols))))
+        return MaterializedResult(list(plan.column_names), types, rows)
+
+    def _plan_distributed(self, query: t.Statement) -> OutputNode:
+        from trino_tpu.planner import LogicalPlanner
+        plan = LogicalPlanner(self.metadata, self.session).plan(query)
+        return optimize(plan, self.metadata, self.session, distributed=True)
+
+    # --------------------------------------------------------- scheduling
+
+    def _schedule_children(self, frag: PlanFragment
+                           ) -> Dict[int, List[Optional[Page]]]:
+        """Run every child fragment and lower its consuming exchange to a
+        collective. Build-before-probe: later sources (join build sides are
+        the right/second child) schedule first (PhasedExecutionSchedule)."""
+        exchange_inputs: Dict[int, List[Optional[Page]]] = {}
+        for child in reversed(frag.children):
+            child_pages = self._run_fragment_to_pages(child)
+            remote = _find_remote(frag.root, child.fragment_id)
+            exchange_inputs[child.fragment_id] = self._apply_exchange(
+                child_pages, remote)
+        return exchange_inputs
+
+    def _run_fragment_to_pages(self, frag: PlanFragment
+                               ) -> List[Optional[Page]]:
+        """Run one non-root fragment on its participating shards; returns one
+        concatenated output Page per shard (None = shard produced nothing)."""
+        exchange_inputs = self._schedule_children(frag)
+        shards = [0] if frag.partitioning == "single" else \
+            list(range(self.mesh.n))
+        out: List[Optional[Page]] = [None] * self.mesh.n
+        for shard in shards:
+            executor = ShardExecutionPlanner(
+                self.metadata, self.session, shard, self.mesh.n,
+                exchange_inputs)
+            stream = executor.execute(frag.root)
+            pages = [p for p in stream.iter_pages()
+                     if int(p.num_rows) > 0]
+            if pages:
+                out[shard] = pages[0] if len(pages) == 1 \
+                    else concat_pages(pages)
+        return out
+
+    # ------------------------------------------------------ exchange plane
+
+    def _apply_exchange(self, child_pages: List[Optional[Page]],
+                        remote: RemoteSourceNode) -> List[Optional[Page]]:
+        n = self.mesh.n
+        ref = next((p for p in child_pages if p is not None), None)
+        if ref is None:
+            return [None] * n
+        pages = [_empty_like(p if p is not None else ref)
+                 if p is None else p for p in child_pages]
+        pages = _normalize_pages(pages)
+        global_page = self.mesh.shard_pages(pages)
+
+        if remote.kind == ExchangeKind.REPARTITION:
+            lay = {s.name: i for i, s in enumerate(remote.symbols)}
+            keys = tuple(lay[s.name] for s in remote.partition_keys)
+            cap = pages[0].capacity
+            bucket = max(1024, _next_pow2(max(1, cap // n)))
+            while True:
+                out, overflow = self._exchange_jit(
+                    "a2a", keys, bucket)(global_page)
+                if int(np.max(np.asarray(jax.device_get(overflow)))) == 0:
+                    break
+                bucket *= 2
+                if bucket > cap:
+                    # a shard can never send more than cap rows to one peer
+                    out, overflow = self._exchange_jit(
+                        "a2a", keys, cap)(global_page)
+                    break
+            return _unstack_page(out, n)
+
+        # BROADCAST / GATHER / MERGE all materialize the full relation on
+        # every shard via all_gather; GATHER consumers are single-shard
+        # fragments that read shard 0, MERGE re-sorts below
+        out = self._exchange_jit("gather", (), 0)(global_page)
+        per_shard = _unstack_page(out, n)
+        if remote.kind == ExchangeKind.MERGE and remote.order_by:
+            lay = {s.name: i for i, s in enumerate(remote.symbols)}
+            sort_keys = tuple(
+                SortKey(lay[o.symbol.name], o.ascending, o.nulls_first)
+                for o in remote.order_by)
+            sort_op = cached_kernel(("merge-sort", sort_keys),
+                                    lambda: order_by(list(sort_keys)))
+            per_shard = [None if p is None else sort_op(p)
+                         for p in per_shard]
+        return per_shard
+
+    def _exchange_jit(self, kind: str, keys: tuple, bucket: int):
+        key = (kind, keys, bucket)
+        fn = self._exchange_jits.get(key)
+        if fn is None:
+            if kind == "a2a":
+                def prog(page):
+                    return all_to_all_by_key(page, list(keys), bucket)
+            else:
+                def prog(page):
+                    return broadcast_page(page)
+            fn = jax.jit(self.mesh.shard_map(prog))
+            self._exchange_jits[key] = fn
+        return fn
+
+
+# ---------------------------------------------------------------------------
+# page plumbing for the collective data plane
+
+
+def _find_remote(node, fragment_id: int) -> RemoteSourceNode:
+    if isinstance(node, RemoteSourceNode) and node.fragment_id == fragment_id:
+        return node
+    for s in node.sources:
+        found = _find_remote(s, fragment_id)
+        if found is not None:
+            return found
+    return None
+
+
+def _empty_like(ref: Page) -> Page:
+    cols = tuple(Column(jnp.zeros_like(c.values),
+                        None if c.valid is None else jnp.zeros_like(c.valid),
+                        c.type, c.dictionary) for c in ref.columns)
+    return Page(cols, jnp.asarray(0, dtype=jnp.int32))
+
+
+def _normalize_pages(pages: List[Page]) -> List[Page]:
+    """Make per-shard pages stackable into one global pytree: equal
+    capacities, uniform validity-mask presence, and shared dictionaries per
+    column (re-encode onto a union pool when shards disagree)."""
+    cap = max(p.capacity for p in pages)
+    pages = [p.pad_to(_next_pow2(cap)) if p.capacity < cap else p
+             for p in pages]
+    cap = max(p.capacity for p in pages)
+    pages = [p.pad_to(cap) for p in pages]
+    ncols = pages[0].num_columns
+    out_cols: List[List[Column]] = [list(p.columns) for p in pages]
+    for ci in range(ncols):
+        cols = [p.column(ci) for p in pages]
+        dicts = {id(c.dictionary): c.dictionary for c in cols
+                 if c.dictionary is not None}
+        remap = None
+        union = None
+        if len(dicts) > 1:
+            union, tables = union_dictionaries(list(dicts.values()))
+            remap = {did: tbl for did, tbl in zip(dicts, tables)}
+        any_valid = any(c.valid is not None for c in cols)
+        for pi, c in enumerate(cols):
+            values = c.values
+            dictionary = c.dictionary
+            if remap is not None and c.dictionary is not None:
+                values = jnp.take(remap[id(c.dictionary)],
+                                  jnp.clip(values, 0), mode="clip")
+                dictionary = union
+            valid = c.valid
+            if any_valid and valid is None:
+                valid = jnp.ones(c.capacity, dtype=jnp.bool_)
+            out_cols[pi][ci] = Column(values, valid, c.type, dictionary)
+    return [Page(tuple(cs), jnp.asarray(p.num_rows, dtype=jnp.int32))
+            for cs, p in zip(out_cols, pages)]
+
+
+def _unstack_page(global_page: Page, n: int) -> List[Optional[Page]]:
+    """View a workers-sharded global Page as per-shard Pages without a host
+    round trip: each leaf's addressable shards are the per-device blocks."""
+    leaves, treedef = jax.tree_util.tree_flatten(global_page)
+    per_shard: List[list] = [[] for _ in range(n)]
+    for leaf in leaves:
+        shards = sorted(
+            leaf.addressable_shards,
+            key=lambda s: (s.index[0].start or 0) if s.index else 0)
+        if len(shards) != n:
+            # replicated or single-device leaf: slice on host
+            data = jax.device_get(leaf)
+            for k in range(n):
+                per_shard[k].append(jnp.asarray(data[k]))
+            continue
+        for k, s in enumerate(shards):
+            per_shard[k].append(jnp.squeeze(s.data, axis=0))
+    return [jax.tree_util.tree_unflatten(treedef, ls) for ls in per_shard]

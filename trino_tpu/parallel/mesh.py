@@ -16,7 +16,10 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from trino_tpu.page import Column, Page
@@ -40,13 +43,23 @@ class QueryMesh:
 
     def shard_pages(self, pages: List[Page]) -> Page:
         """Stack n per-worker pages into one global Page whose leading axis is
-        sharded over the mesh (the split->node assignment step)."""
+        sharded over the mesh (the split->node assignment step).
+
+        Assembled via make_array_from_single_device_arrays so per-shard
+        blocks that already live on their devices (e.g. the output of a
+        previous exchange) are used in place — no host round trip and no
+        cross-device stack."""
         assert len(pages) == self.n, f"need {self.n} pages, got {len(pages)}"
         sharding = NamedSharding(self.mesh, P(self.AXIS))
+        devices = list(self.mesh.devices.flat)
 
         def stack(*leaves):
-            stacked = jnp.stack(leaves)
-            return jax.device_put(stacked, sharding)
+            blocks = [
+                jax.device_put(jnp.expand_dims(jnp.asarray(leaf), 0), dev)
+                for leaf, dev in zip(leaves, devices)]
+            shape = (self.n,) + blocks[0].shape[1:]
+            return jax.make_array_from_single_device_arrays(
+                shape, sharding, blocks)
 
         return jax.tree_util.tree_map(stack, *pages)
 
@@ -69,8 +82,12 @@ class QueryMesh:
             return jax.tree_util.tree_map(
                 lambda x: jnp.expand_dims(x, axis=0), out)
 
-        return shard_map(wrapped, mesh=self.mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_rep=check_rep)
+        try:
+            return shard_map(wrapped, mesh=self.mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_rep)
+        except TypeError:  # pre-0.8 jax spells it check_rep
+            return shard_map(wrapped, mesh=self.mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=check_rep)
 
     def unshard(self, tree):
         """Fetch a sharded tree to host as per-shard list (axis 0)."""

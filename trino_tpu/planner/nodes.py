@@ -191,6 +191,19 @@ class AggregationNode(PlanNode):
 
     @property
     def outputs(self):
+        if self.step == AggStep.PARTIAL:
+            # a PARTIAL aggregation emits raw accumulator state columns
+            # (avg -> sum+count, ...) — the layout the exchange ships and
+            # the FINAL side consumes positionally (reference:
+            # AggregationNode intermediate symbols +
+            # PushPartialAggregationThroughExchange.java)
+            from trino_tpu.ops.aggregate import get_aggregate
+            syms = list(self.group_by)
+            for s, call in self.aggregations:
+                fn = get_aggregate(call.name, call.input_type)
+                for i, st in enumerate(fn.state(call.input_type)):
+                    syms.append(Symbol(f"{s.name}$state{i}", st.type))
+            return tuple(syms)
         return self.group_by + tuple(s for s, _ in self.aggregations)
 
     def with_sources(self, sources):

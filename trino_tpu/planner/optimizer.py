@@ -678,6 +678,12 @@ class DetermineJoinDistributionType(Rule):
         if not isinstance(node, JoinNode) or \
                 node.distribution != JoinDistribution.AUTO:
             return None
+        if node.kind in (JoinKind.FULL, JoinKind.RIGHT):
+            # FULL/RIGHT joins cannot broadcast the build side: the
+            # unmatched-build pass would emit duplicates on every shard
+            # (same restriction as the reference's replicated-join rules)
+            return JoinNode(node.kind, node.left, node.right, node.criteria,
+                            node.filter, JoinDistribution.PARTITIONED)
         forced = ctx.session.get("join_distribution_type")
         if forced == "BROADCAST":
             dist = JoinDistribution.REPLICATED
