@@ -1,0 +1,211 @@
+"""Aggregate function library vs python/sqlite oracles.
+
+Reference parity: testing/trino-testing AbstractTestAggregations — breadth
+coverage of the aggregate registry (operator/aggregation/: variance/
+covariance state in CovarianceState.java, min_by/max_by, bool_and/or,
+count_if, approx_distinct) over the tpch tiny schema.
+"""
+
+import math
+import statistics
+
+import pytest
+
+from trino_tpu.exec import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch("tiny")
+
+
+@pytest.fixture(scope="module")
+def cust(runner):
+    return runner.execute(
+        "SELECT c_nationkey, c_custkey, c_acctbal, c_name FROM customer").rows
+
+
+def by_nation(cust):
+    out = {}
+    for nk, ck, bal, name in cust:
+        out.setdefault(nk, []).append((ck, float(bal), name))
+    return out
+
+
+def test_stddev_variance_global(runner, cust):
+    vals = [float(r[2]) for r in cust]
+    got = runner.execute(
+        "SELECT stddev(c_acctbal), stddev_pop(c_acctbal), "
+        "variance(c_acctbal), var_pop(c_acctbal), var_samp(c_acctbal) "
+        "FROM customer").rows[0]
+    assert got[0] == pytest.approx(statistics.stdev(vals), rel=1e-9)
+    assert got[1] == pytest.approx(statistics.pstdev(vals), rel=1e-9)
+    assert got[2] == pytest.approx(statistics.variance(vals), rel=1e-9)
+    assert got[3] == pytest.approx(statistics.pvariance(vals), rel=1e-9)
+    assert got[4] == got[2]
+
+
+def test_stddev_grouped(runner, cust):
+    groups = by_nation(cust)
+    rows = runner.execute(
+        "SELECT c_nationkey, stddev(c_acctbal) FROM customer "
+        "GROUP BY c_nationkey").rows
+    for nk, sd in rows:
+        vals = [v for _, v, _ in groups[nk]]
+        assert sd == pytest.approx(statistics.stdev(vals), rel=1e-9)
+
+
+def test_var_samp_single_row_null(runner):
+    rows = runner.execute(
+        "SELECT var_samp(n_nationkey), var_pop(n_nationkey) "
+        "FROM nation WHERE n_nationkey = 7").rows
+    assert rows == [(None, 0.0)]
+
+
+def test_corr_covar(runner, cust):
+    xs = [float(r[2]) for r in cust]
+    ys = [float(r[1]) for r in cust]
+    got = runner.execute(
+        "SELECT corr(c_acctbal, c_custkey), covar_samp(c_acctbal, c_custkey),"
+        " covar_pop(c_acctbal, c_custkey) FROM customer").rows[0]
+    assert got[0] == pytest.approx(statistics.correlation(xs, ys), rel=1e-6)
+    assert got[1] == pytest.approx(statistics.covariance(xs, ys), rel=1e-6)
+    n = len(xs)
+    assert got[2] == pytest.approx(
+        statistics.covariance(xs, ys) * (n - 1) / n, rel=1e-6)
+
+
+def test_regr_slope_intercept(runner, cust):
+    xs = [float(r[1]) for r in cust]   # x = custkey
+    ys = [float(r[2]) for r in cust]   # y = acctbal
+    slope, intercept = statistics.linear_regression(xs, ys)
+    got = runner.execute(
+        "SELECT regr_slope(c_acctbal, c_custkey), "
+        "regr_intercept(c_acctbal, c_custkey) FROM customer").rows[0]
+    assert got[0] == pytest.approx(slope, rel=1e-6)
+    assert got[1] == pytest.approx(intercept, rel=1e-6)
+
+
+def test_min_by_max_by(runner, cust):
+    groups = by_nation(cust)
+    rows = runner.execute(
+        "SELECT c_nationkey, min_by(c_name, c_acctbal), "
+        "max_by(c_name, c_acctbal) FROM customer GROUP BY c_nationkey").rows
+    for nk, lo, hi in rows:
+        g = groups[nk]
+        assert lo == min(g, key=lambda t: t[1])[2]
+        assert hi == max(g, key=lambda t: t[1])[2]
+
+
+def test_bool_and_or_count_if(runner, cust):
+    groups = by_nation(cust)
+    rows = runner.execute(
+        "SELECT c_nationkey, bool_and(c_acctbal > 0), "
+        "bool_or(c_acctbal > 9000), count_if(c_acctbal > 0), "
+        "every(c_acctbal > -1000) FROM customer GROUP BY c_nationkey").rows
+    for nk, ba, bo, ci, ev in rows:
+        vals = [v for _, v, _ in groups[nk]]
+        assert ba == all(v > 0 for v in vals)
+        assert bo == any(v > 9000 for v in vals)
+        assert ci == sum(1 for v in vals if v > 0)
+        assert ev is True
+
+
+def test_approx_distinct_exact(runner):
+    rows = runner.execute(
+        "SELECT approx_distinct(o_orderstatus), "
+        "count(DISTINCT o_orderstatus) FROM orders").rows
+    assert rows[0][0] == rows[0][1]
+
+
+def test_arbitrary_any_value(runner):
+    rows = runner.execute(
+        "SELECT arbitrary(n_name), any_value(n_name) "
+        "FROM nation WHERE n_nationkey = 3").rows
+    assert rows == [("CANADA", "CANADA")]
+
+
+def test_geometric_mean(runner):
+    vals = [r[0] for r in runner.execute(
+        "SELECT c_custkey FROM customer").rows]
+    got = runner.execute(
+        "SELECT geometric_mean(c_custkey) FROM customer").rows[0][0]
+    expected = math.exp(sum(math.log(v) for v in vals) / len(vals))
+    assert got == pytest.approx(expected, rel=1e-9)
+
+
+def test_min_by_null_y_skipped(runner):
+    r = LocalQueryRunner.tpch("tiny")
+    r.execute("CREATE TABLE memory.default.mb (x varchar, y bigint)")
+    r.execute("INSERT INTO memory.default.mb VALUES "
+              "('a', NULL), ('b', 5), ('c', 2), (NULL, 1)")
+    rows = r.execute(
+        "SELECT min_by(x, y), max_by(x, y) FROM memory.default.mb").rows
+    assert rows == [(None, "b")]   # min y=1 has NULL x; y NULL row skipped
+
+
+def test_distinct_agg_with_filter(runner):
+    rows = runner.execute(
+        "SELECT count(DISTINCT o_orderstatus) "
+        "FILTER (WHERE o_totalprice > 100000), count(DISTINCT o_orderstatus)"
+        " FROM orders").rows
+    assert rows[0][0] <= rows[0][1]
+
+
+def test_variance_large_mean_stable(runner):
+    # naive E[x^2]-E[x]^2 catastrophically cancels with a 1e9 offset;
+    # centered two-pass must agree with the unshifted variance
+    a = runner.execute(
+        "SELECT stddev(c_custkey + 1000000000), stddev(c_custkey) "
+        "FROM customer WHERE c_custkey <= 100").rows[0]
+    assert a[0] == pytest.approx(a[1], rel=1e-6)
+    assert a[0] > 0
+
+
+def test_covar_corr_large_mean_stable(runner):
+    a = runner.execute(
+        "SELECT covar_samp(c_acctbal + 1000000000, c_custkey + 1000000000), "
+        "covar_samp(c_acctbal, c_custkey), "
+        "corr(c_acctbal + 1000000000, c_custkey + 1000000000), "
+        "corr(c_acctbal, c_custkey) "
+        "FROM customer WHERE c_custkey <= 100").rows[0]
+    assert a[0] == pytest.approx(a[1], rel=1e-6)
+    assert a[2] is not None
+    assert a[2] == pytest.approx(a[3], rel=1e-6)
+
+
+@pytest.fixture(scope="module")
+def nan_runner():
+    r = LocalQueryRunner.tpch("tiny")
+    r.execute("CREATE TABLE memory.default.nantab AS "
+              "SELECT 1 AS g, sqrt(-1e0) AS x "
+              "UNION ALL SELECT 1, sqrt(-1e0) "
+              "UNION ALL SELECT 1, sqrt(-1e0) "
+              "UNION ALL SELECT 1, 1.0e0 "
+              "UNION ALL SELECT 1, 1.0e0 "
+              "UNION ALL SELECT 2, 2.0e0")
+    return r
+
+
+def test_count_distinct_nan_single_value(nan_runner):
+    rows = nan_runner.execute(
+        "SELECT count(DISTINCT x) FROM memory.default.nantab").rows
+    assert rows == [(3,)]  # {NaN, 1.0, 2.0}
+
+
+def test_group_by_nan_single_group(nan_runner):
+    rows = nan_runner.execute(
+        "SELECT count(*) FROM (SELECT x, count(*) AS c "
+        "FROM memory.default.nantab GROUP BY x) t").rows
+    assert rows == [(3,)]
+
+
+def test_min_max_by_nan_largest(nan_runner):
+    rows = nan_runner.execute(
+        "SELECT min_by(g, x), max_by(g, x) FROM memory.default.nantab "
+        "WHERE g = 1").rows
+    # min ignores NaN (treated as largest); max picks a NaN row
+    assert rows == [(1, 1)]
+    rows = nan_runner.execute(
+        "SELECT min_by(g, x) FROM memory.default.nantab").rows
+    assert rows == [(1,)]

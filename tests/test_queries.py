@@ -621,3 +621,35 @@ def test_lag_varchar_with_default(outer_runner):
         "FROM memory.default.lft WHERE k IS NOT NULL").rows
     got = sorted([r for r in rows], key=lambda r: r[0])
     assert got == [(1, "zzz"), (2, "one"), (5, "two")]
+
+
+# ------------------------------------------- DISTINCT aggregation (round 3)
+
+def test_count_distinct_global(runner, oracle):
+    check(runner, oracle,
+          "SELECT count(DISTINCT o_orderstatus) FROM orders")
+
+
+def test_count_distinct_grouped(runner, oracle):
+    check(runner, oracle,
+          "SELECT o_orderpriority, count(DISTINCT o_orderstatus), count(*) "
+          "FROM orders GROUP BY o_orderpriority")
+
+
+def test_sum_avg_distinct(runner, oracle):
+    check(runner, oracle,
+          "SELECT c_mktsegment, sum(DISTINCT c_nationkey), "
+          "count(DISTINCT c_nationkey) FROM customer GROUP BY c_mktsegment")
+
+
+def test_count_distinct_with_nulls(outer_runner):
+    rows = outer_runner.execute(
+        "SELECT count(DISTINCT k), count(k), count(*) "
+        "FROM memory.default.lft").rows
+    assert rows == [(3, 3, 4)]
+
+
+def test_count_distinct_mixed_with_plain(runner, oracle):
+    check(runner, oracle,
+          "SELECT l_returnflag, count(DISTINCT l_shipmode), sum(l_quantity) "
+          "FROM lineitem GROUP BY l_returnflag")

@@ -135,11 +135,15 @@ class ShardExecutionPlanner(LocalExecutionPlanner):
                 in_type: Optional[T.Type] = call.input_type
             else:
                 input_ch, in_type = None, None
+            in2_ch = in2_type = None
+            if len(call.args) > 1 and lay:
+                arg2 = call.args[1]
+                in2_ch, in2_type = lay[arg2.name], arg2.type
             mask_ch = None
             if call.filter is not None:
                 mask_ch = lay[call.filter.name]
             specs.append(AggSpec(call.name, input_ch, in_type, mask_ch,
-                                 call.distinct))
+                                 call.distinct, in2_ch, in2_type))
         return specs
 
     def _exec_partial_agg(self, node: AggregationNode) -> PageStream:

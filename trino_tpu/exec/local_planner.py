@@ -295,15 +295,40 @@ class LocalExecutionPlanner:
                 in_type: Optional[T.Type] = typ[arg.name]
             else:
                 input_ch, in_type = None, None
+            in2_ch = in2_type = None
+            if len(call.args) > 1:
+                arg2 = call.args[1]
+                assert isinstance(arg2, SymbolRef)
+                in2_ch, in2_type = lay[arg2.name], typ[arg2.name]
             mask_ch = None
             if call.filter is not None:
                 assert isinstance(call.filter, SymbolRef)
                 mask_ch = lay[call.filter.name]
             specs.append(AggSpec(call.name, input_ch, in_type, mask_ch,
-                                 call.distinct))
+                                 call.distinct, in2_ch, in2_type))
 
         key_channels_t = tuple(key_channels)
         specs_t = tuple(specs)
+        from trino_tpu.ops.aggregate import SINGLE_STEP_AGGREGATES
+        if any(s.distinct or s.name in SINGLE_STEP_AGGREGATES
+               for s in specs):
+            # DISTINCT needs every row of a group in one kernel call
+            # (distinctness is a property of the whole group, not a page),
+            # so collect and run one SINGLE-step aggregation — the
+            # MarkDistinct + filtered-agg plan collapsed into the sort-based
+            # kernel (ops/aggregate.py:_distinct_first_mask).
+            single_op = cached_kernel(
+                ("agg-single", key_channels_t, specs_t),
+                lambda: hash_aggregate(key_channels, specs, Step.SINGLE))
+
+            def gen_distinct():
+                page = self._collect(src)
+                if page is None:
+                    if not key_channels:
+                        yield self._empty_global_agg(node, specs)
+                    return
+                yield single_op(page)
+            return PageStream(gen_distinct(), node.outputs)
         # fuse the upstream filter/project chain into the partial-agg kernel:
         # scan -> filter -> project -> partial agg is ONE device program per
         # page (ScanFilterAndProjectOperator + partial-agg fusion)
@@ -353,7 +378,7 @@ class LocalExecutionPlanner:
         cols = []
         for (sym, call), spec in zip(node.aggregations, specs):
             typ = sym.type
-            if call.name == "count":
+            if call.name in ("count", "count_if"):
                 cols.append(Column(jnp.zeros(8, typ.dtype), None, typ, None))
             else:
                 cols.append(Column(jnp.zeros(8, typ.dtype),

@@ -128,12 +128,100 @@ def _avg_final_factory(in_type):
     return final
 
 
+def _to_double(v, t: Optional[T.Type]):
+    """Numeric column -> float64 true value (descale decimals)."""
+    out = v.astype(jnp.float64)
+    if isinstance(t, T.DecimalType) and t.scale:
+        out = out / (10.0 ** t.scale)
+    return out
+
+
+def _count_if_state(in_type):
+    return (StateColumn(T.BIGINT,
+                        lambda v, m: (v & m).astype(jnp.int64), "sum"),)
+
+
+def _bool_state(is_and):
+    # AND folds with min over {0,1} (identity 1), OR with max (identity 0)
+    ident = 1 if is_and else 0
+    red = "min" if is_and else "max"
+    return (
+        StateColumn(T.BIGINT,
+                    lambda v, m: jnp.where(m, v.astype(jnp.int64), ident),
+                    red),
+        StateColumn(T.BIGINT, lambda v, m: m.astype(jnp.int64), "sum"),
+    )
+
+
+def _bool_final(state, _):
+    value, nnz = state
+    return value > 0, nnz > 0
+
+
+def _geomean_state_factory(in_type):
+    def state(t):
+        return (
+            StateColumn(T.DOUBLE,
+                        lambda v, m: jnp.where(
+                            m, jnp.log(_to_double(v, in_type)), 0.0),
+                        "sum"),
+            StateColumn(T.BIGINT, lambda v, m: m.astype(jnp.int64), "sum"),
+        )
+    return state
+
+
+def _geomean_final(state, _):
+    s, n = state
+    return jnp.exp(s / jnp.maximum(n.astype(jnp.float64), 1.0)), n > 0
+
+
+# aggregates resolved by picking one row per group rather than reducing
+# independent state columns (reference: MinMaxByNStateFactory / the min_by
+# codegen path); these never split into PARTIAL/FINAL across an exchange
+POSITIONAL_AGGREGATES = frozenset({"min_by", "max_by", "arbitrary"})
+
+# moment aggregates computed with CENTERED sums (two passes over the sorted
+# segments: means first, then squared deviations) for numerical stability —
+# the naive E[x²]−E[x]² raw-moment form catastrophically cancels for large-
+# mean data. Centered sums have no column-wise commutative merge, so these
+# are single-step only (Trino instead merges central moments with Chan's
+# update; reference operator/aggregation/state/CentralMomentsState.java —
+# a future optimization would add a custom merge path to the FINAL step).
+CENTERED_AGGREGATES = frozenset({
+    "variance", "var_samp", "var_pop", "stddev", "stddev_samp", "stddev_pop",
+    "corr", "covar_pop", "covar_samp", "regr_slope", "regr_intercept"})
+
+# aggregates that must see every row of a group in ONE kernel invocation
+SINGLE_STEP_AGGREGATES = POSITIONAL_AGGREGATES | CENTERED_AGGREGATES
+
+
 def get_aggregate(name: str, in_type: Optional[T.Type]) -> AggregateFunction:
-    """Resolve an aggregate by name + input type (FunctionRegistry analog)."""
+    """Resolve an aggregate by name + input type (FunctionRegistry analog).
+
+    For two-argument aggregates `in_type` is a tuple (first, second) of the
+    argument types.
+    """
     n = name.lower()
+    tx, ty = (in_type if isinstance(in_type, tuple) else (in_type, None))
     if n == "count":
         return AggregateFunction("count", _count_state, _count_final,
                                  lambda t: T.BIGINT)
+    if n == "count_if":
+        return AggregateFunction("count_if", _count_if_state, _count_final,
+                                 lambda t: T.BIGINT)
+    if n in ("bool_and", "bool_or"):
+        return AggregateFunction(
+            n, lambda t: _bool_state(n == "bool_and"), _bool_final,
+            lambda t: T.BOOLEAN)
+    if n == "geometric_mean":
+        return AggregateFunction(n, _geomean_state_factory(tx),
+                                 _geomean_final, lambda t: T.DOUBLE)
+    if n in CENTERED_AGGREGATES:
+        # state/final unused — executed by the centered two-pass path
+        return AggregateFunction(n, lambda t: (), None, lambda t: T.DOUBLE)
+    if n in POSITIONAL_AGGREGATES:
+        # state/final unused — executed by the positional row-selection path
+        return AggregateFunction(n, lambda t: (), None, lambda t: tx)
     if n == "sum":
         out = in_type if isinstance(in_type, (T.DecimalType, T.DoubleType,
                                               T.RealType)) else T.BIGINT
@@ -161,27 +249,39 @@ def get_aggregate(name: str, in_type: Optional[T.Type]) -> AggregateFunction:
     raise KeyError(f"unknown aggregate function: {name}")
 
 
-AGGREGATES = ("count", "sum", "avg", "min", "max")
+AGGREGATES = ("count", "sum", "avg", "min", "max", "count_if", "bool_and",
+              "bool_or", "variance", "var_samp", "var_pop", "stddev",
+              "stddev_samp", "stddev_pop", "geometric_mean", "corr",
+              "covar_pop", "covar_samp", "regr_slope", "regr_intercept",
+              "min_by", "max_by", "arbitrary")
 
 
 @dataclasses.dataclass(frozen=True)
 class AggSpec:
-    """One aggregate call in a plan: fn(input_channel). input None = count(*)."""
+    """One aggregate call in a plan: fn(input_channel). input None = count(*).
+
+    Two-argument aggregates (corr/covar/regr, min_by/max_by) carry the
+    second argument in (input2, input2_type)."""
 
     name: str
     input: Optional[int]
     input_type: Optional[T.Type]
     mask_channel: Optional[int] = None  # e.g. count(x) FILTER (WHERE ...)
     distinct: bool = False
+    input2: Optional[int] = None
+    input2_type: Optional[T.Type] = None
 
 
-def _sort_key_arrays(page: Page, key_channels: Sequence[int]):
+def _sort_key_arrays(page: Page, key_channels: Sequence[int], dead=None):
     """Composite sort operands: dead-flag first, then (null, value) per key.
 
     Null rows' value lanes hold garbage; canonicalize them to 0 so all nulls
     of a key collate into ONE group (the null flag is a separate sort key).
+    `dead` overrides the liveness flag (e.g. DISTINCT folds the aggregate's
+    eligibility into it).
     """
-    dead = ~page.row_mask()  # False (live) sorts before True (dead)
+    if dead is None:
+        dead = ~page.row_mask()  # False (live) sorts before True (dead)
     operands = [dead]
     for ch in key_channels:
         col = page.column(ch)
@@ -208,13 +308,23 @@ def hash_aggregate(
     Capacity: output keeps input capacity (#groups <= #rows).
     """
     key_channels = tuple(key_channels)
-    for a in aggs:
-        if a.distinct:
-            # DISTINCT aggregation is planned as mark-distinct + filtered agg
-            # (Trino: MarkDistinctOperator); until that rewrite exists, refuse
-            # rather than silently computing the non-distinct result.
-            raise NotImplementedError(f"{a.name}(DISTINCT ...)")
-    resolved = [get_aggregate(a.name, a.input_type) for a in aggs]
+    if step != Step.SINGLE:
+        for a in aggs:
+            if a.distinct:
+                # the optimizer keeps DISTINCT aggregations single-step
+                # (no partial/final split across an exchange) because
+                # distinctness is only decidable once a group's rows are
+                # colocated; see add_exchanges' `splittable` guard.
+                raise NotImplementedError(
+                    f"{a.name}(DISTINCT ...) in {step} step")
+            if a.name in SINGLE_STEP_AGGREGATES:
+                # positional/centered state has no commutative column-wise
+                # merge; the optimizer keeps these single-step
+                raise NotImplementedError(f"{a.name}() in {step} step")
+    resolved = [get_aggregate(a.name,
+                              a.input_type if a.input2 is None
+                              else (a.input_type, a.input2_type))
+                for a in aggs]
 
     def op(page: Page) -> Page:
         n = page.capacity
@@ -227,13 +337,8 @@ def hash_aggregate(
                                   num_keys=len(operands))
         perm_sorted = sorted_ops[-1]
         # boundary detection on the *sorted* key operands (incl. null flags)
-        key_ops = sorted_ops[1:-1]
         live_sorted = ~sorted_ops[0]
-        boundary = jnp.zeros(n, dtype=jnp.bool_).at[0].set(True)
-        for arr in key_ops:
-            boundary = boundary | (arr != jnp.roll(arr, 1)).at[0].set(
-                boundary[0])
-        boundary = boundary & live_sorted
+        boundary = _boundary_scan(sorted_ops[1:-1], n) & live_sorted
         group_of_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
         num_groups = jnp.sum(boundary).astype(jnp.int32)
         # route dead rows to an out-of-range segment id so they drop out
@@ -249,11 +354,36 @@ def hash_aggregate(
             out_cols.append(page.column(ch).gather(key_row))
 
         agg_cols = _accumulate(page, aggs, resolved, step,
-                               partial_state_channels, perm_sorted, seg, n)
+                               partial_state_channels, perm_sorted, seg, n,
+                               key_channels)
         out_cols.extend(agg_cols)
         return Page(tuple(out_cols), num_groups)
 
     return op
+
+
+def _boundary_scan(key_ops, n) -> jnp.ndarray:
+    """Group-start flags over lexicographically sorted key arrays.
+
+    NaN is ONE value for grouping/DISTINCT purposes (SQL/Trino semantics),
+    so adjacent NaNs do NOT open a new group despite NaN != NaN.
+    """
+    boundary = jnp.zeros(n, dtype=jnp.bool_)
+    for arr in key_ops:
+        prev = jnp.roll(arr, 1)
+        ne = arr != prev
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            ne = ne & ~(jnp.isnan(arr) & jnp.isnan(prev))
+        boundary = boundary | ne
+    return boundary.at[0].set(True)
+
+
+def _nan_as_largest(v: jnp.ndarray) -> jnp.ndarray:
+    """Canonicalize NaN to +inf: ORDER BY / min_by / max_by treat NaN as the
+    largest value (Trino's totalOrder comparison)."""
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        return jnp.where(jnp.isnan(v), jnp.asarray(jnp.inf, v.dtype), v)
+    return v
 
 
 def _segment_reduce(contrib, seg, n, reducer):
@@ -266,8 +396,36 @@ def _segment_reduce(contrib, seg, n, reducer):
     raise ValueError(reducer)
 
 
+def _distinct_first_mask(page: Page, key_channels: Sequence[int],
+                         spec: "AggSpec") -> jnp.ndarray:
+    """Row-order mask marking the first eligible row of each
+    (group keys, argument value) pair — the MarkDistinctOperator.java:38
+    analog, phrased as one extra lexicographic sort + boundary scan so
+    DISTINCT costs O(n log n) on the VPU instead of a hash table.
+
+    Eligibility folds in liveness, argument non-nullness (DISTINCT
+    aggregates skip NULL inputs) and the aggregate's FILTER mask, so
+    distinctness is computed over exactly the rows the aggregate sees.
+    """
+    n = page.capacity
+    col = page.column(spec.input)
+    eligible = page.row_mask() & col.valid_mask()
+    if spec.mask_channel is not None:
+        fcol = page.column(spec.mask_channel)
+        eligible = eligible & fcol.values & fcol.valid_mask()
+    # the argument is just one more sort key after the group keys
+    operands = _sort_key_arrays(page, tuple(key_channels) + (spec.input,),
+                                dead=~eligible)
+    perm = jnp.arange(n, dtype=jnp.int32)
+    sorted_ops = jax.lax.sort(operands + [perm], num_keys=len(operands))
+    perm_s = sorted_ops[-1]
+    elig_s = ~sorted_ops[0]
+    first = _boundary_scan(sorted_ops[1:-1], n) & elig_s
+    return jnp.zeros(n, dtype=jnp.bool_).at[perm_s].set(first)
+
+
 def _accumulate(page, aggs, resolved, step, partial_state_channels,
-                perm_sorted, seg, n) -> List[Column]:
+                perm_sorted, seg, n, key_channels=()) -> List[Column]:
     """Per-agg state accumulation + (for FINAL/SINGLE) final projection."""
     out: List[Column] = []
     for ai, (spec, fn) in enumerate(zip(aggs, resolved)):
@@ -291,6 +449,10 @@ def _accumulate(page, aggs, resolved, step, partial_state_channels,
             values, valid = fn.final(merged, None)
             out.append(_agg_out_column(fn, spec, values, valid,
                                        page.column(chans[0]).dictionary))
+        elif spec.name in POSITIONAL_AGGREGATES:
+            out.append(_positional_grouped(page, spec, perm_sorted, seg, n))
+        elif spec.name in CENTERED_AGGREGATES:
+            out.append(_centered_grouped(page, spec, perm_sorted, seg, n))
         else:
             states = fn.state(spec.input_type)
             dictionary = None
@@ -302,12 +464,21 @@ def _accumulate(page, aggs, resolved, step, partial_state_channels,
             else:
                 vals = jnp.zeros(page.capacity, dtype=jnp.int64)
                 mask = jnp.ones(page.capacity, dtype=jnp.bool_)
+            if spec.input2 is not None:
+                col2 = page.column(spec.input2)
+                vals2 = jnp.take(col2.values, perm_sorted, mode="clip")
+                mask = mask & jnp.take(col2.valid_mask(), perm_sorted,
+                                       mode="clip")
+                vals = (vals, vals2)
             mask = mask & (seg < n)
             if spec.mask_channel is not None:
                 fcol = page.column(spec.mask_channel)
                 fmask = jnp.take(fcol.values & fcol.valid_mask(), perm_sorted,
                                  mode="clip")
                 mask = mask & fmask
+            if spec.distinct:
+                dm = _distinct_first_mask(page, key_channels, spec)
+                mask = mask & jnp.take(dm, perm_sorted, mode="clip")
             state_arrays = []
             for sc in states:
                 contrib = sc.contrib(vals, mask)
@@ -321,6 +492,158 @@ def _accumulate(page, aggs, resolved, step, partial_state_channels,
                 values, valid = fn.final(state_arrays, None)
                 out.append(_agg_out_column(fn, spec, values, valid, dictionary))
     return out
+
+
+def _positional_grouped(page: Page, spec: "AggSpec", perm_sorted, seg,
+                        n) -> Column:
+    """min_by/max_by/arbitrary over sorted groups: pick ONE row per group
+    (first at the y-extremum / first non-null), then gather x from it."""
+    xcol = page.column(spec.input)
+    xv = jnp.take(xcol.values, perm_sorted, mode="clip")
+    xm = jnp.take(xcol.valid_mask(), perm_sorted, mode="clip")
+    eligible = seg < n
+    if spec.mask_channel is not None:
+        fcol = page.column(spec.mask_channel)
+        eligible = eligible & jnp.take(fcol.values & fcol.valid_mask(),
+                                       perm_sorted, mode="clip")
+    if spec.name == "arbitrary":
+        eligible = eligible & xm
+    else:
+        ycol = page.column(spec.input2)
+        yv = _nan_as_largest(jnp.take(ycol.values, perm_sorted, mode="clip"))
+        ym = jnp.take(ycol.valid_mask(), perm_sorted, mode="clip")
+        eligible = eligible & ym
+        is_min = spec.name == "min_by"
+        ident = _ident_for(yv.dtype, is_min)
+        yc = jnp.where(eligible, yv, ident)
+        ext = _segment_reduce(yc, seg, n, "min" if is_min else "max")
+        eligible = eligible & (yc == jnp.take(ext, seg, mode="clip"))
+    pos = jnp.where(eligible, jnp.arange(n, dtype=jnp.int32), n)
+    first = jax.ops.segment_min(pos, seg, num_segments=n)
+    has = first < n
+    idx = jnp.clip(first, 0, n - 1)
+    return Column(jnp.take(xv, idx), has & jnp.take(xm, idx), xcol.type,
+                  xcol.dictionary)
+
+
+def _positional_global(page: Page, spec: "AggSpec", live) -> Column:
+    """Single-group variant of _positional_grouped (one output row)."""
+    n = page.capacity
+    xcol = page.column(spec.input)
+    xv, xm = xcol.values, xcol.valid_mask()
+    eligible = live
+    if spec.mask_channel is not None:
+        fcol = page.column(spec.mask_channel)
+        eligible = eligible & fcol.values & fcol.valid_mask()
+    if spec.name == "arbitrary":
+        eligible = eligible & xm
+    else:
+        ycol = page.column(spec.input2)
+        yv, ym = _nan_as_largest(ycol.values), ycol.valid_mask()
+        eligible = eligible & ym
+        is_min = spec.name == "min_by"
+        ident = _ident_for(yv.dtype, is_min)
+        yc = jnp.where(eligible, yv, ident)
+        ext = jnp.min(yc) if is_min else jnp.max(yc)
+        eligible = eligible & (yc == ext)
+    pos = jnp.where(eligible, jnp.arange(n, dtype=jnp.int32), n)
+    first = jnp.min(pos, keepdims=True)
+    has = first < n
+    idx = jnp.clip(first, 0, n - 1)
+    return Column(jnp.take(xv, idx), has & jnp.take(xm, idx), xcol.type,
+                  xcol.dictionary)
+
+
+def _centered_finalize(kind: str, cnt, sa, sb, caa, cbb, cab):
+    """Shared finalization of the centered-moment family. First argument `a`
+    is the dependent variable, second `b` the independent one
+    (regr_slope(y, x) argument order); var/stddev use `a` only."""
+    nf = jnp.maximum(cnt.astype(jnp.float64), 1.0)
+    if kind in ("var_pop", "stddev_pop"):
+        value, valid = caa / nf, cnt > 0
+    elif kind in ("variance", "var_samp", "stddev", "stddev_samp"):
+        value, valid = caa / jnp.maximum(nf - 1.0, 1.0), cnt > 1
+    elif kind == "covar_pop":
+        value, valid = cab / nf, cnt > 0
+    elif kind == "covar_samp":
+        value, valid = cab / jnp.maximum(nf - 1.0, 1.0), cnt > 1
+    elif kind == "corr":
+        denom = jnp.sqrt(caa * cbb)
+        value = cab / jnp.where(denom > 0, denom, 1.0)
+        valid = (cnt > 1) & (denom > 0)
+    elif kind == "regr_slope":
+        value = cab / jnp.where(cbb > 0, cbb, 1.0)
+        valid = (cnt > 0) & (cbb > 0)
+    else:  # regr_intercept = mean(a) - slope * mean(b)
+        slope = cab / jnp.where(cbb > 0, cbb, 1.0)
+        value = sa / nf - slope * sb / nf
+        valid = (cnt > 0) & (cbb > 0)
+    if kind.startswith("stddev"):
+        value = jnp.sqrt(jnp.maximum(value, 0.0))
+    return value, valid
+
+
+def _centered_grouped(page: Page, spec: "AggSpec", perm_sorted, seg,
+                      n) -> Column:
+    """variance/stddev/corr/covar/regr per group: segment means first, then
+    segment sums of (centered) cross-products — numerically stable where the
+    raw-moment form E[x²]−E[x]² cancels."""
+    acol = page.column(spec.input)
+    av = _to_double(jnp.take(acol.values, perm_sorted, mode="clip"),
+                    spec.input_type)
+    mask = jnp.take(acol.valid_mask(), perm_sorted, mode="clip") & (seg < n)
+    bivar = spec.input2 is not None
+    if bivar:
+        bcol = page.column(spec.input2)
+        bv = _to_double(jnp.take(bcol.values, perm_sorted, mode="clip"),
+                        spec.input2_type)
+        mask = mask & jnp.take(bcol.valid_mask(), perm_sorted, mode="clip")
+    if spec.mask_channel is not None:
+        fcol = page.column(spec.mask_channel)
+        mask = mask & jnp.take(fcol.values & fcol.valid_mask(), perm_sorted,
+                               mode="clip")
+    cnt = jax.ops.segment_sum(mask.astype(jnp.int64), seg, num_segments=n)
+    nf = jnp.maximum(cnt.astype(jnp.float64), 1.0)
+    sa = jax.ops.segment_sum(jnp.where(mask, av, 0.0), seg, num_segments=n)
+    da = jnp.where(mask, av - jnp.take(sa / nf, seg, mode="clip"), 0.0)
+    caa = jax.ops.segment_sum(da * da, seg, num_segments=n)
+    sb = cbb = cab = None
+    if bivar:
+        sb = jax.ops.segment_sum(jnp.where(mask, bv, 0.0), seg,
+                                 num_segments=n)
+        db = jnp.where(mask, bv - jnp.take(sb / nf, seg, mode="clip"), 0.0)
+        cbb = jax.ops.segment_sum(db * db, seg, num_segments=n)
+        cab = jax.ops.segment_sum(da * db, seg, num_segments=n)
+    value, valid = _centered_finalize(spec.name, cnt, sa, sb, caa, cbb, cab)
+    return Column(value, valid, T.DOUBLE, None)
+
+
+def _centered_global(page: Page, spec: "AggSpec", live) -> Column:
+    """Single-group variant of _centered_grouped (one output row)."""
+    acol = page.column(spec.input)
+    av = _to_double(acol.values, spec.input_type)
+    mask = acol.valid_mask() & live
+    bivar = spec.input2 is not None
+    if bivar:
+        bcol = page.column(spec.input2)
+        bv = _to_double(bcol.values, spec.input2_type)
+        mask = mask & bcol.valid_mask()
+    if spec.mask_channel is not None:
+        fcol = page.column(spec.mask_channel)
+        mask = mask & fcol.values & fcol.valid_mask()
+    cnt = jnp.sum(mask.astype(jnp.int64), keepdims=True)
+    nf = jnp.maximum(cnt.astype(jnp.float64), 1.0)
+    sa = jnp.sum(jnp.where(mask, av, 0.0), keepdims=True)
+    da = jnp.where(mask, av - sa / nf, 0.0)
+    caa = jnp.sum(da * da, keepdims=True)
+    sb = cbb = cab = None
+    if bivar:
+        sb = jnp.sum(jnp.where(mask, bv, 0.0), keepdims=True)
+        db = jnp.where(mask, bv - sb / nf, 0.0)
+        cbb = jnp.sum(db * db, keepdims=True)
+        cab = jnp.sum(da * db, keepdims=True)
+    value, valid = _centered_finalize(spec.name, cnt, sa, sb, caa, cbb, cab)
+    return Column(value, valid, T.DOUBLE, None)
 
 
 def _ident_for(dtype, is_min):
@@ -346,6 +669,12 @@ def _global_aggregate(page, aggs, resolved, step, partial_state_channels):
     live = page.row_mask()
     out_cols: List[Column] = []
     for ai, (spec, fn) in enumerate(zip(aggs, resolved)):
+        if spec.name in POSITIONAL_AGGREGATES:
+            out_cols.append(_positional_global(page, spec, live))
+            continue
+        if spec.name in CENTERED_AGGREGATES:
+            out_cols.append(_centered_global(page, spec, live))
+            continue
         states = fn.state(spec.input_type)
         if step == Step.FINAL:
             chans = partial_state_channels[ai]
@@ -374,9 +703,15 @@ def _global_aggregate(page, aggs, resolved, step, partial_state_channels):
         else:
             vals = jnp.zeros(page.capacity, dtype=jnp.int64)
             mask = live
+        if spec.input2 is not None:
+            col2 = page.column(spec.input2)
+            mask = mask & col2.valid_mask()
+            vals = (vals, col2.values)
         if spec.mask_channel is not None:
             fcol = page.column(spec.mask_channel)
             mask = mask & fcol.values & fcol.valid_mask()
+        if spec.distinct:
+            mask = mask & _distinct_first_mask(page, (), spec)
         state_arrays = []
         for sc in states:
             contrib = sc.contrib(vals, mask)

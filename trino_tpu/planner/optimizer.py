@@ -999,7 +999,9 @@ def _split_aggregation(agg: AggregationNode, src: PlanNode,
     """partial agg -> exchange -> final agg
     (PushPartialAggregationThroughExchange.java). DISTINCT or FILTER aggs
     can't split; gather instead."""
+    from trino_tpu.ops.aggregate import SINGLE_STEP_AGGREGATES
     splittable = all(not a.distinct and a.filter is None
+                     and a.name not in SINGLE_STEP_AGGREGATES
                      for _, a in agg.aggregations)
     if not splittable:
         kind = (ExchangeKind.REPARTITION if agg.group_by

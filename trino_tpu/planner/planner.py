@@ -673,15 +673,23 @@ class _PlanBuilder:
             resolved = resolve_aggregate(name, [a.type for a in args])
             args = tuple(cast_to(a, ty)
                          for a, ty in zip(args, resolved.arg_types))
+            agg_name, distinct = resolved.name, fc.distinct
+            if agg_name == "approx_distinct":
+                # executed as an exact DISTINCT count (standard error 0);
+                # the optional max-standard-error argument is advisory and
+                # dropped before symbolization so it never materializes.
+                # Reference: ApproximateCountDistinctAggregation.java
+                agg_name, distinct = "count", True
+                args = args[:1]
             arg_syms = tuple(to_symbol(a, "aggarg") for a in args)
             filt_sym = None
             if fc.filter is not None:
                 fx = tr.translate(fc.filter)
                 filt_sym = to_symbol(fx, "aggfilter").ref()
             out_sym = planner.symbols.new(name, resolved.return_type)
-            call = AggCall(resolved.name,
+            call = AggCall(agg_name,
                            tuple(s.ref() for s in arg_syms),
-                           fc.distinct, filt_sym,
+                           distinct, filt_sym,
                            args[0].type if args else None)
             aggregations.append((out_sym, call))
             # register substitution under the canonical aggregate key

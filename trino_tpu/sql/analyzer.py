@@ -39,6 +39,7 @@ AGGREGATE_NAMES = frozenset({
     "every", "arbitrary", "any_value", "stddev", "stddev_pop", "stddev_samp",
     "variance", "var_pop", "var_samp", "approx_distinct", "corr", "covar_pop",
     "covar_samp", "regr_slope", "regr_intercept", "checksum", "geometric_mean",
+    "min_by", "max_by",
 })
 
 WINDOW_NAMES = frozenset({
@@ -301,4 +302,8 @@ def resolve_aggregate(name: str, arg_types: Sequence[T.Type]
     if n in ("corr", "covar_pop", "covar_samp", "regr_slope",
              "regr_intercept"):
         return ResolvedFunction(n, tuple(T.DOUBLE for _ in args), T.DOUBLE)
+    if n in ("min_by", "max_by"):
+        if len(args) != 2:
+            raise SemanticError(f"{n}() takes exactly two arguments")
+        return ResolvedFunction(n, args, args[0])
     raise SemanticError(f"unknown aggregate: {name}()")
