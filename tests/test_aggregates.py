@@ -209,3 +209,28 @@ def test_min_max_by_nan_largest(nan_runner):
     rows = nan_runner.execute(
         "SELECT min_by(g, x) FROM memory.default.nantab").rows
     assert rows == [(1,)]
+
+
+def test_variance_distinct(runner):
+    # var over DISTINCT values must differ from var over all rows
+    r = LocalQueryRunner.tpch("tiny")
+    r.execute("CREATE TABLE memory.default.vd AS "
+              "SELECT 1 AS x UNION ALL SELECT 1 "
+              "UNION ALL SELECT 1 UNION ALL SELECT 2")
+    got = r.execute("SELECT var_pop(DISTINCT x), var_pop(x) "
+                    "FROM memory.default.vd").rows[0]
+    assert got[0] == pytest.approx(0.25)
+    assert got[1] == pytest.approx(0.1875)
+
+
+def test_approx_distinct_in_correlated_subquery(runner):
+    rows = runner.execute(
+        "SELECT r_name, (SELECT approx_distinct(n_name) FROM nation "
+        "WHERE n_regionkey = r_regionkey) FROM region").rows
+    assert sorted(v for _, v in rows) == [5, 5, 5, 5, 5]
+
+
+def test_min_by_distinct_rejected(runner):
+    with pytest.raises(Exception):
+        runner.execute("SELECT min_by(DISTINCT n_name, n_nationkey) "
+                       "FROM nation")

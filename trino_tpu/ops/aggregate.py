@@ -308,6 +308,13 @@ def hash_aggregate(
     Capacity: output keeps input capacity (#groups <= #rows).
     """
     key_channels = tuple(key_channels)
+    for a in aggs:
+        if a.distinct and (a.name in POSITIONAL_AGGREGATES
+                           or (a.name in CENTERED_AGGREGATES
+                               and a.input2 is not None)):
+            # DISTINCT over a row-pair has no single-column first-occurrence
+            # mask; refuse rather than silently dropping the qualifier
+            raise NotImplementedError(f"{a.name}(DISTINCT ...)")
     if step != Step.SINGLE:
         for a in aggs:
             if a.distinct:
@@ -428,6 +435,17 @@ def _accumulate(page, aggs, resolved, step, partial_state_channels,
                 perm_sorted, seg, n, key_channels=()) -> List[Column]:
     """Per-agg state accumulation + (for FINAL/SINGLE) final projection."""
     out: List[Column] = []
+    dmask_cache: dict = {}
+
+    def distinct_mask(spec):
+        # multiple DISTINCT aggs over one argument share the sort+mask
+        key = (spec.input, spec.mask_channel)
+        if key not in dmask_cache:
+            dmask_cache[key] = jnp.take(
+                _distinct_first_mask(page, key_channels, spec), perm_sorted,
+                mode="clip")
+        return dmask_cache[key]
+
     for ai, (spec, fn) in enumerate(zip(aggs, resolved)):
         if step == Step.FINAL:
             # inputs are partial state columns; merge with each state's reducer
@@ -452,7 +470,9 @@ def _accumulate(page, aggs, resolved, step, partial_state_channels,
         elif spec.name in POSITIONAL_AGGREGATES:
             out.append(_positional_grouped(page, spec, perm_sorted, seg, n))
         elif spec.name in CENTERED_AGGREGATES:
-            out.append(_centered_grouped(page, spec, perm_sorted, seg, n))
+            extra = distinct_mask(spec) if spec.distinct else None
+            out.append(_centered_grouped(page, spec, perm_sorted, seg, n,
+                                         extra))
         else:
             states = fn.state(spec.input_type)
             dictionary = None
@@ -477,8 +497,7 @@ def _accumulate(page, aggs, resolved, step, partial_state_channels,
                                  mode="clip")
                 mask = mask & fmask
             if spec.distinct:
-                dm = _distinct_first_mask(page, key_channels, spec)
-                mask = mask & jnp.take(dm, perm_sorted, mode="clip")
+                mask = mask & distinct_mask(spec)
             state_arrays = []
             for sc in states:
                 contrib = sc.contrib(vals, mask)
@@ -584,7 +603,7 @@ def _centered_finalize(kind: str, cnt, sa, sb, caa, cbb, cab):
 
 
 def _centered_grouped(page: Page, spec: "AggSpec", perm_sorted, seg,
-                      n) -> Column:
+                      n, extra_mask=None) -> Column:
     """variance/stddev/corr/covar/regr per group: segment means first, then
     segment sums of (centered) cross-products — numerically stable where the
     raw-moment form E[x²]−E[x]² cancels."""
@@ -602,6 +621,8 @@ def _centered_grouped(page: Page, spec: "AggSpec", perm_sorted, seg,
         fcol = page.column(spec.mask_channel)
         mask = mask & jnp.take(fcol.values & fcol.valid_mask(), perm_sorted,
                                mode="clip")
+    if extra_mask is not None:     # DISTINCT first-occurrence mask
+        mask = mask & extra_mask
     cnt = jax.ops.segment_sum(mask.astype(jnp.int64), seg, num_segments=n)
     nf = jnp.maximum(cnt.astype(jnp.float64), 1.0)
     sa = jax.ops.segment_sum(jnp.where(mask, av, 0.0), seg, num_segments=n)
@@ -618,7 +639,8 @@ def _centered_grouped(page: Page, spec: "AggSpec", perm_sorted, seg,
     return Column(value, valid, T.DOUBLE, None)
 
 
-def _centered_global(page: Page, spec: "AggSpec", live) -> Column:
+def _centered_global(page: Page, spec: "AggSpec", live,
+                     extra_mask=None) -> Column:
     """Single-group variant of _centered_grouped (one output row)."""
     acol = page.column(spec.input)
     av = _to_double(acol.values, spec.input_type)
@@ -631,6 +653,8 @@ def _centered_global(page: Page, spec: "AggSpec", live) -> Column:
     if spec.mask_channel is not None:
         fcol = page.column(spec.mask_channel)
         mask = mask & fcol.values & fcol.valid_mask()
+    if extra_mask is not None:     # DISTINCT first-occurrence mask
+        mask = mask & extra_mask
     cnt = jnp.sum(mask.astype(jnp.int64), keepdims=True)
     nf = jnp.maximum(cnt.astype(jnp.float64), 1.0)
     sa = jnp.sum(jnp.where(mask, av, 0.0), keepdims=True)
@@ -668,12 +692,21 @@ def _global_aggregate(page, aggs, resolved, step, partial_state_channels):
     """No GROUP BY: one output row (reference: AggregationOperator.java)."""
     live = page.row_mask()
     out_cols: List[Column] = []
+    dmask_cache: dict = {}
+
+    def distinct_mask(spec):
+        key = (spec.input, spec.mask_channel)
+        if key not in dmask_cache:
+            dmask_cache[key] = _distinct_first_mask(page, (), spec)
+        return dmask_cache[key]
+
     for ai, (spec, fn) in enumerate(zip(aggs, resolved)):
         if spec.name in POSITIONAL_AGGREGATES:
             out_cols.append(_positional_global(page, spec, live))
             continue
         if spec.name in CENTERED_AGGREGATES:
-            out_cols.append(_centered_global(page, spec, live))
+            extra = distinct_mask(spec) if spec.distinct else None
+            out_cols.append(_centered_global(page, spec, live, extra))
             continue
         states = fn.state(spec.input_type)
         if step == Step.FINAL:
@@ -711,7 +744,7 @@ def _global_aggregate(page, aggs, resolved, step, partial_state_channels):
             fcol = page.column(spec.mask_channel)
             mask = mask & fcol.values & fcol.valid_mask()
         if spec.distinct:
-            mask = mask & _distinct_first_mask(page, (), spec)
+            mask = mask & distinct_mask(spec)
         state_arrays = []
         for sc in states:
             contrib = sc.contrib(vals, mask)

@@ -1236,11 +1236,16 @@ class _PlanBuilder:
             resolved = resolve_aggregate(name, [a.type for a in args])
             args = tuple(cast_to(a, ty)
                          for a, ty in zip(args, resolved.arg_types))
+            agg_name, distinct = resolved.name, fc.distinct
+            if agg_name == "approx_distinct":
+                # same exact-DISTINCT-count rewrite as plan_aggregation
+                agg_name, distinct = "count", True
+                args = args[:1]
             arg_syms = tuple(to_symbol(a, "aggarg") for a in args)
             out_sym = planner.symbols.new(name, resolved.return_type)
             aggregations.append((out_sym, AggCall(
-                resolved.name, tuple(s.ref() for s in arg_syms),
-                fc.distinct, None, args[0].type if args else None)))
+                agg_name, tuple(s.ref() for s in arg_syms),
+                distinct, None, args[0].type if args else None)))
             self.substitutions[tr.aggregate_key(fc)] = out_sym
         self.node = ProjectNode(self.node, tuple(pre_assigns))
         self.node = AggregationNode(self.node, tuple(key_syms),

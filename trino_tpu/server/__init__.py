@@ -1,0 +1,6 @@
+"""HTTP client-protocol surface (reference: core/trino-main/src/main/java/io/
+trino/server/ + client/trino-client)."""
+
+from trino_tpu.server.app import TrinoServer
+
+__all__ = ["TrinoServer"]

@@ -1,0 +1,253 @@
+"""HTTP /v1/statement server over a query runner.
+
+Reference parity: server/protocol/ExecutingStatementResource.java +
+dispatcher/QueuedStatementResource.java:95 — POST /v1/statement submits SQL,
+the client then follows `nextUri` (GET) until the response carries no
+`nextUri`; DELETE on the page URI cancels. Session state travels in
+X-Trino-* headers both ways (Set-Session / Clear-Session on SET/RESET),
+keeping the server stateless across requests the way the reference's
+dispatcher is.
+
+TPU-first simplification: the engine executes synchronously on one device
+(or mesh), so the POST runs the query to completion and `nextUri` pages the
+buffered result in fixed-size chunks — the protocol surface (what the stock
+CLI sees) is identical, while the scheduler/dispatcher queue machinery the
+reference needs for its async fan-out is collapsed into the runner call.
+
+Serving is stdlib ThreadingHTTPServer; engine calls serialize on a lock
+(single-controller JAX process — concurrency comes from the mesh, not
+threads).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import re
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from trino_tpu.exec.runner import MaterializedResult
+from trino_tpu.server import protocol
+
+PAGE_ROWS = 1000
+
+_SET_SESSION = re.compile(r"^\s*set\s+session\s+(\w+)\s*=\s*(.+?)\s*$",
+                          re.IGNORECASE | re.DOTALL)
+_RESET_SESSION = re.compile(r"^\s*reset\s+session\s+(\w+)\s*$",
+                            re.IGNORECASE)
+
+
+class _Query:
+    def __init__(self, query_id: str, slug: str):
+        self.query_id = query_id
+        self.slug = slug
+        self.result: Optional[MaterializedResult] = None
+        self.error: Optional[dict] = None
+        self.update_type: Optional[str] = None
+        self.set_session: Optional[tuple] = None
+        self.clear_session: Optional[str] = None
+        self.cancelled = False
+        self.started = time.monotonic()
+
+    @property
+    def elapsed_ms(self) -> int:
+        return int((time.monotonic() - self.started) * 1000)
+
+
+class TrinoServer:
+    """Wire-compatible statement server wrapping a query runner."""
+
+    def __init__(self, runner, host: str = "127.0.0.1", port: int = 0):
+        self.runner = runner
+        self._lock = threading.Lock()
+        self._queries: Dict[str, _Query] = {}
+        self._seq = itertools.count(1)
+        handler = self._make_handler()
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------- lifecycle
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def base_uri(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "TrinoServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # ---------------------------------------------------------- execution
+
+    def _submit(self, sql: str, headers) -> _Query:
+        day = time.strftime("%Y%m%d")
+        qid = f"{day}_{next(self._seq):06d}_{uuid.uuid4().hex[:5]}"
+        q = _Query(qid, uuid.uuid4().hex[:12])
+        self._queries[qid] = q
+        session = self.runner.session
+        with self._lock:
+            saved = (session.catalog, session.schema)
+            try:
+                catalog = headers.get("X-Trino-Catalog")
+                schema = headers.get("X-Trino-Schema")
+                if catalog:
+                    session.catalog = catalog
+                if schema:
+                    session.schema = schema
+                overrides = {}
+                props_header = headers.get("X-Trino-Session", "")
+                for part in props_header.split(","):
+                    if "=" in part:
+                        k, _, v = part.partition("=")
+                        overrides[k.strip()] = v.strip()
+                saved_props = {k: session.properties.get(k)
+                               for k in overrides}
+                for k, v in overrides.items():
+                    try:
+                        session.set(k, v)
+                    except Exception:
+                        saved_props.pop(k, None)
+                try:
+                    q.result = self.runner.execute(sql)
+                finally:
+                    for k, v in saved_props.items():
+                        if v is None:
+                            session.properties.pop(k, None)
+                        else:
+                            session.properties[k] = v
+                m = _SET_SESSION.match(sql)
+                if m:
+                    q.update_type = "SET SESSION"
+                    q.set_session = (m.group(1),
+                                     m.group(2).strip().strip("'"))
+                m = _RESET_SESSION.match(sql)
+                if m:
+                    q.update_type = "RESET SESSION"
+                    q.clear_session = m.group(1)
+            except Exception as e:  # surface as QueryError, not HTTP 500
+                q.error = protocol.error_json(
+                    f"{type(e).__name__}: {e}",
+                    error_name=type(e).__name__.upper())
+            finally:
+                session.catalog, session.schema = saved
+        return q
+
+    # ------------------------------------------------------------ paging
+
+    def _page_uri(self, q: _Query, token: int) -> str:
+        return (f"{self.base_uri}/v1/statement/executing/"
+                f"{q.query_id}/{q.slug}/{token}")
+
+    def _response_for(self, q: _Query, token: int) -> dict:
+        if q.error is not None:
+            return protocol.query_results(
+                q.query_id, self.base_uri, state="FAILED", error=q.error,
+                elapsed_ms=q.elapsed_ms)
+        if q.cancelled:
+            return protocol.query_results(
+                q.query_id, self.base_uri, state="CANCELED",
+                error=protocol.error_json("Query was canceled",
+                                          "USER_CANCELED"),
+                elapsed_ms=q.elapsed_ms)
+        res = q.result
+        assert res is not None
+        cols = protocol.columns_json(res.column_names, res.column_types)
+        lo, hi = token * PAGE_ROWS, (token + 1) * PAGE_ROWS
+        chunk = res.rows[lo:hi]
+        data = protocol.encode_rows(chunk, res.column_types)
+        has_more = hi < len(res.rows)
+        return protocol.query_results(
+            q.query_id, self.base_uri, columns=cols, data=data,
+            next_uri=self._page_uri(q, token + 1) if has_more else None,
+            state="RUNNING" if has_more else "FINISHED",
+            update_type=q.update_type, rows=len(res.rows),
+            elapsed_ms=q.elapsed_ms)
+
+    # ----------------------------------------------------------- handler
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _send_json(self, payload: dict, q: Optional[_Query] = None,
+                           status: int = 200):
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                if q is not None and q.set_session is not None:
+                    k, v = q.set_session
+                    self.send_header("X-Trino-Set-Session", f"{k}={v}")
+                if q is not None and q.clear_session is not None:
+                    self.send_header("X-Trino-Clear-Session",
+                                     q.clear_session)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path.rstrip("/") != "/v1/statement":
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                sql = self.rfile.read(length).decode()
+                q = server._submit(sql, self.headers)
+                # first response: QUEUED with a nextUri (the dispatcher
+                # handshake the CLI expects), data starts at token 0
+                if q.error is not None:
+                    self._send_json(server._response_for(q, 0), q)
+                    return
+                self._send_json(protocol.query_results(
+                    q.query_id, server.base_uri,
+                    next_uri=server._page_uri(q, 0), state="QUEUED",
+                    elapsed_ms=q.elapsed_ms), q)
+
+            def do_GET(self):
+                q, token = self._resolve()
+                if q is None:
+                    return
+                self._send_json(server._response_for(q, token), q)
+
+            def do_DELETE(self):
+                q, _ = self._resolve()
+                if q is None:
+                    return
+                q.cancelled = True
+                self.send_response(204)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def _resolve(self):
+                parts = self.path.strip("/").split("/")
+                # v1/statement/executing/{id}/{slug}/{token}
+                if len(parts) != 6 or parts[:3] != ["v1", "statement",
+                                                    "executing"]:
+                    self.send_error(404)
+                    return None, 0
+                q = server._queries.get(parts[3])
+                if q is None or q.slug != parts[4]:
+                    self.send_error(404, "Query not found")
+                    return None, 0
+                return q, int(parts[5])
+
+        return Handler
