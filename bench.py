@@ -1,15 +1,20 @@
-"""Benchmark: TPC-H SF1 end-to-end wall-clock on the real chip.
+"""Benchmark: TPC-H end-to-end wall-clock on the real chip.
 
-Measurement ladder (BASELINE.md): configs 1-3 — q6 (scan+filter+agg), q1
-(lineitem hash aggregation), q3 (3-way join customer x orders x lineitem) at
-SF1 through the full engine (parse -> plan -> optimize -> execute). Prints
+Measurement ladder (BASELINE.md): #1 q6 tiny-smoke is folded into the SF1
+run; #2 q1 SF1 (lineitem hash aggregation); #3 q3 **SF10** (3-way join
+customer x orders x lineitem) — the actual ladder rung, not SF1. Every query
+runs through the full engine (parse -> plan -> optimize -> execute). Prints
 ONE JSON line; the headline metric stays q6 SF1 wall-clock, with the other
 ladder rungs in "extra".
 
 vs_baseline: the reference repo publishes no numbers (BASELINE.md); the
-denominator is 1.0 s — the ballpark single-node Trino q6 SF1 wall-clock its
-LocalQueryRunner benchmarks show on server CPUs — so vs_baseline > 1 means
-faster than that estimate.
+denominators are ballpark single-node Trino wall-clocks from its
+LocalQueryRunner-style benchmarks on server CPUs — q6 SF1 ~1.0s, q1 SF1
+~2.5s, q3 SF10 ~10s — so vs_baseline > 1 means faster than that estimate.
+
+Data caveat (BASELINE.md north-star asks for bit-identical rows): the tpch
+connector generates spec-shaped seeded data, not dbgen bitstreams, so the
+comparison is same-shape wall-clock, not row-identical output.
 """
 
 import json
@@ -48,7 +53,10 @@ GROUP BY l_orderkey, o_orderdate, o_shippriority
 ORDER BY revenue DESC, o_orderdate LIMIT 10
 """
 
-BASELINE_ESTIMATE_S = 1.0
+# ballpark single-node Java-engine estimates (no published numbers exist)
+BASE_Q6_SF1_S = 1.0
+BASE_Q1_SF1_S = 2.5
+BASE_Q3_SF10_S = 10.0
 
 
 def _time_query(runner, sql, iters=3):
@@ -63,20 +71,27 @@ def _time_query(runner, sql, iters=3):
 
 
 def main():
+    import trino_tpu
+    # persistent compile cache: repeat driver rounds skip XLA recompiles
+    trino_tpu.enable_persistent_cache()
+
     from trino_tpu.exec import LocalQueryRunner
 
-    runner = LocalQueryRunner.tpch("sf1")
-    q6 = _time_query(runner, Q6)
-    q1 = _time_query(runner, Q1)
-    q3 = _time_query(runner, Q3)
+    sf1 = LocalQueryRunner.tpch("sf1")
+    q6 = _time_query(sf1, Q6)
+    q1 = _time_query(sf1, Q1)
+    sf10 = LocalQueryRunner.tpch("sf10")
+    q3 = _time_query(sf10, Q3)
     print(json.dumps({
         "metric": "tpch_q6_sf1_wall_s",
         "value": round(q6, 4),
         "unit": "s",
-        "vs_baseline": round(BASELINE_ESTIMATE_S / q6, 3),
+        "vs_baseline": round(BASE_Q6_SF1_S / q6, 3),
         "extra": {
             "tpch_q1_sf1_wall_s": round(q1, 4),
-            "tpch_q3_sf1_wall_s": round(q3, 4),
+            "tpch_q1_sf1_vs_baseline": round(BASE_Q1_SF1_S / q1, 3),
+            "tpch_q3_sf10_wall_s": round(q3, 4),
+            "tpch_q3_sf10_vs_baseline": round(BASE_Q3_SF10_S / q3, 3),
         },
     }))
 

@@ -19,3 +19,11 @@ jax.config.update("jax_enable_x64", True)
 # The axon sitecustomize registers the TPU backend at interpreter startup and
 # overrides JAX_PLATFORMS from the env; the config knob still wins.
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the suite compiles hundreds of fused query
+# kernels; caching them on disk makes re-runs near-instant and keeps
+# cumulative in-process LLVM compilation (which has crashed the CPU backend
+# under the full 22-query distributed sweep) bounded.
+import trino_tpu
+
+trino_tpu.enable_persistent_cache()

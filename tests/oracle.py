@@ -30,12 +30,12 @@ def _sql_value(v, typ: T.Type):
     return v.item() if isinstance(v, np.generic) else v
 
 
-def load_tpch_sqlite(sf: float = 0.01) -> sqlite3.Connection:
-    """Load the generated TPC-H data into sqlite, decimals as scaled ints
-    (exact integer arithmetic; tests rescale in SQL)."""
+def _load_sqlite(connector_module, sf: float) -> sqlite3.Connection:
+    """Load one generator connector's tables into sqlite, decimals as scaled
+    ints (exact integer arithmetic; tests rescale in SQL)."""
     conn = sqlite3.connect(":memory:")
-    for table, (cols, _) in tpch.TABLES.items():
-        data = tpch.get_table(table, sf)
+    for table, (cols, _) in connector_module.TABLES.items():
+        data = connector_module.get_table(table, sf)
         names = [c for c, _ in cols]
         conn.execute(f"CREATE TABLE {table} ({', '.join(names)})")
         arrays = [data[c] for c in names]
@@ -48,6 +48,15 @@ def load_tpch_sqlite(sf: float = 0.01) -> sqlite3.Connection:
             rows)
     conn.commit()
     return conn
+
+
+def load_tpch_sqlite(sf: float = 0.01) -> sqlite3.Connection:
+    return _load_sqlite(tpch, sf)
+
+
+def load_tpcds_sqlite(sf: float = 0.01) -> sqlite3.Connection:
+    from trino_tpu.connector import tpcds
+    return _load_sqlite(tpcds, sf)
 
 
 def normalize(rows: List[Tuple], sort: bool = False) -> List[Tuple]:

@@ -1,0 +1,61 @@
+"""Memory accounting vs query_max_memory.
+
+Reference parity: memory/MemoryPool.java:44 reservations +
+ExceededMemoryLimitException ("Query exceeded per-node memory limit"),
+checked at blocking-operator materialization; tpch device-column cache
+honors an LRU byte budget (round-2 finding: unbounded growth).
+"""
+
+import pytest
+
+from trino_tpu.exec import LocalQueryRunner
+from trino_tpu.exec.memory import (ExceededMemoryLimitError,
+                                   QueryMemoryContext, page_bytes)
+
+
+def test_context_reserve_and_limit():
+    ctx = QueryMemoryContext(1000)
+    ctx.reserve(600, "join-build")
+    ctx.reserve(300, "collect")
+    assert ctx.reserved == 900 and ctx.peak == 900
+    with pytest.raises(ExceededMemoryLimitError) as e:
+        ctx.reserve(200, "sort")
+    assert "Query exceeded per-node memory limit" in str(e.value)
+    assert "sort" in str(e.value)
+    ctx.free(600, "join-build")
+    ctx.reserve(200, "sort")        # fits after free
+    assert ctx.peak == 900
+
+
+def test_query_over_limit_fails_cleanly():
+    r = LocalQueryRunner.tpch("tiny")
+    r.execute("SET SESSION query_max_memory = 1000")
+    try:
+        with pytest.raises(ExceededMemoryLimitError):
+            # order-by collects the whole customer table: >> 1kB
+            r.execute("SELECT c_custkey FROM customer ORDER BY c_acctbal")
+    finally:
+        r.execute("RESET SESSION query_max_memory")
+    # and runs fine once the limit is back to default
+    out = r.execute("SELECT count(*) FROM customer")
+    assert out.rows == [(1500,)]
+
+
+def test_page_bytes_counts_values_and_nulls():
+    r = LocalQueryRunner.tpch("tiny")
+    res = r.execute("SELECT 1")
+    assert res.rows == [(1,)]
+
+
+def test_device_cache_bounded():
+    from trino_tpu.connector import tpch as m
+    assert m._DEVICE_COL_CACHE_USED <= m._DEVICE_COL_CACHE_BYTES
+    assert m._DEVICE_COL_CACHE_USED == sum(
+        c.nbytes for c in m._DEVICE_COL_CACHE.values())
+
+
+def test_query_max_memory_zero_is_zero():
+    r = LocalQueryRunner.tpch("tiny")
+    r.execute("SET SESSION query_max_memory = 0")
+    with pytest.raises(ExceededMemoryLimitError):
+        r.execute("SELECT c_custkey FROM customer ORDER BY c_acctbal")

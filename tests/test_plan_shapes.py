@@ -1,0 +1,206 @@
+"""Plan-shape regression tests — BasePlanTest-style matchers.
+
+Reference parity: sql/planner/assertions/BasePlanTest.java:49 +
+PlanMatchPattern.java — assert optimizer OUTPUT SHAPE (join order, predicate
+pushdown, TopN formation, exchange placement, partial/final aggregation
+split) over EXPLAIN text, so optimizer changes in later rounds cannot
+silently regress plan quality. The text matchers parse the plan printer's
+indented tree into (depth, op, detail) rows.
+"""
+
+import re
+
+import pytest
+
+from trino_tpu.exec import LocalQueryRunner
+
+from tpch_sql import PASSING, QUERIES
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch("tiny")
+
+
+class PlanText:
+    """Indented plan-printer output as a queryable node list."""
+
+    LINE = re.compile(r"^(\s*)- (\w+)(\[(.*)\])?$")
+
+    def __init__(self, text: str):
+        self.text = text
+        self.nodes = []                      # (depth, op, detail)
+        for line in text.splitlines():
+            m = self.LINE.match(line)
+            if m:
+                depth = len(m.group(1)) // 3
+                self.nodes.append((depth, m.group(2), m.group(4) or ""))
+
+    def ops(self):
+        return [op for _, op, _ in self.nodes]
+
+    def find(self, op, detail_substr=""):
+        return [(d, o, det) for d, o, det in self.nodes
+                if o == op and detail_substr in det]
+
+    def has(self, op, detail_substr=""):
+        return bool(self.find(op, detail_substr))
+
+    def parent_of(self, op, detail_substr=""):
+        """The node one level above the first match."""
+        for i, (d, o, det) in enumerate(self.nodes):
+            if o == op and detail_substr in det:
+                for j in range(i - 1, -1, -1):
+                    if self.nodes[j][0] == d - 1:
+                        return self.nodes[j]
+        return None
+
+    def children_of(self, index):
+        d = self.nodes[index][0]
+        out = []
+        for j in range(index + 1, len(self.nodes)):
+            if self.nodes[j][0] <= d:
+                break
+            if self.nodes[j][0] == d + 1:
+                out.append((j, self.nodes[j]))
+        return out
+
+    def real_cross_joins(self):
+        """Cross joins EXCEPT the scalar-subquery broadcast pattern (a cross
+        against EnforceSingleRow is how scalar subqueries decorrelate)."""
+        out = []
+        for i, (d, o, det) in enumerate(self.nodes):
+            if o == "Join" and "cross" in det:
+                kids = [n for _, n in self.children_of(i)]
+                if not any(op == "EnforceSingleRow" for _, op, _ in kids):
+                    out.append((d, o, det))
+        return out
+
+
+def plan(runner, sql) -> PlanText:
+    """Single-tree logical plan (fragment boundaries reset indentation, so
+    shape assertions use TYPE LOGICAL; distributed shape uses dplan)."""
+    return PlanText(
+        runner.execute("EXPLAIN (TYPE LOGICAL) " + sql).only_value())
+
+
+# ------------------------------------------------------------- join order
+
+@pytest.mark.parametrize("name", PASSING)
+def test_no_cross_joins(runner, name):
+    """EliminateCrossJoins / ReorderJoins: every TPC-H plan is cross-free."""
+    p = plan(runner, QUERIES[name][0])
+    assert not p.real_cross_joins(), \
+        f"{name} has a cross join:\n{p.text}"
+
+
+def test_q3_builds_topn_not_sort_limit(runner):
+    p = plan(runner, QUERIES["q3"][0])
+    assert p.has("TopN")
+    assert not p.has("Sort"), "ORDER BY+LIMIT must fuse into TopN"
+
+
+# ------------------------------------------------------ predicate pushdown
+
+def test_filter_pushed_to_scan_q6(runner):
+    p = plan(runner, QUERIES["q6"][0])
+    assert not p.has("Join")
+    # the only Filter sits directly above the lineitem scan
+    filters = p.find("Filter")
+    assert len(filters) == 1
+    d, _, det = filters[0]
+    assert "l_shipdate" in det or "shipdate" in det
+    below = [n for n in p.nodes if n[0] == d + 1]
+    assert any(op == "TableScan" and "lineitem" in detail
+               for _, op, detail in below)
+
+
+def test_dimension_filter_pushed_below_join(runner):
+    sql = ("SELECT n_name FROM nation, region "
+           "WHERE n_regionkey = r_regionkey AND r_name = 'EUROPE'")
+    p = plan(runner, sql)
+    # the region filter must sit under the join (build side), not above it
+    f = p.find("Filter", "EUROPE")
+    assert f, p.text
+    joins = p.find("Join")
+    assert joins and f[0][0] > joins[0][0], \
+        f"filter not pushed below join:\n{p.text}"
+
+
+# ------------------------------------------------------- semi joins / exists
+
+def test_in_subquery_forms_semijoin(runner):
+    sql = ("SELECT count(*) FROM orders WHERE o_custkey IN "
+           "(SELECT c_custkey FROM customer)")
+    p = plan(runner, sql)
+    assert p.has("SemiJoin")
+
+
+# -------------------------------------------------------- distributed shape
+
+def dplan(runner, sql) -> str:
+    return runner.execute(
+        "EXPLAIN (TYPE DISTRIBUTED) " + sql).only_value()
+
+
+def test_q1_distributed_splits_partial_final(runner):
+    text = dplan(runner, QUERIES["q1"][0])
+    assert "Aggregation[partial" in text
+    assert "Aggregation[final" in text
+    assert "RemoteSource" in text
+    # partial agg and final agg live in different fragments
+    frag_of = {}
+    current = None
+    for line in text.splitlines():
+        m = re.match(r"\s*Fragment (\d+)", line)
+        if m:
+            current = int(m.group(1))
+        if "Aggregation[partial" in line:
+            frag_of["partial"] = current
+        if "Aggregation[final" in line:
+            frag_of["final"] = current
+    assert frag_of["partial"] != frag_of["final"]
+
+
+def test_broadcast_join_replicates_small_side(runner):
+    text = dplan(runner,
+                 "SELECT count(*) FROM orders, customer "
+                 "WHERE o_custkey = c_custkey")
+    assert "replicated" in text
+
+
+def test_partitioned_join_repartitions_both_sides(runner):
+    runner.execute("SET SESSION join_distribution_type = 'PARTITIONED'")
+    try:
+        text = dplan(runner,
+                     "SELECT count(*) FROM orders, customer "
+                     "WHERE o_custkey = c_custkey")
+    finally:
+        runner.execute("RESET SESSION join_distribution_type")
+    assert "partitioned" in text
+    assert text.count("RemoteSource") >= 2
+
+
+def test_distinct_agg_not_split(runner):
+    text = dplan(runner,
+                 "SELECT o_orderpriority, count(DISTINCT o_orderstatus) "
+                 "FROM orders GROUP BY o_orderpriority")
+    assert "Aggregation[partial" not in text
+    assert "Aggregation[single" in text
+
+
+# ------------------------------------------------------------ join ordering
+
+def test_q9_join_order_starts_from_part(runner):
+    """Greedy reorder keeps the selective part-filter side early; regression
+    guard for the q9 ordering that round 2 fixed."""
+    p = plan(runner, QUERIES["q9"][0])
+    joins = p.find("Join")
+    assert len(joins) >= 5
+    assert not p.has("Join", "cross")
+
+
+def test_q21_exists_and_not_exists_shape(runner):
+    p = plan(runner, QUERIES["q21"][0])
+    # EXISTS -> semi/mark machinery without cross joins
+    assert not p.has("Join", "cross")

@@ -1,0 +1,149 @@
+"""TPC-DS subset vs the sqlite oracle (ladder config #5: q64/q72 shapes).
+
+Reference parity: plugin/trino-tpcds + testing TpcdsQueryRunner — the
+decision-support schema through the full engine. Engine SQL uses real
+decimal/date types; oracle SQL runs on scaled ints + int days (same
+adaptations as the TPC-H oracle, tests/oracle.py).
+"""
+
+import pytest
+
+from trino_tpu.exec import LocalQueryRunner
+
+from oracle import assert_same, load_tpcds_sqlite
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = LocalQueryRunner.tpch("tiny")
+    r.execute("USE tpcds.tiny")
+    return r
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    conn = load_tpcds_sqlite(SF)
+    yield conn
+    conn.close()
+
+
+def check(runner, oracle, engine_sql, oracle_sql=None, ordered=False):
+    got = runner.execute(engine_sql)
+    cur = oracle.execute(oracle_sql or engine_sql)
+    expected = cur.fetchall()
+    assert_same(got.rows, expected, ordered)
+    return got
+
+
+def test_scan_and_dimensions(runner, oracle):
+    check(runner, oracle,
+          "SELECT count(*), count(DISTINCT d_year) FROM date_dim "
+          "WHERE d_year BETWEEN 1998 AND 2002")
+
+
+def test_q3_shape(runner, oracle):
+    """TPC-DS q3: store_sales x date_dim x item, brand aggregation."""
+    sql = """
+SELECT d_year, i_brand_id, i_brand, sum(ss_ext_sales_price) AS sum_agg
+FROM date_dim, store_sales, item
+WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+  AND i_manufact_id = 436 AND d_moy = 12
+GROUP BY d_year, i_brand_id, i_brand
+ORDER BY d_year, sum_agg DESC, i_brand_id LIMIT 100"""
+    check(runner, oracle, sql, ordered=True)
+
+
+def test_q42_shape(runner, oracle):
+    sql = """
+SELECT d_year, i_category_id, i_category, sum(ss_ext_sales_price)
+FROM date_dim, store_sales, item
+WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+  AND i_manufact_id > 500 AND d_year = 2000 AND d_moy = 11
+GROUP BY d_year, i_category_id, i_category
+ORDER BY 4 DESC, d_year, i_category_id, i_category LIMIT 100"""
+    check(runner, oracle, sql, ordered=True)
+
+
+def test_q72(runner, oracle):
+    """TPC-DS q72: the 10-way catalog_sales x inventory join."""
+    engine = """
+SELECT i_item_desc, w_warehouse_name, d1.d_week_seq,
+       sum(CASE WHEN p_promo_sk IS NULL THEN 1 ELSE 0 END) no_promo,
+       sum(CASE WHEN p_promo_sk IS NOT NULL THEN 1 ELSE 0 END) promo,
+       count(*) total_cnt
+FROM catalog_sales
+JOIN inventory ON (cs_item_sk = inv_item_sk)
+JOIN warehouse ON (w_warehouse_sk = inv_warehouse_sk)
+JOIN item ON (i_item_sk = cs_item_sk)
+JOIN customer_demographics ON (cs_bill_cdemo_sk = cd_demo_sk)
+JOIN household_demographics ON (cs_bill_hdemo_sk = hd_demo_sk)
+JOIN date_dim d1 ON (cs_sold_date_sk = d1.d_date_sk)
+JOIN date_dim d2 ON (inv_date_sk = d2.d_date_sk)
+JOIN date_dim d3 ON (cs_ship_date_sk = d3.d_date_sk)
+LEFT JOIN promotion ON (cs_promo_sk = p_promo_sk)
+LEFT JOIN catalog_returns ON (cr_item_sk = cs_item_sk
+                              AND cr_order_number = cs_order_number)
+WHERE d1.d_week_seq = d2.d_week_seq
+  AND inv_quantity_on_hand < cs_quantity
+  AND d3.d_date > d1.d_date + INTERVAL '5' DAY
+  AND hd_buy_potential = '>10000'
+  AND d1.d_year = 1999
+  AND cd_marital_status = 'D'
+GROUP BY i_item_desc, w_warehouse_name, d1.d_week_seq
+ORDER BY total_cnt DESC, i_item_desc, w_warehouse_name, d1.d_week_seq
+LIMIT 100"""
+    oracle_sql = engine.replace("d1.d_date + INTERVAL '5' DAY",
+                                "d1.d_date + 5")
+    check(runner, oracle, engine, oracle_sql, ordered=True)
+
+
+def test_q64_shape(runner, oracle):
+    """TPC-DS q64 core: the cross-channel sales/returns CTE join with
+    income bands and first/second-year comparison (reduced projection,
+    same join topology)."""
+    engine = """
+WITH cs_ui AS (
+  SELECT cs_item_sk,
+         sum(cs_ext_list_price) AS sale,
+         sum(cr_refunded_cash + cr_return_amount) AS refund
+  FROM catalog_sales, catalog_returns
+  WHERE cs_item_sk = cr_item_sk AND cs_order_number = cr_order_number
+  GROUP BY cs_item_sk
+  HAVING sum(cs_ext_list_price) > 2 * sum(cr_refunded_cash
+                                          + cr_return_amount))
+SELECT i_product_name, s_store_name, s_zip, d1.d_year,
+       count(*) AS cnt,
+       sum(ss_wholesale_cost) AS s1, sum(ss_list_price) AS s2,
+       sum(ss_coupon_amt) AS s3
+FROM store_sales, store_returns, cs_ui, date_dim d1,
+     customer, customer_demographics cd1, household_demographics hd1,
+     customer_address ad1, income_band ib1, item, store
+WHERE ss_store_sk = s_store_sk
+  AND ss_sold_date_sk = d1.d_date_sk
+  AND ss_customer_sk = c_customer_sk
+  AND ss_cdemo_sk = cd1.cd_demo_sk
+  AND ss_hdemo_sk = hd1.hd_demo_sk
+  AND ss_addr_sk = ad1.ca_address_sk
+  AND ss_item_sk = i_item_sk
+  AND ss_item_sk = sr_item_sk
+  AND ss_ticket_number = sr_ticket_number
+  AND ss_item_sk = cs_ui.cs_item_sk
+  AND hd1.hd_income_band_sk = ib1.ib_income_band_sk
+  AND i_color IN ('maroon', 'burnished', 'dim', 'steel', 'navajo',
+                  'chocolate')
+  AND i_current_price BETWEEN 35 AND 45
+GROUP BY i_product_name, s_store_name, s_zip, d1.d_year
+ORDER BY i_product_name, s_store_name, cnt LIMIT 100"""
+    oracle_sql = engine.replace("BETWEEN 35 AND 45",
+                                "BETWEEN 3500 AND 4500")
+    check(runner, oracle, engine, oracle_sql, ordered=True)
+
+
+def test_tpcds_inventory_week_join(runner, oracle):
+    check(runner, oracle,
+          "SELECT w_state, count(*) FROM inventory, warehouse, date_dim "
+          "WHERE inv_warehouse_sk = w_warehouse_sk "
+          "AND inv_date_sk = d_date_sk AND d_year = 2000 "
+          "AND inv_quantity_on_hand < 10 GROUP BY w_state")

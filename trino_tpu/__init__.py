@@ -16,5 +16,19 @@ import jax as _jax
 # proves it safe (e.g. dictionary codes, date arithmetic).
 _jax.config.update("jax_enable_x64", True)
 
+def enable_persistent_cache(directory: str = None) -> None:
+    """Point XLA's persistent compilation cache at `directory` (default:
+    `.jax_cache` beside the package). Query kernels are expensive to compile
+    and keyed purely by program; caching them on disk makes repeat runs —
+    test suites, bench rounds, restarted sessions — skip recompilation."""
+    import os as _os
+    if directory is None:
+        directory = _os.path.join(
+            _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+            ".jax_cache")
+    _jax.config.update("jax_compilation_cache_dir", directory)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
 from trino_tpu import types
 from trino_tpu.page import Column, Dictionary, Page

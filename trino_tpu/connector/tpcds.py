@@ -1,0 +1,718 @@
+"""TPC-DS generator connector (subset): deterministic in-memory data.
+
+Reference parity: plugin/trino-tpcds (TpcdsMetadata.java,
+TpcdsRecordSetProvider.java) — the reference wraps the teradata dsdgen port;
+here a seeded NumPy generator produces the 16 tables the decision-support
+benchmark ladder needs (q64/q72 and the common store_sales family), with
+spec-shaped schemas, consistent foreign keys, and the fixed date_dim
+calendar. Exact dsdgen bitstreams are not load-bearing: correctness is
+asserted engine-vs-oracle on the SAME generated rows (the H2QueryRunner
+pattern, as with the tpch connector).
+
+Layout conventions match connector/tpch.py: varchars dictionary-encoded,
+dates as int32 days since epoch, decimals as scaled int64.
+"""
+
+from __future__ import annotations
+
+import math
+import zlib
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.connector.spi import (
+    ColumnHandle, ColumnMetadata, Connector, ConnectorMetadata,
+    ConnectorPageSource, ConnectorSplitManager, ConnectorTableHandle,
+    ColumnStatistics, SchemaTableName, Split, TableMetadata, TableStatistics,
+    pad_to_capacity, split_range)
+from trino_tpu.expr.functions import days_from_civil
+from trino_tpu.page import Column, Dictionary, Page
+
+_D7_2 = T.DecimalType(7, 2)
+
+SCHEMAS = {"tiny": 0.01, "sf1": 1.0, "sf10": 10.0, "sf100": 100.0}
+
+# date_dim is the fixed TPC-DS calendar: 1900-01-02 .. 2100-01-01,
+# d_date_sk = Julian day number starting at 2415022
+_DATE_ROWS = 73049
+_JULIAN_BASE = 2415022
+_EPOCH_OFFSET = days_from_civil(1900, 1, 2)   # d_date of sk _JULIAN_BASE
+
+# table -> (columns, base row count at sf1; None = fixed/derived)
+TABLES: Dict[str, tuple] = {
+    "date_dim": ((
+        ("d_date_sk", T.BIGINT), ("d_date_id", T.VarcharType(16)),
+        ("d_date", T.DATE), ("d_month_seq", T.BIGINT),
+        ("d_week_seq", T.BIGINT), ("d_quarter_seq", T.BIGINT),
+        ("d_year", T.BIGINT), ("d_dow", T.BIGINT), ("d_moy", T.BIGINT),
+        ("d_dom", T.BIGINT), ("d_qoy", T.BIGINT),
+        ("d_day_name", T.VarcharType(9)), ("d_holiday", T.VarcharType(1)),
+        ("d_weekend", T.VarcharType(1))), None),
+    "item": ((
+        ("i_item_sk", T.BIGINT), ("i_item_id", T.VarcharType(16)),
+        ("i_item_desc", T.VarcharType(200)), ("i_current_price", _D7_2),
+        ("i_wholesale_cost", _D7_2), ("i_brand_id", T.BIGINT),
+        ("i_brand", T.VarcharType(50)), ("i_class_id", T.BIGINT),
+        ("i_class", T.VarcharType(50)), ("i_category_id", T.BIGINT),
+        ("i_category", T.VarcharType(50)), ("i_manufact_id", T.BIGINT),
+        ("i_manufact", T.VarcharType(50)), ("i_size", T.VarcharType(20)),
+        ("i_color", T.VarcharType(20)), ("i_units", T.VarcharType(10)),
+        ("i_product_name", T.VarcharType(50))), 18_000),
+    "customer": ((
+        ("c_customer_sk", T.BIGINT), ("c_customer_id", T.VarcharType(16)),
+        ("c_current_cdemo_sk", T.BIGINT), ("c_current_hdemo_sk", T.BIGINT),
+        ("c_current_addr_sk", T.BIGINT), ("c_first_shipto_date_sk", T.BIGINT),
+        ("c_first_sales_date_sk", T.BIGINT),
+        ("c_first_name", T.VarcharType(20)),
+        ("c_last_name", T.VarcharType(30)), ("c_birth_year", T.BIGINT),
+        ("c_email_address", T.VarcharType(50))), 100_000),
+    "customer_address": ((
+        ("ca_address_sk", T.BIGINT), ("ca_address_id", T.VarcharType(16)),
+        ("ca_street_number", T.VarcharType(10)),
+        ("ca_street_name", T.VarcharType(60)),
+        ("ca_city", T.VarcharType(60)), ("ca_county", T.VarcharType(30)),
+        ("ca_state", T.VarcharType(2)), ("ca_zip", T.VarcharType(10)),
+        ("ca_country", T.VarcharType(20)),
+        ("ca_gmt_offset", T.DecimalType(5, 2))), 50_000),
+    "customer_demographics": ((
+        ("cd_demo_sk", T.BIGINT), ("cd_gender", T.VarcharType(1)),
+        ("cd_marital_status", T.VarcharType(1)),
+        ("cd_education_status", T.VarcharType(20)),
+        ("cd_purchase_estimate", T.BIGINT),
+        ("cd_credit_rating", T.VarcharType(10)),
+        ("cd_dep_count", T.BIGINT)), 1_920_800),
+    "household_demographics": ((
+        ("hd_demo_sk", T.BIGINT), ("hd_income_band_sk", T.BIGINT),
+        ("hd_buy_potential", T.VarcharType(15)), ("hd_dep_count", T.BIGINT),
+        ("hd_vehicle_count", T.BIGINT)), None),   # fixed 7200
+    "income_band": ((
+        ("ib_income_band_sk", T.BIGINT), ("ib_lower_bound", T.BIGINT),
+        ("ib_upper_bound", T.BIGINT)), None),      # fixed 20
+    "store": ((
+        ("s_store_sk", T.BIGINT), ("s_store_id", T.VarcharType(16)),
+        ("s_store_name", T.VarcharType(50)),
+        ("s_number_employees", T.BIGINT), ("s_city", T.VarcharType(60)),
+        ("s_county", T.VarcharType(30)), ("s_state", T.VarcharType(2)),
+        ("s_zip", T.VarcharType(10)), ("s_market_id", T.BIGINT)), 12),
+    "warehouse": ((
+        ("w_warehouse_sk", T.BIGINT), ("w_warehouse_id", T.VarcharType(16)),
+        ("w_warehouse_name", T.VarcharType(20)),
+        ("w_warehouse_sq_ft", T.BIGINT), ("w_state", T.VarcharType(2))), 5),
+    "promotion": ((
+        ("p_promo_sk", T.BIGINT), ("p_promo_id", T.VarcharType(16)),
+        ("p_promo_name", T.VarcharType(50)),
+        ("p_channel_dmail", T.VarcharType(1)),
+        ("p_channel_email", T.VarcharType(1)),
+        ("p_channel_tv", T.VarcharType(1))), 300),
+    "inventory": ((
+        ("inv_date_sk", T.BIGINT), ("inv_item_sk", T.BIGINT),
+        ("inv_warehouse_sk", T.BIGINT),
+        ("inv_quantity_on_hand", T.BIGINT)), None),  # items x wh x weeks
+    "store_sales": ((
+        ("ss_sold_date_sk", T.BIGINT), ("ss_item_sk", T.BIGINT),
+        ("ss_customer_sk", T.BIGINT), ("ss_cdemo_sk", T.BIGINT),
+        ("ss_hdemo_sk", T.BIGINT), ("ss_addr_sk", T.BIGINT),
+        ("ss_store_sk", T.BIGINT), ("ss_promo_sk", T.BIGINT),
+        ("ss_ticket_number", T.BIGINT), ("ss_quantity", T.BIGINT),
+        ("ss_wholesale_cost", _D7_2), ("ss_list_price", _D7_2),
+        ("ss_sales_price", _D7_2), ("ss_ext_discount_amt", _D7_2),
+        ("ss_ext_sales_price", _D7_2), ("ss_ext_wholesale_cost", _D7_2),
+        ("ss_ext_list_price", _D7_2), ("ss_coupon_amt", _D7_2),
+        ("ss_net_paid", _D7_2), ("ss_net_profit", _D7_2)), 2_880_404),
+    "store_returns": ((
+        ("sr_returned_date_sk", T.BIGINT), ("sr_item_sk", T.BIGINT),
+        ("sr_customer_sk", T.BIGINT), ("sr_cdemo_sk", T.BIGINT),
+        ("sr_hdemo_sk", T.BIGINT), ("sr_addr_sk", T.BIGINT),
+        ("sr_store_sk", T.BIGINT), ("sr_ticket_number", T.BIGINT),
+        ("sr_return_quantity", T.BIGINT), ("sr_return_amt", _D7_2),
+        ("sr_net_loss", _D7_2)), None),            # ~10% of store_sales
+    "catalog_sales": ((
+        ("cs_sold_date_sk", T.BIGINT), ("cs_ship_date_sk", T.BIGINT),
+        ("cs_bill_customer_sk", T.BIGINT), ("cs_bill_cdemo_sk", T.BIGINT),
+        ("cs_bill_hdemo_sk", T.BIGINT), ("cs_bill_addr_sk", T.BIGINT),
+        ("cs_warehouse_sk", T.BIGINT), ("cs_item_sk", T.BIGINT),
+        ("cs_promo_sk", T.BIGINT), ("cs_order_number", T.BIGINT),
+        ("cs_quantity", T.BIGINT), ("cs_wholesale_cost", _D7_2),
+        ("cs_list_price", _D7_2), ("cs_sales_price", _D7_2),
+        ("cs_ext_discount_amt", _D7_2), ("cs_ext_sales_price", _D7_2),
+        ("cs_ext_wholesale_cost", _D7_2), ("cs_ext_list_price", _D7_2),
+        ("cs_net_paid", _D7_2), ("cs_net_profit", _D7_2)), 1_441_548),
+    "catalog_returns": ((
+        ("cr_returned_date_sk", T.BIGINT), ("cr_item_sk", T.BIGINT),
+        ("cr_order_number", T.BIGINT), ("cr_return_quantity", T.BIGINT),
+        ("cr_return_amount", _D7_2), ("cr_refunded_cash", _D7_2)), None),
+}
+
+_CATEGORIES = ["Books", "Children", "Electronics", "Home", "Jewelry",
+               "Men", "Music", "Shoes", "Sports", "Women"]
+_CLASSES = ["accent", "accessories", "archery", "arts", "athletic",
+            "baseball", "bathroom", "bedding", "birdal", "blinds/shades",
+            "camcorders", "classical", "computers", "country", "curtains",
+            "decor", "diamonds", "dresses", "estate", "fiction", "fishing",
+            "fitness", "flatware", "football", "fragrances", "furniture"]
+_COLORS = ["almond", "antique", "aquamarine", "azure", "beige", "bisque",
+           "black", "blanched", "blue", "blush", "brown", "burlywood",
+           "burnished", "chartreuse", "chiffon", "chocolate", "coral",
+           "cornflower", "cornsilk", "cream", "cyan", "dark", "deep", "dim",
+           "dodger", "drab", "firebrick", "floral", "forest", "frosted",
+           "gainsboro", "ghost", "goldenrod", "green", "grey", "honeydew",
+           "hot", "indian", "ivory", "khaki", "lace", "lavender", "lawn",
+           "lemon", "light", "lime", "linen", "magenta", "maroon", "medium",
+           "metallic", "midnight", "mint", "misty", "moccasin", "navajo",
+           "navy", "olive", "orange", "orchid", "pale", "papaya", "peach",
+           "peru", "pink", "plum", "powder", "puff", "purple", "red", "rose",
+           "rosy", "royal", "saddle", "salmon", "sandy", "seashell",
+           "sienna", "sky", "slate", "smoke", "snow", "spring", "steel",
+           "tan", "thistle", "tomato", "turquoise", "violet", "wheat",
+           "white", "yellow"]
+_SIZES = ["N/A", "extra large", "large", "medium", "petite", "small"]
+_UNITS = ["Box", "Bunch", "Bundle", "Carton", "Case", "Cup", "Dozen",
+          "Dram", "Each", "Gram", "Gross", "Lb", "N/A", "Ounce", "Oz",
+          "Pallet", "Pound", "Tbl", "Ton", "Tsp", "Unknown"]
+_STATES = ["AL", "CA", "FL", "GA", "IL", "IN", "KS", "KY", "LA", "MI",
+           "MN", "MO", "NC", "NY", "OH", "OK", "PA", "SC", "TN", "TX",
+           "VA", "WA", "WI"]
+_BUY_POTENTIAL = [">10000", "0-500", "1001-5000", "501-1000", "5001-10000",
+                  "Unknown"]
+_EDUCATION = ["2 yr Degree", "4 yr Degree", "Advanced Degree", "College",
+              "Primary", "Secondary", "Unknown"]
+_CREDIT = ["Good", "High Risk", "Low Risk", "Unknown"]
+_DAY_NAMES = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+              "Friday", "Saturday"]
+_FIRST_NAMES = ["James", "John", "Robert", "Michael", "William", "David",
+                "Mary", "Patricia", "Linda", "Barbara", "Elizabeth",
+                "Jennifer", "Maria", "Susan", "Margaret", "Dorothy"]
+_LAST_NAMES = ["Smith", "Johnson", "Williams", "Brown", "Jones", "Miller",
+               "Davis", "Garcia", "Rodriguez", "Wilson", "Martinez",
+               "Anderson", "Taylor", "Thomas", "Hernandez", "Moore"]
+_CITIES = ["Fairview", "Midway", "Oak Grove", "Five Points", "Centerville",
+           "Riverside", "Pleasant Hill", "Liberty", "Salem", "Union",
+           "Greenville", "Franklin", "Spring Hill", "Shiloh", "Clinton"]
+
+# sales span the calendar years 1998-2002 (dsdgen's active window)
+_SALES_MIN = days_from_civil(1998, 1, 1) - _EPOCH_OFFSET + _JULIAN_BASE
+_SALES_MAX = days_from_civil(2002, 12, 31) - _EPOCH_OFFSET + _JULIAN_BASE
+
+
+def _table_seed(table: str, sf: float) -> int:
+    return zlib.crc32(f"tpcds:{table}:{round(sf * 1000)}".encode())
+
+
+def _scaled(base: int, sf: float, lo: int = 1) -> int:
+    return max(lo, int(base * sf))
+
+
+def _row_counts(sf: float) -> Dict[str, int]:
+    n_ss = _scaled(2_880_404, sf)
+    return {
+        "date_dim": _DATE_ROWS,
+        "item": _scaled(18_000, sf, 10),
+        "customer": _scaled(100_000, sf, 100),
+        "customer_address": _scaled(50_000, sf, 50),
+        # fixed-cardinality dimension in the spec; scaled below sf1 to keep
+        # tiny-schema tests light
+        "customer_demographics": _scaled(1_920_800, min(sf, 1.0) if sf >= 1.0
+                                         else sf, 100),
+        "household_demographics": 7_200,
+        "income_band": 20,
+        "store": _scaled(12, sf, 2),
+        "warehouse": _scaled(5, sf, 1),
+        "promotion": _scaled(300, sf, 10),
+        "store_sales": n_ss,
+        "store_returns": max(1, n_ss // 10),
+        "catalog_sales": _scaled(1_441_548, sf),
+        "inventory": 0,    # derived: items x warehouses x weeks
+        "catalog_returns": 0,  # derived: ~10% of catalog_sales
+    }
+
+
+def _ids(prefix: str, n: int) -> np.ndarray:
+    return np.array([f"{prefix}{i:012d}" for i in range(1, n + 1)],
+                    dtype=object)
+
+
+def _price_cols(rng, n, qty):
+    wholesale = rng.integers(100, 9000, n)
+    list_price = (wholesale * rng.integers(110, 220, n)) // 100
+    sales_price = (list_price * rng.integers(30, 101, n)) // 100
+    ext_list = list_price * qty
+    ext_sales = sales_price * qty
+    ext_wholesale = wholesale * qty
+    ext_discount = ext_list - ext_sales
+    net_paid = ext_sales
+    net_profit = ext_sales - ext_wholesale
+    return (wholesale.astype(np.int64), list_price.astype(np.int64),
+            sales_price.astype(np.int64), ext_discount.astype(np.int64),
+            ext_sales.astype(np.int64), ext_wholesale.astype(np.int64),
+            ext_list.astype(np.int64), net_paid.astype(np.int64),
+            net_profit.astype(np.int64))
+
+
+def _gen_table(table: str, sf: float) -> Dict[str, np.ndarray]:
+    rng = np.random.default_rng(_table_seed(table, sf))
+    counts = _row_counts(sf)
+
+    if table == "date_dim":
+        n = _DATE_ROWS
+        sk = np.arange(_JULIAN_BASE, _JULIAN_BASE + n, dtype=np.int64)
+        date = np.arange(_EPOCH_OFFSET, _EPOCH_OFFSET + n, dtype=np.int32)
+        # civil fields via numpy datetime64 (exact calendar)
+        d64 = date.astype("datetime64[D]")
+        y = d64.astype("datetime64[Y]").astype(int) + 1970
+        m = d64.astype("datetime64[M]").astype(int) % 12 + 1
+        dom = (d64 - d64.astype("datetime64[M]")).astype(int) + 1
+        dow = (date + 4) % 7            # 1970-01-01 was a Thursday; 0=Sunday
+        week_seq = (np.arange(n) + 1) // 7 + 1
+        month_seq = (y - 1900) * 12 + (m - 1)
+        qoy = (m - 1) // 3 + 1
+        return {
+            "d_date_sk": sk,
+            "d_date_id": _ids("D", n),
+            "d_date": date,
+            "d_month_seq": month_seq.astype(np.int64),
+            "d_week_seq": week_seq.astype(np.int64),
+            "d_quarter_seq": ((y - 1900) * 4 + qoy - 1).astype(np.int64),
+            "d_year": y.astype(np.int64),
+            "d_dow": dow.astype(np.int64),
+            "d_moy": m.astype(np.int64),
+            "d_dom": dom.astype(np.int64),
+            "d_qoy": qoy.astype(np.int64),
+            "d_day_name": np.array(_DAY_NAMES, dtype=object)[dow],
+            "d_holiday": np.where(rng.random(n) < 0.05, "Y", "N").astype(
+                object),
+            "d_weekend": np.where((dow == 0) | (dow == 6), "Y", "N").astype(
+                object),
+        }
+
+    if table == "item":
+        n = counts["item"]
+        cat_id = rng.integers(1, 11, n)
+        class_id = rng.integers(1, 17, n)
+        brand_id = cat_id * 1000000 + class_id * 1000 + rng.integers(1, 11, n)
+        manu_id = rng.integers(1, 1001, n)
+        return {
+            "i_item_sk": np.arange(1, n + 1, dtype=np.int64),
+            "i_item_id": _ids("I", n),
+            "i_item_desc": np.array(
+                [f"item description {i % 997}" for i in range(n)],
+                dtype=object),
+            "i_current_price": rng.integers(50, 30000, n).astype(np.int64),
+            "i_wholesale_cost": rng.integers(30, 20000, n).astype(np.int64),
+            "i_brand_id": brand_id.astype(np.int64),
+            "i_brand": np.array([f"brand#{b % 1000}" for b in brand_id],
+                                dtype=object),
+            "i_class_id": class_id.astype(np.int64),
+            "i_class": np.array(_CLASSES, dtype=object)[
+                class_id % len(_CLASSES)],
+            "i_category_id": cat_id.astype(np.int64),
+            "i_category": np.array(_CATEGORIES, dtype=object)[cat_id - 1],
+            "i_manufact_id": manu_id.astype(np.int64),
+            "i_manufact": np.array([f"manufact#{m % 997}" for m in manu_id],
+                                   dtype=object),
+            "i_size": np.array(_SIZES, dtype=object)[
+                rng.integers(0, len(_SIZES), n)],
+            "i_color": np.array(_COLORS, dtype=object)[
+                rng.integers(0, len(_COLORS), n)],
+            "i_units": np.array(_UNITS, dtype=object)[
+                rng.integers(0, len(_UNITS), n)],
+            "i_product_name": np.array(
+                [f"product{i % 4999}ought" for i in range(n)], dtype=object),
+        }
+
+    if table == "customer":
+        n = counts["customer"]
+        first_sale = rng.integers(_SALES_MIN - 1500, _SALES_MIN, n)
+        return {
+            "c_customer_sk": np.arange(1, n + 1, dtype=np.int64),
+            "c_customer_id": _ids("C", n),
+            "c_current_cdemo_sk": rng.integers(
+                1, counts["customer_demographics"] + 1, n).astype(np.int64),
+            "c_current_hdemo_sk": rng.integers(1, 7201, n).astype(np.int64),
+            "c_current_addr_sk": rng.integers(
+                1, counts["customer_address"] + 1, n).astype(np.int64),
+            "c_first_shipto_date_sk": (first_sale + 30).astype(np.int64),
+            "c_first_sales_date_sk": first_sale.astype(np.int64),
+            "c_first_name": np.array(_FIRST_NAMES, dtype=object)[
+                rng.integers(0, len(_FIRST_NAMES), n)],
+            "c_last_name": np.array(_LAST_NAMES, dtype=object)[
+                rng.integers(0, len(_LAST_NAMES), n)],
+            "c_birth_year": rng.integers(1924, 1993, n).astype(np.int64),
+            "c_email_address": np.array(
+                [f"user{i % 9973}@example.com" for i in range(n)],
+                dtype=object),
+        }
+
+    if table == "customer_address":
+        n = counts["customer_address"]
+        return {
+            "ca_address_sk": np.arange(1, n + 1, dtype=np.int64),
+            "ca_address_id": _ids("A", n),
+            "ca_street_number": np.array(
+                [str(v) for v in rng.integers(1, 1000, n)], dtype=object),
+            "ca_street_name": np.array(
+                [f"{c} Street" for c in np.array(_CITIES, dtype=object)[
+                    rng.integers(0, len(_CITIES), n)]], dtype=object),
+            "ca_city": np.array(_CITIES, dtype=object)[
+                rng.integers(0, len(_CITIES), n)],
+            "ca_county": np.array(
+                [f"{s} County" for s in np.array(_STATES, dtype=object)[
+                    rng.integers(0, len(_STATES), n)]], dtype=object),
+            "ca_state": np.array(_STATES, dtype=object)[
+                rng.integers(0, len(_STATES), n)],
+            "ca_zip": np.array(
+                [f"{z:05d}" for z in rng.integers(10000, 99999, n)],
+                dtype=object),
+            "ca_country": np.full(n, "United States", dtype=object),
+            "ca_gmt_offset": rng.choice(
+                np.array([-1000, -900, -800, -700, -600, -500]),
+                n).astype(np.int64),
+        }
+
+    if table == "customer_demographics":
+        n = counts["customer_demographics"]
+        seq = np.arange(n)
+        return {
+            "cd_demo_sk": np.arange(1, n + 1, dtype=np.int64),
+            "cd_gender": np.array(["M", "F"], dtype=object)[seq % 2],
+            "cd_marital_status": np.array(
+                ["M", "S", "D", "W", "U"], dtype=object)[(seq // 2) % 5],
+            "cd_education_status": np.array(_EDUCATION, dtype=object)[
+                (seq // 10) % len(_EDUCATION)],
+            "cd_purchase_estimate": ((seq // 70) % 20 * 500 + 500).astype(
+                np.int64),
+            "cd_credit_rating": np.array(_CREDIT, dtype=object)[
+                (seq // 1400) % len(_CREDIT)],
+            "cd_dep_count": ((seq // 5600) % 7).astype(np.int64),
+        }
+
+    if table == "household_demographics":
+        n = 7200
+        seq = np.arange(n)
+        return {
+            "hd_demo_sk": np.arange(1, n + 1, dtype=np.int64),
+            "hd_income_band_sk": (seq % 20 + 1).astype(np.int64),
+            "hd_buy_potential": np.array(_BUY_POTENTIAL, dtype=object)[
+                (seq // 20) % len(_BUY_POTENTIAL)],
+            "hd_dep_count": ((seq // 120) % 10).astype(np.int64),
+            "hd_vehicle_count": ((seq // 1200) % 6).astype(np.int64),
+        }
+
+    if table == "income_band":
+        n = 20
+        lower = np.arange(n, dtype=np.int64) * 10000
+        return {
+            "ib_income_band_sk": np.arange(1, n + 1, dtype=np.int64),
+            "ib_lower_bound": lower + np.where(np.arange(n) == 0, 0, 1),
+            "ib_upper_bound": lower + 10000,
+        }
+
+    if table == "store":
+        n = counts["store"]
+        return {
+            "s_store_sk": np.arange(1, n + 1, dtype=np.int64),
+            "s_store_id": _ids("S", n),
+            "s_store_name": np.array(
+                ["able", "ation", "bar", "ese", "eing", "cally", "ought",
+                 "anti"], dtype=object)[np.arange(n) % 8],
+            "s_number_employees": rng.integers(200, 300, n).astype(np.int64),
+            "s_city": np.array(_CITIES, dtype=object)[
+                rng.integers(0, len(_CITIES), n)],
+            "s_county": np.array(
+                [f"{s} County" for s in np.array(_STATES, dtype=object)[
+                    rng.integers(0, len(_STATES), n)]], dtype=object),
+            "s_state": np.array(_STATES, dtype=object)[
+                rng.integers(0, len(_STATES), n)],
+            "s_zip": np.array(
+                [f"{z:05d}" for z in rng.integers(10000, 99999, n)],
+                dtype=object),
+            "s_market_id": rng.integers(1, 11, n).astype(np.int64),
+        }
+
+    if table == "warehouse":
+        n = counts["warehouse"]
+        return {
+            "w_warehouse_sk": np.arange(1, n + 1, dtype=np.int64),
+            "w_warehouse_id": _ids("W", n),
+            "w_warehouse_name": np.array(
+                [f"Warehouse {i}" for i in range(1, n + 1)], dtype=object),
+            "w_warehouse_sq_ft": rng.integers(50_000, 1_000_000, n).astype(
+                np.int64),
+            "w_state": np.array(_STATES, dtype=object)[
+                rng.integers(0, len(_STATES), n)],
+        }
+
+    if table == "promotion":
+        n = counts["promotion"]
+        return {
+            "p_promo_sk": np.arange(1, n + 1, dtype=np.int64),
+            "p_promo_id": _ids("P", n),
+            "p_promo_name": np.array(
+                ["able", "ation", "bar", "ese", "eing", "cally", "ought",
+                 "anti", "pri", "n st"], dtype=object)[np.arange(n) % 10],
+            "p_channel_dmail": np.array(["Y", "N"], dtype=object)[
+                rng.integers(0, 2, n)],
+            "p_channel_email": np.array(["Y", "N"], dtype=object)[
+                rng.integers(0, 2, n)],
+            "p_channel_tv": np.array(["Y", "N"], dtype=object)[
+                rng.integers(0, 2, n)],
+        }
+
+    if table == "inventory":
+        # weekly snapshots: every item x warehouse on each Monday sk
+        n_items = counts["item"]
+        n_wh = counts["warehouse"]
+        weeks = np.arange(_SALES_MIN, _SALES_MAX, 7, dtype=np.int64)
+        n = n_items * n_wh * len(weeks)
+        item = np.tile(np.arange(1, n_items + 1, dtype=np.int64),
+                       n_wh * len(weeks))
+        wh = np.tile(np.repeat(np.arange(1, n_wh + 1, dtype=np.int64),
+                               n_items), len(weeks))
+        date = np.repeat(weeks, n_items * n_wh)
+        return {
+            "inv_date_sk": date,
+            "inv_item_sk": item,
+            "inv_warehouse_sk": wh,
+            "inv_quantity_on_hand": rng.integers(0, 1000, n).astype(
+                np.int64),
+        }
+
+    if table == "store_sales":
+        n = counts["store_sales"]
+        qty = rng.integers(1, 101, n)
+        (wholesale, list_price, sales_price, ext_discount, ext_sales,
+         ext_wholesale, ext_list, net_paid, net_profit) = \
+            _price_cols(rng, n, qty)
+        tickets = np.arange(1, n + 1, dtype=np.int64) // 4 + 1
+        return {
+            "ss_sold_date_sk": rng.integers(_SALES_MIN, _SALES_MAX + 1,
+                                            n).astype(np.int64),
+            "ss_item_sk": rng.integers(1, counts["item"] + 1, n).astype(
+                np.int64),
+            "ss_customer_sk": rng.integers(1, counts["customer"] + 1,
+                                           n).astype(np.int64),
+            "ss_cdemo_sk": rng.integers(
+                1, counts["customer_demographics"] + 1, n).astype(np.int64),
+            "ss_hdemo_sk": rng.integers(1, 7201, n).astype(np.int64),
+            "ss_addr_sk": rng.integers(1, counts["customer_address"] + 1,
+                                       n).astype(np.int64),
+            "ss_store_sk": rng.integers(1, counts["store"] + 1, n).astype(
+                np.int64),
+            "ss_promo_sk": rng.integers(1, counts["promotion"] + 1,
+                                        n).astype(np.int64),
+            "ss_ticket_number": tickets,
+            "ss_quantity": qty.astype(np.int64),
+            "ss_wholesale_cost": wholesale,
+            "ss_list_price": list_price,
+            "ss_sales_price": sales_price,
+            "ss_ext_discount_amt": ext_discount,
+            "ss_ext_sales_price": ext_sales,
+            "ss_ext_wholesale_cost": ext_wholesale,
+            "ss_ext_list_price": ext_list,
+            "ss_coupon_amt": np.where(rng.random(n) < 0.2,
+                                      ext_discount // 2, 0).astype(np.int64),
+            "ss_net_paid": net_paid,
+            "ss_net_profit": net_profit,
+        }
+
+    if table == "store_returns":
+        # returns reference REAL store_sales rows (ticket+item pairs), so
+        # q64's ss⋈sr join has matches
+        ss = get_table("store_sales", sf)
+        n_ss = len(ss["ss_item_sk"])
+        n = max(1, n_ss // 10)
+        pick = rng.choice(n_ss, size=n, replace=False)
+        ret_amt = (ss["ss_sales_price"][pick] *
+                   rng.integers(1, ss["ss_quantity"][pick] + 1))
+        return {
+            "sr_returned_date_sk": (ss["ss_sold_date_sk"][pick] +
+                                    rng.integers(1, 60, n)).astype(np.int64),
+            "sr_item_sk": ss["ss_item_sk"][pick].astype(np.int64),
+            "sr_customer_sk": ss["ss_customer_sk"][pick].astype(np.int64),
+            "sr_cdemo_sk": ss["ss_cdemo_sk"][pick].astype(np.int64),
+            "sr_hdemo_sk": ss["ss_hdemo_sk"][pick].astype(np.int64),
+            "sr_addr_sk": ss["ss_addr_sk"][pick].astype(np.int64),
+            "sr_store_sk": ss["ss_store_sk"][pick].astype(np.int64),
+            "sr_ticket_number": ss["ss_ticket_number"][pick].astype(
+                np.int64),
+            "sr_return_quantity": rng.integers(1, 50, n).astype(np.int64),
+            "sr_return_amt": ret_amt.astype(np.int64),
+            "sr_net_loss": (ret_amt // 2).astype(np.int64),
+        }
+
+    if table == "catalog_sales":
+        n = counts["catalog_sales"]
+        qty = rng.integers(1, 101, n)
+        (wholesale, list_price, sales_price, ext_discount, ext_sales,
+         ext_wholesale, ext_list, net_paid, net_profit) = \
+            _price_cols(rng, n, qty)
+        sold = rng.integers(_SALES_MIN, _SALES_MAX + 1, n)
+        return {
+            "cs_sold_date_sk": sold.astype(np.int64),
+            "cs_ship_date_sk": (sold + rng.integers(2, 90, n)).astype(
+                np.int64),
+            "cs_bill_customer_sk": rng.integers(
+                1, counts["customer"] + 1, n).astype(np.int64),
+            "cs_bill_cdemo_sk": rng.integers(
+                1, counts["customer_demographics"] + 1, n).astype(np.int64),
+            "cs_bill_hdemo_sk": rng.integers(1, 7201, n).astype(np.int64),
+            "cs_bill_addr_sk": rng.integers(
+                1, counts["customer_address"] + 1, n).astype(np.int64),
+            "cs_warehouse_sk": rng.integers(
+                1, counts["warehouse"] + 1, n).astype(np.int64),
+            "cs_item_sk": rng.integers(1, counts["item"] + 1, n).astype(
+                np.int64),
+            "cs_promo_sk": rng.integers(1, counts["promotion"] + 1,
+                                        n).astype(np.int64),
+            "cs_order_number": (np.arange(1, n + 1, dtype=np.int64) // 3
+                                + 1),
+            "cs_quantity": qty.astype(np.int64),
+            "cs_wholesale_cost": wholesale,
+            "cs_list_price": list_price,
+            "cs_sales_price": sales_price,
+            "cs_ext_discount_amt": ext_discount,
+            "cs_ext_sales_price": ext_sales,
+            "cs_ext_wholesale_cost": ext_wholesale,
+            "cs_ext_list_price": ext_list,
+            "cs_net_paid": net_paid,
+            "cs_net_profit": net_profit,
+        }
+
+    if table == "catalog_returns":
+        cs = get_table("catalog_sales", sf)
+        n_cs = len(cs["cs_item_sk"])
+        n = max(1, n_cs // 10)
+        pick = rng.choice(n_cs, size=n, replace=False)
+        amount = (cs["cs_sales_price"][pick] * rng.integers(1, 20, n))
+        return {
+            "cr_returned_date_sk": (cs["cs_sold_date_sk"][pick] +
+                                    rng.integers(1, 60, n)).astype(np.int64),
+            "cr_item_sk": cs["cs_item_sk"][pick].astype(np.int64),
+            "cr_order_number": cs["cs_order_number"][pick].astype(np.int64),
+            "cr_return_quantity": rng.integers(1, 50, n).astype(np.int64),
+            "cr_return_amount": amount.astype(np.int64),
+            "cr_refunded_cash": (amount // 2).astype(np.int64),
+        }
+
+    raise KeyError(table)
+
+
+_TABLE_CACHE: Dict[tuple, Dict[str, np.ndarray]] = {}
+_DICT_CACHE: Dict[tuple, Dictionary] = {}
+
+
+def get_table(table: str, sf: float) -> Dict[str, np.ndarray]:
+    key = (table, round(sf * 1000))
+    if key not in _TABLE_CACHE:
+        _TABLE_CACHE[key] = _gen_table(table, sf)
+    return _TABLE_CACHE[key]
+
+
+def table_row_count(table: str, sf: float) -> int:
+    counts = _row_counts(sf)
+    if table == "inventory":
+        weeks = len(np.arange(_SALES_MIN, _SALES_MAX, 7))
+        return counts["item"] * counts["warehouse"] * weeks
+    if table == "store_returns":
+        return max(1, counts["store_sales"] // 10)
+    if table == "catalog_returns":
+        return max(1, counts["catalog_sales"] // 10)
+    return counts[table]
+
+
+def table_dictionary(table: str, sf: float, column: str) -> Dictionary:
+    key = (table, round(sf * 1000), column)
+    if key not in _DICT_CACHE:
+        data = get_table(table, sf)[column]
+        _DICT_CACHE[key] = Dictionary.build(data)[0]
+    return _DICT_CACHE[key]
+
+
+class TpcdsMetadata(ConnectorMetadata):
+    """plugin/trino-tpcds TpcdsMetadata.java analog."""
+
+    def list_schemas(self) -> List[str]:
+        return sorted(SCHEMAS)
+
+    def list_tables(self, schema: Optional[str] = None
+                    ) -> List[SchemaTableName]:
+        schemas = [schema] if schema else sorted(SCHEMAS)
+        return [SchemaTableName(s, t) for s in schemas for t in sorted(TABLES)]
+
+    def get_table_handle(self, name: SchemaTableName
+                         ) -> Optional[ConnectorTableHandle]:
+        if name.schema in SCHEMAS and name.table in TABLES:
+            return ConnectorTableHandle(name)
+        return None
+
+    def get_table_metadata(self, handle: ConnectorTableHandle
+                           ) -> TableMetadata:
+        cols = tuple(ColumnMetadata(n, t)
+                     for n, t in TABLES[handle.name.table][0])
+        return TableMetadata(handle.name, cols)
+
+    def get_table_statistics(self, handle: ConnectorTableHandle
+                             ) -> TableStatistics:
+        sf = SCHEMAS[handle.name.schema]
+        rows = float(table_row_count(handle.name.table, sf))
+        cols: Dict[str, ColumnStatistics] = {}
+        for name, typ in TABLES[handle.name.table][0]:
+            ndv = rows if name.endswith("_sk") else min(rows, 1000.0)
+            cols[name] = ColumnStatistics(null_fraction=0.0,
+                                          distinct_count=ndv)
+        return TableStatistics(rows, cols)
+
+    def apply_filter(self, handle, constraint):
+        merged = handle.constraint.intersect(constraint)
+        return (ConnectorTableHandle(handle.name, merged, handle.limit),
+                constraint)
+
+    def apply_limit(self, handle, limit):
+        if handle.limit is not None and handle.limit <= limit:
+            return None
+        return ConnectorTableHandle(handle.name, handle.constraint, limit)
+
+
+class TpcdsSplitManager(ConnectorSplitManager):
+    def get_splits(self, handle: ConnectorTableHandle,
+                   target_splits: int = 1) -> List[Split]:
+        sf = SCHEMAS[handle.name.schema]
+        rows = table_row_count(handle.name.table, sf)
+        parts = max(1, min(target_splits, math.ceil(rows / 4096)))
+        return [Split(handle, p, parts, host=p) for p in range(parts)]
+
+
+class TpcdsPageSource(ConnectorPageSource):
+    def pages(self, split: Split, columns: Sequence[ColumnHandle],
+              page_capacity: int) -> Iterator[Page]:
+        handle = split.table
+        table = handle.name.table
+        sf = SCHEMAS[handle.name.schema]
+        total = table_row_count(table, sf)
+        start, end = split_range(total, split.part, split.total_parts)
+        if handle.limit is not None:
+            end = min(end, start + handle.limit)
+        data = get_table(table, sf)
+        for off in range(start, end, page_capacity):
+            hi = min(off + page_capacity, end)
+            n = hi - off
+            cols = []
+            for ch in columns:
+                raw = data[ch.name][off:hi]
+                if T.is_string(ch.type):
+                    d = table_dictionary(table, sf, ch.name)
+                    codes = pad_to_capacity(d.encode(raw), page_capacity, 0)
+                    cols.append(Column.from_numpy(codes, ch.type,
+                                                  dictionary=d))
+                else:
+                    arr = pad_to_capacity(
+                        np.asarray(raw, T.to_numpy_dtype(ch.type)),
+                        page_capacity, 0)
+                    cols.append(Column.from_numpy(arr, ch.type))
+            yield Page(tuple(cols), n)
+
+
+def create_connector() -> Connector:
+    return Connector("tpcds", TpcdsMetadata(), TpcdsSplitManager(),
+                     TpcdsPageSource())

@@ -222,9 +222,11 @@ class DistributedQueryRunner(LocalQueryRunner):
     @classmethod
     def tpch(cls, schema: str = "tiny",
              devices: Optional[Sequence] = None) -> "DistributedQueryRunner":
-        from trino_tpu.connector import blackhole, memory, tpch as tpch_conn
+        from trino_tpu.connector import (blackhole, memory, tpcds,
+                                         tpch as tpch_conn)
         runner = cls(Session(catalog="tpch", schema=schema), devices)
         runner.catalogs.register("tpch", tpch_conn.create_connector())
+        runner.catalogs.register("tpcds", tpcds.create_connector())
         runner.catalogs.register("memory", memory.create_connector())
         runner.catalogs.register("blackhole", blackhole.create_connector())
         return runner

@@ -12,11 +12,19 @@ beneath each entry. Executing the same query shape twice must not re-trace.
 
 from __future__ import annotations
 
+import collections
 from typing import Any, Callable, Dict, Hashable
 
 import jax
 
-_CACHE: Dict[Hashable, Callable] = {}
+_CACHE: "collections.OrderedDict[Hashable, Callable]" = \
+    collections.OrderedDict()
+# LRU bound: every cached kernel pins a loaded XLA executable (JIT code
+# pages + device buffers); unbounded growth across a long session exhausts
+# executable memory maps. 512 is far above any single query's kernel count,
+# so bench re-runs stay fully warm. Evicted kernels fall back to the
+# on-disk persistent compilation cache (no re-trace cost beyond reload).
+_MAX_KERNELS = 512
 
 
 def cached_kernel(key: Hashable, build: Callable[[], Callable]) -> Callable:
@@ -28,7 +36,11 @@ def cached_kernel(key: Hashable, build: Callable[[], Callable]) -> Callable:
     fn = _CACHE.get(key)
     if fn is None:
         fn = jax.jit(build())
+        while len(_CACHE) >= _MAX_KERNELS:
+            _CACHE.popitem(last=False)
         _CACHE[key] = fn
+    else:
+        _CACHE.move_to_end(key)
     return fn
 
 

@@ -134,6 +134,18 @@ def compose_chain(pending, tail_key=None, tail_builder=None):
     return cached_kernel(key, build)
 
 
+@dataclasses.dataclass
+class NodeStats:
+    """Per-plan-node runtime counters (operator/OperatorStats.java analog:
+    output rows/pages + inclusive wall time; exclusive time is derived at
+    render by subtracting child time)."""
+
+    name: str
+    rows: int = 0
+    pages: int = 0
+    wall_s: float = 0.0
+
+
 class LocalExecutionPlanner:
     """Single-process executor over one device (LocalQueryRunner's engine)."""
 
@@ -141,6 +153,11 @@ class LocalExecutionPlanner:
         self.metadata = metadata
         self.session = session
         self.page_capacity = int(session.get("page_capacity"))
+        # id(plan node) -> NodeStats, populated only under EXPLAIN ANALYZE
+        self.node_stats: Optional[Dict[int, NodeStats]] = None
+        from trino_tpu.exec.memory import QueryMemoryContext
+        self.memory = QueryMemoryContext(
+            int(session.get("query_max_memory")))
 
     # ------------------------------------------------------------ dispatch
 
@@ -149,7 +166,35 @@ class LocalExecutionPlanner:
         method = getattr(self, f"_exec_{name}", None)
         if method is None:
             raise ExecutionError(f"no executor for {name}")
-        return method(node)
+        stream = method(node)
+        if self.node_stats is None:
+            return stream
+        return self._instrument(node, stream)
+
+    def _instrument(self, node: PlanNode, stream: PageStream) -> PageStream:
+        """EXPLAIN ANALYZE wrapper: count rows/pages and inclusive wall time
+        at every node boundary. Forces the pending chain at each node (the
+        per-operator observability the reference pays for with
+        OperationTimer), so fused-chain timings split into their operators;
+        the row-count read syncs the device once per page."""
+        import time as _time
+        st = NodeStats(type(node).__name__)
+        self.node_stats[id(node)] = st
+
+        def gen():
+            it = stream.iter_pages()
+            while True:
+                t0 = _time.perf_counter()
+                try:
+                    page = next(it)
+                except StopIteration:
+                    st.wall_s += _time.perf_counter() - t0
+                    return
+                st.rows += int(page.num_rows)
+                st.wall_s += _time.perf_counter() - t0
+                st.pages += 1
+                yield page
+        return PageStream(gen(), stream.symbols)
 
     # ---------------------------------------------------------------- leaf
 
@@ -275,12 +320,18 @@ class LocalExecutionPlanner:
     # ------------------------------------------------------------ blocking
 
     def _collect(self, stream: PageStream) -> Optional[Page]:
+        """Materialize a stream (blocking-operator input). The result is
+        reserved against query_max_memory: blocking materializations are
+        what consumes HBM (streamed pages flow through one fused kernel).
+        Reservations live for the query (a conservative upper bound — the
+        reference frees per-operator contexts on finish)."""
+        from trino_tpu.exec.memory import page_bytes
         pages = [p for p in stream.iter_pages() if int(p.num_rows) > 0]
         if not pages:
             return None
-        if len(pages) == 1:
-            return pages[0]
-        return concat_pages(pages)
+        page = pages[0] if len(pages) == 1 else concat_pages(pages)
+        self.memory.reserve(page_bytes(page), "collect")
+        return page
 
     def _exec_AggregationNode(self, node: AggregationNode) -> PageStream:
         src = self.execute(node.source)
@@ -675,13 +726,22 @@ class LocalExecutionPlanner:
             def build():
                 op = hash_join(probe_keys, build_keys, jt,
                                output_capacity=cap)
-                if rest_lowered is None:
-                    return lambda p, b: op(p, b)
-                fn = compile_filter(rest_lowered)
+                fn = None if rest_lowered is None \
+                    else compile_filter(rest_lowered)
 
                 def run(p, b):
                     out, total = op(p, b)
-                    return out.filter(fn(out)), total
+                    if fn is not None:
+                        out = out.filter(fn(out))
+                    # surviving rows all share one match value (semi: True,
+                    # anti: False); emit it so pages carry EXACTLY the
+                    # node's declared outputs — downstream operators lower
+                    # expressions against declared layouts
+                    mcol = Column(
+                        jnp.broadcast_to(jnp.asarray(mode == "semi"),
+                                         (out.capacity,)),
+                        None, T.BOOLEAN, None)
+                    return Page(out.columns + (mcol,), out.num_rows), total
                 return run
             return cached_kernel(
                 ("semijoin", tuple(probe_keys), tuple(build_keys), jt,
@@ -695,7 +755,8 @@ class LocalExecutionPlanner:
                 bp = self._null_build_page(semi.filtering_source.outputs)
             yield from _run_with_overflow(
                 probe_stream, bp, semi_op, self.page_capacity)
-        return PageStream(gen(), semi.source.outputs)
+        return PageStream(gen(),
+                          semi.source.outputs + (semi.match_symbol,))
 
     def _exec_SemiJoinNode(self, node: SemiJoinNode) -> PageStream:
         """Bare semi join: emit probe rows + boolean match channel
@@ -830,13 +891,7 @@ class LocalExecutionPlanner:
                       for o in node.order_by)
         specs = []
         for out_sym, wf in node.functions:
-            if wf.start_value is not None or wf.end_value is not None or \
-                    wf.start_type != "UNBOUNDED_PRECEDING":
-                raise ExecutionError(
-                    "bounded window frames (<n> PRECEDING/FOLLOWING) not "
-                    "supported yet")
-            whole = (not node.order_by) or \
-                wf.end_type == "UNBOUNDED_FOLLOWING"
+            whole, bounds = self._lower_frame(node, wf)
             args = []
             for a in wf.args:
                 if not isinstance(a, SymbolRef):
@@ -844,7 +899,7 @@ class LocalExecutionPlanner:
                 args.append(lay[a.name])
             specs.append(WindowSpec(wf.name.lower(), tuple(args),
                                     out_sym.type, whole,
-                                    wf.frame_type == "ROWS"))
+                                    wf.frame_type == "ROWS", bounds))
         win = cached_kernel(
             ("window", part, okeys, tuple(specs)),
             lambda: window(part, okeys, specs))
@@ -855,6 +910,48 @@ class LocalExecutionPlanner:
                 return
             yield win(page)
         return PageStream(gen(), node.outputs)
+
+    @staticmethod
+    def _lower_frame(node: WindowNode, wf):
+        """WindowFunction frame -> (frame_whole, bounds) for WindowSpec.
+
+        Ranking functions ignore frames (SQL). The default/unbounded frames
+        map onto the legacy whole/running paths; literal ROWS offsets become
+        static (start_off, end_off) bounds; value-based RANGE offsets and
+        GROUPS frames fail loud. Reference: FramedWindowFunction.java +
+        sql/planner/plan/WindowNode.Frame."""
+        from trino_tpu.ops.window import RANKING
+
+        def literal_offset(value, kind: str) -> int:
+            if not isinstance(value, Literal) or \
+                    not isinstance(value.value, int):
+                raise ExecutionError(
+                    "window frame offsets must be integer literals")
+            v = int(value.value)
+            if v < 0:
+                raise ExecutionError("window frame offset must be >= 0")
+            return -v if kind == "PRECEDING" else v
+
+        if wf.name.lower() in RANKING:
+            return (not node.order_by), None
+        st, sv = wf.start_type, wf.start_value
+        et, ev = wf.end_type, wf.end_value
+        if st == "UNBOUNDED_PRECEDING" and et == "UNBOUNDED_FOLLOWING":
+            return True, None
+        if not node.order_by:
+            return True, None
+        if st == "UNBOUNDED_PRECEDING" and et == "CURRENT_ROW":
+            return False, None                     # running frame
+        if wf.frame_type == "GROUPS":
+            raise ExecutionError("GROUPS window frames not supported")
+        if wf.frame_type == "RANGE":
+            raise ExecutionError(
+                "RANGE frames with value offsets not supported")
+        start_off = None if st == "UNBOUNDED_PRECEDING" else (
+            0 if st == "CURRENT_ROW" else literal_offset(sv, st))
+        end_off = None if et == "UNBOUNDED_FOLLOWING" else (
+            0 if et == "CURRENT_ROW" else literal_offset(ev, et))
+        return False, (start_off, end_off)
 
     def _exec_OutputNode(self, node: OutputNode) -> PageStream:
         src = self.execute(node.source)

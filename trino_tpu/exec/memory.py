@@ -1,0 +1,69 @@
+"""Query memory accounting.
+
+Reference parity: memory/MemoryPool.java:44 + lib/trino-memory-context
+(AggregatedMemoryContext tree) + ExceededMemoryLimitException — every
+blocking materialization (join build side, aggregation/sort/window collect,
+exchange buffers) reserves its page bytes against the session's
+`query_max_memory` before the device call, and the query fails with the
+reference's "Query exceeded per-node memory limit" error when the
+reservation would overflow.
+
+TPU framing: the pool models HBM, the scarce resource a fused streaming
+pipeline does NOT consume (pages flow through one kernel) but blocking
+operators do. Reservations are tracked per operator tag so the error names
+the offender, and freed when an operator's output is consumed (operator
+scopes call free()).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+class ExceededMemoryLimitError(RuntimeError):
+    """io.trino.ExceededMemoryLimitException analog."""
+
+
+def _fmt_bytes(n: int) -> str:
+    units = ("B", "kB", "MB", "GB", "TB")
+    v = float(n)
+    for u in units:
+        if abs(v) < 1024 or u == units[-1]:
+            return f"{int(v)}{u}" if u == "B" else f"{v:.2f}{u}"
+        v /= 1024
+    return f"{n}B"
+
+
+def page_bytes(page) -> int:
+    """Device bytes of one Page (sum of Column.nbytes)."""
+    return sum(col.nbytes for col in page.columns)
+
+
+class QueryMemoryContext:
+    """Single-query reservation ledger checked against query_max_memory."""
+
+    def __init__(self, limit_bytes: Optional[int]):
+        self.limit = int(limit_bytes) if limit_bytes is not None else None
+        self.reserved = 0
+        self.peak = 0
+        self.by_tag: Dict[str, int] = {}
+
+    def reserve(self, nbytes: int, tag: str = "operator") -> None:
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            return
+        if self.limit is not None and self.reserved + nbytes > self.limit:
+            raise ExceededMemoryLimitError(
+                f"Query exceeded per-node memory limit of "
+                f"{_fmt_bytes(self.limit)} [{tag} requested "
+                f"{_fmt_bytes(nbytes)}, reserved "
+                f"{_fmt_bytes(self.reserved)}]")
+        self.reserved += nbytes
+        self.by_tag[tag] = self.by_tag.get(tag, 0) + nbytes
+        self.peak = max(self.peak, self.reserved)
+
+    def free(self, nbytes: int, tag: str = "operator") -> None:
+        nbytes = int(nbytes)
+        self.reserved = max(0, self.reserved - nbytes)
+        if tag in self.by_tag:
+            self.by_tag[tag] = max(0, self.by_tag[tag] - nbytes)

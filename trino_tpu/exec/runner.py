@@ -80,6 +80,8 @@ class LocalQueryRunner:
         """Runner with tpch/memory/blackhole catalogs (TpchQueryRunner)."""
         runner = cls(Session(catalog="tpch", schema=schema))
         runner.catalogs.register("tpch", tpch.create_connector())
+        from trino_tpu.connector import tpcds
+        runner.catalogs.register("tpcds", tpcds.create_connector())
         runner.catalogs.register("memory", memory.create_connector())
         runner.catalogs.register("blackhole", blackhole.create_connector())
         return runner
@@ -244,6 +246,8 @@ class LocalQueryRunner:
         if not isinstance(stmt.statement, t.Query):
             raise SemanticError("EXPLAIN requires a query")
         plan = self._plan(stmt.statement)
+        if stmt.analyze:
+            return self._explain_analyze(plan)
         if stmt.explain_type == "DISTRIBUTED":
             from trino_tpu.planner.optimizer import add_exchanges, \
                 OptimizerContext, StatsEstimator
@@ -254,6 +258,36 @@ class LocalQueryRunner:
             text = _format_fragments(frag)
         else:
             text = format_plan(plan)
+        return MaterializedResult(["Query Plan"], [T.VARCHAR], [(text,)])
+
+    def _explain_analyze(self, plan: OutputNode) -> MaterializedResult:
+        """EXPLAIN ANALYZE: run the query with per-node instrumentation and
+        render the plan annotated with output rows + wall time
+        (operator/ExplainAnalyzeOperator.java + OperatorStats.java)."""
+        import time
+        executor = LocalExecutionPlanner(self.metadata, self.session)
+        executor.node_stats = {}
+        t0 = time.perf_counter()
+        n_out = 0
+        for page in executor.execute(plan).iter_pages():
+            n_out += int(page.num_rows)
+        total = time.perf_counter() - t0
+        stats = executor.node_stats
+
+        def annotate(node):
+            st = stats.get(id(node))
+            if st is None:
+                return ""
+            child_wall = sum(stats[id(s)].wall_s for s in node.sources
+                             if id(s) in stats)
+            own = max(st.wall_s - child_wall, 0.0)
+            return (f"output: {st.rows} rows ({st.pages} pages), "
+                    f"time: {own * 1000:.2f}ms "
+                    f"({st.wall_s * 1000:.2f}ms cumulative)")
+
+        text = format_plan(plan, annotate=annotate)
+        text += (f"\n\nQuery: {n_out} rows, "
+                 f"wall {total * 1000:.2f}ms (single device)")
         return MaterializedResult(["Query Plan"], [T.VARCHAR], [(text,)])
 
     def _show_tables(self, stmt: t.ShowTables) -> MaterializedResult:

@@ -36,6 +36,9 @@ VALUE = ("lead", "lag", "first_value", "last_value", "nth_value")
 AGGREGATE = ("sum", "avg", "min", "max", "count")
 
 
+from typing import Optional
+
+
 @dataclasses.dataclass(frozen=True)
 class WindowSpec:
     name: str
@@ -43,6 +46,10 @@ class WindowSpec:
     out_type: T.Type
     frame_whole: bool    # UNBOUNDED..UNBOUNDED (or no ORDER BY)
     frame_rows: bool     # ROWS vs RANGE for the running frame
+    # bounded ROWS frame: (start_off, end_off) row offsets relative to the
+    # current row (negative = PRECEDING); None inside the tuple = unbounded
+    # on that side. None overall = use frame_whole/frame_rows.
+    bounds: Optional[Tuple[Optional[int], Optional[int]]] = None
 
 
 def window(partition_channels: Sequence[int],
@@ -187,21 +194,34 @@ def _eval(spec: WindowSpec, page: Page, live, idx, seg_b, seg_id, seg_start,
 
     if name in ("first_value", "last_value", "nth_value"):
         x = arg(0)
-        if name == "first_value":
-            tgt = seg_start
-        elif name == "last_value":
-            if spec.frame_whole:
-                tgt = seg_start + seg_len - 1
-            elif spec.frame_rows:
-                tgt = idx                       # frame ends at current row
+        if spec.bounds is not None:
+            lo, hi, nonempty = _bounded_range(spec, idx, seg_start, seg_len,
+                                              live)
+            if name == "first_value":
+                tgt = lo
+            elif name == "last_value":
+                tgt = hi
             else:
-                tgt = seg_start + peer_end0 - 1  # peer-inclusive RANGE
+                nth = arg(1).values.astype(jnp.int64)
+                tgt = lo + nth - 1
+                nonempty = nonempty & (tgt <= hi)
+            in_frame = nonempty
         else:
-            nth = arg(1).values.astype(jnp.int64)
-            tgt = seg_start + nth - 1
-        frame_end = seg_start + seg_len if spec.frame_whole else (
-            idx + 1 if spec.frame_rows else seg_start + peer_end0)
-        in_frame = (tgt >= seg_start) & (tgt < frame_end)
+            if name == "first_value":
+                tgt = seg_start
+            elif name == "last_value":
+                if spec.frame_whole:
+                    tgt = seg_start + seg_len - 1
+                elif spec.frame_rows:
+                    tgt = idx                   # frame ends at current row
+                else:
+                    tgt = seg_start + peer_end0 - 1  # peer-incl. RANGE
+            else:
+                nth = arg(1).values.astype(jnp.int64)
+                tgt = seg_start + nth - 1
+            frame_end = seg_start + seg_len if spec.frame_whole else (
+                idx + 1 if spec.frame_rows else seg_start + peer_end0)
+            in_frame = (tgt >= seg_start) & (tgt < frame_end)
         tgt_c = jnp.clip(tgt, 0, n - 1)
         vals = jnp.take(x.values, tgt_c)
         valid = in_frame
@@ -211,8 +231,18 @@ def _eval(spec: WindowSpec, page: Page, live, idx, seg_b, seg_id, seg_start,
 
     if name in AGGREGATE:
         return _eval_aggregate(spec, page, live, idx, seg_b, seg_id,
-                               seg_start, peer_start, peer_end0)
+                               seg_start, seg_len, peer_start, peer_end0)
     raise NotImplementedError(f"window function {name}")
+
+
+def _bounded_range(spec, idx, seg_start, seg_len, live):
+    """[lo, hi] absolute row positions of a bounded ROWS frame, clipped to
+    the partition (FramedWindowFunction's frame computation, vectorized)."""
+    bs, be = spec.bounds
+    seg_end = seg_start + seg_len - 1
+    lo = seg_start if bs is None else jnp.maximum(idx + bs, seg_start)
+    hi = seg_end if be is None else jnp.minimum(idx + be, seg_end)
+    return lo, hi, (hi >= lo) & live
 
 
 def _segmented_scan(values: jnp.ndarray, boundaries: jnp.ndarray, combine):
@@ -226,8 +256,18 @@ def _segmented_scan(values: jnp.ndarray, boundaries: jnp.ndarray, combine):
     return out
 
 
+def _bounded_counts(cnt_contrib, seg_b, seg_start, lo, hi, nonempty, n):
+    """Frame row count via prefix-sum difference (shared by every bounded
+    aggregate's validity bit)."""
+    prefc = _segmented_scan(cnt_contrib, seg_b, jnp.add)
+    c_hi = jnp.take(prefc, jnp.clip(hi, 0, n - 1))
+    c_lo = jnp.where(lo > seg_start,
+                     jnp.take(prefc, jnp.clip(lo - 1, 0, n - 1)), 0)
+    return jnp.where(nonempty, c_hi - c_lo, 0)
+
+
 def _eval_aggregate(spec, page, live, idx, seg_b, seg_id, seg_start,
-                    peer_start, peer_end0) -> Column:
+                    seg_len, peer_start, peer_end0) -> Column:
     name = spec.name
     n = page.capacity
     counting = name == "count"
@@ -245,7 +285,18 @@ def _eval_aggregate(spec, page, live, idx, seg_b, seg_id, seg_start,
             else jnp.int64
         contrib = jnp.where(xvalid, xv, 0).astype(acc_dtype)
         cnt_contrib = jnp.where(xvalid, 1, 0).astype(jnp.int64)
-        if spec.frame_whole:
+        if spec.bounds is not None:
+            # bounded ROWS frame: prefix-sum difference pref[hi]-pref[lo-1]
+            lo, hi, nonempty = _bounded_range(spec, idx, seg_start, seg_len,
+                                              live)
+            pref = _segmented_scan(contrib, seg_b, jnp.add)
+            s_hi = jnp.take(pref, jnp.clip(hi, 0, n - 1))
+            s_lo = jnp.where(lo > seg_start,
+                             jnp.take(pref, jnp.clip(lo - 1, 0, n - 1)), 0)
+            sums = jnp.where(nonempty, s_hi - s_lo, 0)
+            cnts = _bounded_counts(cnt_contrib, seg_b, seg_start, lo, hi,
+                                   nonempty, n)
+        elif spec.frame_whole:
             sums = jnp.zeros(n, dtype=acc_dtype).at[seg_id].add(
                 contrib)[seg_id]
             cnts = jnp.zeros(n, dtype=jnp.int64).at[seg_id].add(
@@ -287,7 +338,11 @@ def _eval_aggregate(spec, page, live, idx, seg_b, seg_id, seg_start,
     contrib = jnp.where(xvalid, xv, neutral)
     combine = jnp.minimum if name == "min" else jnp.maximum
     cnt_contrib = jnp.where(xvalid, 1, 0).astype(jnp.int64)
-    if spec.frame_whole:
+    if spec.bounds is not None:
+        res, cnts = _bounded_minmax(spec, contrib, cnt_contrib, combine,
+                                    neutral, idx, seg_b, seg_id, seg_start,
+                                    seg_len, live, n)
+    elif spec.frame_whole:
         init = jnp.full(n, neutral)
         res = (init.at[seg_id].min(contrib) if name == "min"
                else init.at[seg_id].max(contrib))[seg_id]
@@ -305,3 +360,57 @@ def _eval_aggregate(spec, page, live, idx, seg_b, seg_id, seg_start,
     dictionary = page.column(spec.arg_channels[0]).dictionary \
         if spec.arg_channels else None
     return Column(res, cnts > 0, spec.out_type, dictionary)
+
+
+def _bounded_minmax(spec, contrib, cnt_contrib, combine, neutral, idx,
+                    seg_b, seg_id, seg_start, seg_len, live, n):
+    """min/max over a bounded ROWS frame.
+
+    Prefix differences don't invert min/max, so:
+      - unbounded-start frames read the running segmented scan at hi;
+      - unbounded-end frames read a reversed running scan at lo;
+      - two-sided frames use segmented power-of-two doubling (sparse-table
+        style): level k holds min over [i, i+2^k) ∩ segment, and any window
+        of length ≤ 2^(k+1) is covered by two overlapping level-k reads.
+        Levels are static (frame offsets are literals), so the whole thing
+        stays one fused XLA program.
+    """
+    bs, be = spec.bounds
+    lo, hi, nonempty = _bounded_range(spec, idx, seg_start, seg_len, live)
+    lo_c = jnp.clip(lo, 0, n - 1)
+    hi_c = jnp.clip(hi, 0, n - 1)
+    if bs is None:
+        run = _segmented_scan(contrib, seg_b, combine)
+        res = jnp.take(run, hi_c)
+    elif be is None:
+        # suffix scan: reverse, with boundaries at original segment ENDS
+        end_flags = jnp.roll(seg_b, -1).at[-1].set(True)
+        run_r = _segmented_scan(jnp.flip(contrib, 0), jnp.flip(end_flags, 0),
+                                combine)
+        res = jnp.take(jnp.flip(run_r, 0), lo_c)
+    else:
+        window_len = be - bs + 1
+        k_max = max(window_len.bit_length() - 1, 0)
+        levels = [contrib]
+        step = 1
+        for _ in range(k_max):
+            prev = levels[-1]
+            ahead = jnp.clip(idx + step, 0, n - 1).astype(jnp.int32)
+            same = ((idx + step) < n) & \
+                (jnp.take(seg_id, ahead) == seg_id)
+            levels.append(combine(prev, jnp.where(
+                same, jnp.take(prev, ahead), neutral)))
+            step *= 2
+        flat = jnp.stack(levels).reshape(-1)
+        length = jnp.maximum(hi - lo + 1, 1)
+        k = jnp.zeros(n, dtype=jnp.int64)
+        for j in range(1, k_max + 1):
+            k = k + (length >= (1 << j)).astype(jnp.int64)
+        shift = jnp.left_shift(jnp.int64(1), k)
+        p2 = jnp.clip(hi - shift + 1, 0, n - 1)
+        res = combine(jnp.take(flat, k * n + lo_c),
+                      jnp.take(flat, k * n + p2))
+    res = jnp.where(nonempty, res, neutral)
+    cnts = _bounded_counts(cnt_contrib, seg_b, seg_start, lo, hi, nonempty,
+                           n)
+    return res, cnts

@@ -161,6 +161,15 @@ class Column:
     def with_valid(self, valid: Optional[jnp.ndarray]) -> "Column":
         return Column(self.values, valid, self.type, self.dictionary)
 
+    @property
+    def nbytes(self) -> int:
+        """Device bytes (values + validity) — the unit of memory accounting
+        shared by the HBM pool (exec/memory.py) and scan caches."""
+        n = int(getattr(self.values, "nbytes", 0) or 0)
+        if self.valid is not None:
+            n += int(getattr(self.valid, "nbytes", 0) or 0)
+        return n
+
     @classmethod
     def from_numpy(cls, data: np.ndarray, typ: T.Type,
                    valid: Optional[np.ndarray] = None,

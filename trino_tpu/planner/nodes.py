@@ -578,8 +578,12 @@ def visit_plan(node: PlanNode):
         yield from visit_plan(s)
 
 
-def format_plan(node: PlanNode, indent: int = 0) -> str:
-    """Plan printer (sql/planner/planprinter/PlanPrinter.java, text mode)."""
+def format_plan(node: PlanNode, indent: int = 0, annotate=None) -> str:
+    """Plan printer (sql/planner/planprinter/PlanPrinter.java, text mode).
+
+    `annotate(node) -> str` appends per-node runtime stats lines — the
+    EXPLAIN ANALYZE rendering (PlanPrinter.textDistributedPlan with
+    operator stats)."""
     pad = "   " * indent
     detail = ""
     if isinstance(node, TableScanNode):
@@ -620,6 +624,10 @@ def format_plan(node: PlanNode, indent: int = 0) -> str:
     elif isinstance(node, GroupIdNode):
         detail = f"[{len(node.grouping_sets)} sets]"
     lines = [f"{pad}- {node.node_name()}{detail}"]
+    if annotate is not None:
+        extra = annotate(node)
+        if extra:
+            lines.append(f"{pad}     {extra}")
     for s in node.sources:
-        lines.append(format_plan(s, indent + 1))
+        lines.append(format_plan(s, indent + 1, annotate))
     return "\n".join(lines)

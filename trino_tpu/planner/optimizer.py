@@ -57,6 +57,54 @@ def combine(parts: Sequence[RowExpression]) -> Optional[RowExpression]:
     return out
 
 
+def disjuncts(e: Optional[RowExpression]) -> List[RowExpression]:
+    if e is None:
+        return []
+    if isinstance(e, SpecialForm) and e.kind is SpecialKind.OR:
+        out = []
+        for a in e.args:
+            out.extend(disjuncts(a))
+        return out
+    return [e]
+
+
+def combine_or(parts: Sequence[RowExpression]) -> RowExpression:
+    out = parts[0]
+    for p in parts[1:]:
+        out = SpecialForm(SpecialKind.OR, (out, p), T.BOOLEAN)
+    return out
+
+
+def extract_common_predicates(e: RowExpression) -> RowExpression:
+    """(A ∧ B) ∨ (A ∧ C)  ->  A ∧ (B ∨ C), recursively
+    (sql/planner/iterative/rule/ExtractCommonPredicatesExpressionRewriter).
+
+    Kleene 3VL distributivity makes the rewrite exact. Load-bearing for
+    q19-style filters: the factored-out equality conjunct becomes a join
+    clause instead of a post-cross-join residual."""
+    if not isinstance(e, SpecialForm):
+        return e
+    if e.kind is SpecialKind.AND:
+        parts = [extract_common_predicates(c) for c in conjuncts(e)]
+        return combine(parts)
+    if e.kind is SpecialKind.OR:
+        branches = [conjuncts(extract_common_predicates(d))
+                    for d in disjuncts(e)]
+        common = [c for c in branches[0]
+                  if all(c in b for b in branches[1:])]
+        if not common:
+            return combine_or([combine(b) for b in branches])
+        residuals = []
+        for b in branches:
+            rem = [c for c in b if c not in common]
+            if not rem:
+                # x ∨ (x ∧ y) = x: this branch absorbs the whole OR
+                return combine(common)
+            residuals.append(combine(rem))
+        return combine(common + [combine_or(residuals)])
+    return e
+
+
 def symbols_in(e: RowExpression) -> Set[str]:
     out: Set[str] = set()
 
@@ -238,6 +286,17 @@ class StatsEstimator:
 
 # ---------------------------------------------------------------------------
 # rules
+
+
+class ExtractCommonPredicates(Rule):
+    def apply(self, node: PlanNode, ctx: "OptimizerContext"
+              ) -> Optional[PlanNode]:
+        if not isinstance(node, FilterNode):
+            return None
+        new = extract_common_predicates(node.predicate)
+        if new == node.predicate:
+            return None
+        return FilterNode(node.source, new)
 
 
 class MergeFilters(Rule):
@@ -1106,6 +1165,7 @@ def optimize(root: OutputNode, metadata: Metadata, session: Session,
     ctx = OptimizerContext(metadata, session, StatsEstimator(metadata))
     rules = [
         MergeFilters(),
+        ExtractCommonPredicates(),
         MergeAdjacentProjects(),
         RemoveIdentityProjections(),
         PredicatePushDown(),

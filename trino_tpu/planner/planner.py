@@ -430,12 +430,20 @@ class LogicalPlanner:
         if win_calls:
             builder.plan_windows(win_calls)
 
-        # SELECT projection (+ extra sort keys), DISTINCT, ORDER BY, LIMIT
-        out_fields = builder.plan_select(select_items)
+        # SELECT projection (+ extra sort keys), DISTINCT, ORDER BY, LIMIT.
+        # ORDER BY may reference source columns that are not selected
+        # (QueryPlanner's ORDER BY scope): carry them through the projection
+        # and prune after the sort.
+        order_keep: Tuple[Symbol, ...] = ()
+        pre_fields = builder.scope().fields
+        if spec.order_by and not spec.select.distinct:
+            order_keep = builder.sort_key_source_symbols(spec.order_by)
+        out_fields = builder.plan_select(select_items, keep=order_keep)
         if spec.select.distinct:
             builder.plan_distinct(out_fields)
         if spec.order_by:
-            builder.plan_order_by(spec.order_by, out_fields)
+            builder.plan_order_by(spec.order_by, out_fields,
+                                  pre_fields if order_keep else None)
         if spec.offset is not None:
             builder.plan_offset(_literal_count(spec.offset, "OFFSET"))
         if spec.limit is not None:
@@ -767,14 +775,18 @@ class _PlanBuilder:
             out_type = _window_type(name, args)
             out_sym = planner.symbols.new(name, out_type)
             frame = w.frame
+            sv = (tr.translate(frame.start_value)
+                  if frame and frame.start_value is not None else None)
+            ev = (tr.translate(frame.end_value)
+                  if frame and frame.end_value is not None else None)
             wf = WindowFunction(
                 name, arg_syms,
                 frame.frame_type if frame else "RANGE",
                 frame.start_type if frame else "UNBOUNDED_PRECEDING",
-                None,
+                sv,
                 (frame.end_type if frame and frame.end_type
                  else "CURRENT_ROW"),
-                None)
+                ev)
             self.node = WindowNode(self.node, part_syms, orderings,
                                    ((out_sym, wf),))
             self.substitutions[tr.aggregate_key(fc)] = out_sym
@@ -817,25 +829,49 @@ class _PlanBuilder:
 
     # ------------------------------------------------------------ ORDER BY
 
+    def sort_key_source_symbols(self, sort_items) -> Tuple[Symbol, ...]:
+        """Source symbols the ORDER BY needs that the SELECT list may not
+        project — passed as `keep` through plan_select so sorting on
+        unselected columns works (QueryPlanner ORDER BY scope)."""
+        available = {s.name: s for s in self.node.outputs}
+        keep: List[Symbol] = []
+        tr = self.translator()
+        for item in sort_items:
+            if isinstance(item.key, t.LongLiteral):
+                continue
+            try:
+                rx = tr.translate(item.key)
+            except SemanticError:
+                continue   # resolves only against output aliases
+            for name in sorted(_symbols_in(rx)):
+                sym = available.get(name)
+                if sym is not None:
+                    keep.append(sym)
+        return tuple(dict.fromkeys(keep))
+
     def plan_order_by(self, sort_items: Tuple[t.SortItem, ...],
-                      out_fields: List[Field]):
+                      out_fields: List[Field],
+                      pre_fields: Optional[List[Field]] = None):
         orderings: List[Ordering] = []
         extra: List[Tuple[Symbol, RowExpression]] = []
         # order-by scope: output aliases win, then the pre-projection scope
         for item in sort_items:
-            sym = self._resolve_sort_key(item.key, out_fields, extra)
+            sym = self._resolve_sort_key(item.key, out_fields, extra,
+                                         pre_fields)
             nulls_first = item.nulls_first
             if nulls_first is None:
                 nulls_first = not item.ascending  # Trino default
             orderings.append(Ordering(sym, item.ascending, nulls_first))
         if extra:
-            assigns = [(f.symbol, f.symbol.ref()) for f in out_fields]
-            assigns += [(s, e) for s, e in extra]
-            self.node = ProjectNode(self.node, tuple(assigns))
+            assigns = [(s.name, (s, s.ref()))
+                       for s in self.node.outputs]
+            assigns += [(s.name, (s, e)) for s, e in extra]
+            self.node = ProjectNode(self.node,
+                                    tuple(dict(assigns).values()))
         self.node = SortNode(self.node, tuple(orderings))
 
     def _resolve_sort_key(self, key: t.Expression, out_fields: List[Field],
-                          extra) -> Symbol:
+                          extra, pre_fields=None) -> Symbol:
         if isinstance(key, t.LongLiteral):
             idx = key.value - 1
             if not 0 <= idx < len(out_fields):
@@ -849,11 +885,12 @@ class _PlanBuilder:
             if len(matches) > 1:
                 raise SemanticError(f"ORDER BY '{key.value}' is ambiguous")
         # fall back: translate against the select-output scope (+ aggregate
-        # substitutions). Sorting on source columns that were not selected is
-        # deliberately unsupported this round — the select projection already
-        # pruned them; the resolve below then reports the missing column.
+        # substitutions). Output aliases win; the pre-projection scope
+        # resolves source columns the SELECT list didn't project (their
+        # symbols were carried through via plan_select's `keep`).
+        parent = Scope(pre_fields, None) if pre_fields else None
         tr = ExpressionTranslator(
-            Scope(out_fields, None),
+            Scope(out_fields, parent),
             self.substitutions, session=self.planner.session)
         rx = tr.translate(key)
         available = {s.name for s in self.node.outputs}
