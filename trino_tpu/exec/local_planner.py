@@ -34,7 +34,7 @@ from trino_tpu.expr.ir import (Call, InputRef, Literal, RowExpression,
                                SpecialForm, SpecialKind, SymbolRef)
 from trino_tpu.metadata import Metadata, Session
 from trino_tpu.ops import (AggSpec, JoinType, SortKey, Step, hash_aggregate,
-                           hash_join, order_by, top_n)
+                           hash_join, order_by, prepare_build, top_n)
 from trino_tpu.page import Column, Page, concat_pages
 from trino_tpu.planner.nodes import (
     AggregationNode, AggStep, DistinctLimitNode, EnforceSingleRowNode,
@@ -330,6 +330,13 @@ class LocalExecutionPlanner:
         if not pages:
             return None
         page = pages[0] if len(pages) == 1 else concat_pages(pages)
+        # shrink heavily padded intermediates (e.g. a filtered scan page at
+        # table capacity): downstream blocking work — build-side sorts,
+        # aggregation/window sorts — costs O(capacity log capacity), so a
+        # 64M-capacity page carrying 3M live rows would pay 20x
+        tight = _next_pow2(max(int(page.num_rows), 1))
+        if page.capacity > 2 * tight:
+            page = page.shrink_to(tight)
         self.memory.reserve(page_bytes(page), "collect")
         return page
 
@@ -540,7 +547,7 @@ class LocalExecutionPlanner:
         def join_op(cap: int):
             def build():
                 op = hash_join(probe_keys, build_keys, join_kind,
-                               output_capacity=cap)
+                               output_capacity=cap, prepared=True)
                 if post_pred is None:
                     return lambda p, b: op(p, b)
                 post_filter = compile_filter(post_pred)
@@ -560,9 +567,17 @@ class LocalExecutionPlanner:
                     return
                 # LEFT join with empty build: emit null-extended probe rows
                 build_page = self._null_build_page(node.right.outputs)
+            prepared = self._prepare_build(build_keys, build_page)
             yield from _run_with_overflow(
-                probe_stream, build_page, join_op, self.page_capacity)
+                probe_stream, prepared, join_op, self.page_capacity)
         return PageStream(gen(), out_symbols)
+
+    def _prepare_build(self, build_keys, build_page):
+        """Sort the build side ONCE per join (LookupSourceFactory analog) —
+        probe-page kernels consume the prepared tuple without re-sorting."""
+        prep = cached_kernel(("join-prep", tuple(build_keys)),
+                             lambda: prepare_build(build_keys))
+        return prep(build_page)
 
     def _exec_right_join(self, node: JoinNode) -> PageStream:
         flipped = JoinNode(
@@ -595,7 +610,7 @@ class LocalExecutionPlanner:
             return cached_kernel(
                 ("fulljoin", tuple(probe_keys), tuple(build_keys), cap),
                 lambda: hash_join(probe_keys, build_keys, JoinType.FULL,
-                                  output_capacity=cap))
+                                  output_capacity=cap, prepared=True))
 
         def gen():
             import itertools
@@ -603,6 +618,7 @@ class LocalExecutionPlanner:
             bp = build_page
             if bp is None:
                 bp = self._null_build_page(node.right.outputs)
+            prepared = self._prepare_build(build_keys, bp)
             matched = jnp.zeros(bp.capacity, dtype=jnp.bool_)
             it = probe_stream.iter_pages()
             while True:
@@ -617,14 +633,14 @@ class LocalExecutionPlanner:
                     probe_meta = tuple(
                         (c.type, c.dictionary) for c in page.columns)
                     cap = max(self.page_capacity, page.capacity)
-                    results.append((cap, full_op(cap)(page, bp)))
+                    results.append((cap, full_op(cap)(page, prepared)))
                 totals = jax.device_get([t for _, (_, t, _) in results])
                 for page, (cap, (out, _, bm)), total in zip(
                         batch, results, totals):
                     total = int(total)
                     while total > cap:
                         cap = _next_pow2(total)
-                        out, t, bm = full_op(cap)(page, bp)
+                        out, t, bm = full_op(cap)(page, prepared)
                         total = int(t)
                     matched = matched | bm
                     yield out
@@ -725,7 +741,7 @@ class LocalExecutionPlanner:
         def semi_op(cap: int):
             def build():
                 op = hash_join(probe_keys, build_keys, jt,
-                               output_capacity=cap)
+                               output_capacity=cap, prepared=True)
                 fn = None if rest_lowered is None \
                     else compile_filter(rest_lowered)
 
@@ -753,8 +769,9 @@ class LocalExecutionPlanner:
                 if jt == JoinType.SEMI:
                     return
                 bp = self._null_build_page(semi.filtering_source.outputs)
+            prepared = self._prepare_build(build_keys, bp)
             yield from _run_with_overflow(
-                probe_stream, bp, semi_op, self.page_capacity)
+                probe_stream, prepared, semi_op, self.page_capacity)
         return PageStream(gen(),
                           semi.source.outputs + (semi.match_symbol,))
 
@@ -775,7 +792,7 @@ class LocalExecutionPlanner:
             return cached_kernel(
                 ("markjoin", tuple(probe_keys), tuple(build_keys), cap),
                 lambda: hash_join(probe_keys, build_keys, JoinType.MARK,
-                                  output_capacity=cap))
+                                  output_capacity=cap, prepared=True))
 
         def no_match(page: Page) -> Page:
             mark = Column(jnp.zeros(page.capacity, dtype=jnp.bool_), None,
@@ -788,8 +805,9 @@ class LocalExecutionPlanner:
                 for page in probe_stream.iter_pages():
                     yield no_match(page)
                 return
+            prepared = self._prepare_build(build_keys, bp)
             yield from _run_with_overflow(
-                probe_stream, bp, mark_op, self.page_capacity)
+                probe_stream, prepared, mark_op, self.page_capacity)
         return PageStream(gen(), out_symbols)
 
     def _exec_AssignUniqueIdNode(self, node) -> PageStream:
@@ -1024,6 +1042,11 @@ def _run_with_overflow(probe_stream: PageStream, build_page: Page,
                 cap = _next_pow2(total)
                 out, t = make_op(cap)(page, build_page)
                 total = int(t)
+            # join outputs inherit probe capacity; shrink heavily padded
+            # ones so downstream sorts run at live size
+            tight = _next_pow2(max(total, 1))
+            if cap > 2 * tight:
+                out = out.shrink_to(tight)
             yield out
 
 

@@ -99,15 +99,60 @@ def _mark_page(probe: Page, matched: jnp.ndarray, pnull: jnp.ndarray,
     return Page(tuple(probe.columns) + (mark,), probe.num_rows)
 
 
+def prepare_build(build_keys: Sequence[int]):
+    """Build-phase kernel: sort the build side ONCE into a LookupSource-like
+    pytree consumed by every probe-page call (reference:
+    operator/join/LookupSourceFactory — the build runs once per join, not
+    once per probe page). Returns prep(build_page) -> prepared tuple."""
+    build_keys = tuple(build_keys)
+
+    def prep(build: Page):
+        bkey, bnull = _key_u64(build, build_keys)
+        # dead/null build rows: mask their key to u64::MAX and sort by
+        # (key, dead) — keeps the key array globally sorted for
+        # searchsorted while live rows occupy the prefix [0, n_live)
+        b_dead = ~build.row_mask() | bnull
+        u64max = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+        bkey_masked = jnp.where(b_dead, u64max, bkey)
+        sort_ops = jax.lax.sort(
+            [bkey_masked, b_dead,
+             jnp.arange(build.capacity, dtype=jnp.int32)],
+            num_keys=2)
+        bkey_s, b_dead_s, bperm = sort_ops
+        n_live_build = jnp.sum(~b_dead_s).astype(jnp.int32)
+        live_b = build.row_mask()
+        n_build_rows = jnp.sum(live_b).astype(jnp.int32)
+        build_has_null = jnp.any(bnull & live_b)
+        # per-position run length of equal keys: lets the probe derive its
+        # upper bound from the lower bound (hi = lo + run_len[lo]) with no
+        # second searchsorted — each probe-side searchsorted costs a full
+        # sort-engine pass at scale
+        n = build.capacity
+        idx = jnp.arange(n, dtype=jnp.int32)
+        boundary = (bkey_s != jnp.roll(bkey_s, 1)).at[0].set(True)
+        run_start = jax.lax.cummax(jnp.where(boundary, idx, 0))
+        nxt = jnp.where(boundary, idx, n)
+        suffix_min = jnp.flip(jax.lax.cummin(jnp.flip(nxt)))
+        next_start = jnp.concatenate(
+            [suffix_min[1:], jnp.full((1,), n, dtype=suffix_min.dtype)])
+        run_len = (next_start - run_start).astype(jnp.int32)
+        return (build, bkey_s, bperm, n_live_build, n_build_rows,
+                build_has_null, run_len)
+    return prep
+
+
 def hash_join(
     probe_keys: Sequence[int],
     build_keys: Sequence[int],
     join_type: str = JoinType.INNER,
     output_capacity: Optional[int] = None,
     verify_composite: bool = True,
+    prepared: bool = False,
 ) -> Callable[[Page, Page], Tuple[Page, jnp.ndarray]]:
-    """Build op(probe_page, build_page) -> (output_page, true_total_rows).
+    """Build op(probe_page, build) -> (output_page, true_total_rows).
 
+    `build` is a build Page, or (with prepared=True) the tuple produced by
+    prepare_build — the executor sorts the build once and probes many pages.
     Output layout: probe columns ++ build columns (semi/anti: probe only).
     output_capacity: static result capacity; defaults to probe capacity.
     true_total_rows may exceed num_rows when the capacity was too small —
@@ -117,7 +162,13 @@ def hash_join(
     build_keys = tuple(build_keys)
     composite = len(probe_keys) > 1
 
-    def op(probe: Page, build: Page) -> Tuple[Page, jnp.ndarray]:
+    def op(probe: Page, build) -> Tuple[Page, jnp.ndarray]:
+        if prepared:
+            (build, bkey_s, bperm, n_live_build, n_build_rows,
+             build_has_null, run_len) = build
+        else:
+            (build, bkey_s, bperm, n_live_build, n_build_rows,
+             build_has_null, run_len) = prepare_build(build_keys)(build)
         n_build = build.capacity
         n_probe = probe.capacity
         n_probe_cols = probe.num_columns
@@ -130,28 +181,19 @@ def hash_join(
                     "string join keys across distinct dictionaries; "
                     "re-encode to a shared dictionary first")
 
-        bkey, bnull = _key_u64(build, build_keys)
         pkey, pnull = _key_u64(probe, probe_keys)
-        # dead/null build rows: mask their key to u64::MAX and sort by
-        # (key, dead) — keeps the key array globally sorted for searchsorted
-        # while guaranteeing live rows occupy the prefix [0, n_live) (live
-        # rows win ties at MAX via the secondary dead flag)
-        b_dead = ~build.row_mask() | bnull
-        u64max = jnp.uint64(0xFFFFFFFFFFFFFFFF)
-        bkey_masked = jnp.where(b_dead, u64max, bkey)
-        sort_ops = jax.lax.sort(
-            [bkey_masked, b_dead, jnp.arange(n_build, dtype=jnp.int32)],
-            num_keys=2)
-        bkey_s, b_dead_s, bperm = sort_ops
-        n_live_build = jnp.sum(~b_dead_s).astype(jnp.int32)
-        live_b = build.row_mask()
-        n_build_rows = jnp.sum(live_b).astype(jnp.int32)
-        build_has_null = jnp.any(bnull & live_b)
 
         p_dead = ~probe.row_mask() | pnull
-        # searchsorted over the live prefix: clamp indices into [0, n_live]
-        lo = jnp.searchsorted(bkey_s, pkey, side="left")
-        hi = jnp.searchsorted(bkey_s, pkey, side="right")
+        # ONE searchsorted over the live prefix (method="sort" routes the
+        # lookup through the TPU sort engine — ~20x faster at millions of
+        # keys than the default per-level binary-search gathers); the upper
+        # bound comes from the build side's precomputed run lengths
+        n_build_m1 = jnp.maximum(n_build - 1, 0)
+        lo = jnp.searchsorted(bkey_s, pkey, side="left", method="sort")
+        lo_c = jnp.minimum(lo, n_build_m1)
+        found = (jnp.take(bkey_s, lo_c, mode="clip") == pkey) & \
+            (lo < n_live_build)
+        hi = lo + jnp.where(found, jnp.take(run_len, lo_c, mode="clip"), 0)
         lo = jnp.minimum(lo, n_live_build)
         hi = jnp.minimum(hi, n_live_build)
         counts = jnp.where(p_dead, 0, hi - lo).astype(jnp.int64)
@@ -181,7 +223,8 @@ def hash_join(
 
         out_idx = jnp.arange(cap, dtype=jnp.int64)
         # which probe row produced output slot j: last start <= j
-        prow = jnp.searchsorted(offsets, out_idx, side="right").astype(jnp.int32)
+        prow = jnp.searchsorted(offsets, out_idx, side="right",
+                                method="sort").astype(jnp.int32)
         prow_c = jnp.minimum(prow, n_probe - 1)
         j_within = out_idx - jnp.take(starts, prow_c, mode="clip")
         brow_sorted = jnp.take(lo, prow_c, mode="clip") + j_within
