@@ -67,9 +67,13 @@ def normalize(rows: List[Tuple], sort: bool = False) -> List[Tuple]:
         canon = []
         for v in row:
             if isinstance(v, decimal.Decimal):
-                canon.append(("dec", int(v.scaleb(
-                    -v.as_tuple().exponent)) if v.as_tuple().exponent < 0
-                    else int(v)))
+                exp = v.as_tuple().exponent
+                if exp < 0:
+                    # carry the scale: comparing against a float oracle
+                    # needs to know the engine's decimal rounding grid
+                    canon.append(("dec", int(v.scaleb(-exp)), -exp))
+                else:
+                    canon.append(("dec", int(v), 0))
             elif isinstance(v, float):
                 if math.isnan(v):
                     canon.append(("f", "nan"))
@@ -116,9 +120,17 @@ def _row_eq(a: Tuple, b: Tuple) -> bool:
             elif abs(xa - ya) / max(abs(xa), abs(ya)) > 1e-9:
                 return False
         elif isinstance(x, tuple) and x and x[0] == "dec":
-            yv = y[1] if isinstance(y, tuple) else y
-            if int(x[1]) != int(yv):
-                return False
+            if isinstance(y, tuple) and y and y[0] == "f":
+                # engine decimal vs float oracle (e.g. decimal division —
+                # Trino types q8's mkt_share decimal(38,4)): equal when the
+                # float rounds onto the decimal's grid
+                scale = x[2] if len(x) > 2 else 0
+                if abs(x[1] / (10 ** scale) - y[1]) > 0.5 * 10 ** -scale:
+                    return False
+            else:
+                yv = y[1] if isinstance(y, tuple) else y
+                if int(x[1]) != int(yv):
+                    return False
         elif isinstance(x, tuple) and x and x[0] == "d":
             yv = y[1] if isinstance(y, tuple) else y
             if int(x[1]) != int(yv):

@@ -613,6 +613,51 @@ def test_in_subquery_null_build_3vl(outer_runner):
     assert got[5] is None
 
 
+def test_not_in_null_build_filters_all(outer_runner):
+    # NOT IN against a subquery containing NULL: membership is UNKNOWN for
+    # every non-matching row, so WHERE keeps nothing but definite matches'
+    # complement — here, nothing at all (Trino 3VL; round-3 caveat removed)
+    rows = outer_runner.execute(
+        "SELECT k FROM memory.default.lft "
+        "WHERE k NOT IN (SELECT k FROM memory.default.rgt)").rows
+    assert rows == []
+
+
+def test_not_in_null_free_build(outer_runner):
+    rows = outer_runner.execute(
+        "SELECT k FROM memory.default.lft "
+        "WHERE k NOT IN (SELECT k FROM memory.default.rgt "
+        "                WHERE k IS NOT NULL)").rows
+    # NULL probe key -> UNKNOWN against non-empty build -> filtered
+    assert sorted(r[0] for r in rows) == [2, 5]
+
+
+def test_not_in_empty_build_keeps_all(outer_runner):
+    rows = outer_runner.execute(
+        "SELECT k FROM memory.default.lft "
+        "WHERE k NOT IN (SELECT k FROM memory.default.rgt WHERE k > 99)").rows
+    # x NOT IN (empty) is TRUE, even for NULL x
+    assert sorted((r[0] is None, r[0]) for r in rows) == \
+        [(False, 1), (False, 2), (False, 5), (True, None)]
+
+
+def test_not_exists_keeps_null_key_rows(outer_runner):
+    # NOT EXISTS: a NULL correlation key never matches -> row kept (EXISTS
+    # anti semantics differ from NOT IN: no 3VL escalation from build NULLs)
+    rows = outer_runner.execute(
+        "SELECT a FROM memory.default.lft l WHERE NOT EXISTS ("
+        "SELECT 1 FROM memory.default.rgt r WHERE r.k = l.k)").rows
+    assert sorted(r[0] for r in rows) == ["five", "nil", "two"]
+
+
+def test_in_null_probe_empty_build_is_false(outer_runner):
+    rows = outer_runner.execute(
+        "SELECT k, k IN (SELECT k FROM memory.default.rgt WHERE k > 99) "
+        "FROM memory.default.lft").rows
+    # IN over an empty set is FALSE for every probe value, including NULL
+    assert all(r[1] is False for r in rows)
+
+
 def test_lag_varchar_with_default(outer_runner):
     # dictionary-encoded arg + literal default: codes must be re-encoded
     # onto a union pool, not decoded through the arg's dictionary
@@ -733,3 +778,12 @@ def test_window_frame_unbounded_following(runner, oracle):
           "ROWS BETWEEN 1 FOLLOWING AND UNBOUNDED FOLLOWING), "
           "first_value(n_name) OVER (ORDER BY n_nationkey "
           "ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) FROM nation")
+
+
+def test_nth_value_nonpositive_rejected(outer_runner):
+    # window/NthValueFunction: INVALID_FUNCTION_ARGUMENT for n <= 0
+    import pytest as _pytest
+    with _pytest.raises(Exception, match="NTH_VALUE must be greater"):
+        outer_runner.execute(
+            "SELECT nth_value(a, 0) OVER (ORDER BY k) "
+            "FROM memory.default.lft")

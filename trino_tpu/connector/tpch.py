@@ -3,10 +3,17 @@
 Reference parity: plugin/trino-tpch (TpchMetadata.java, TpchRecordSetProvider
 .java, TpchSplitManager.java) — schemas tiny/sf1/sf10/... expose the 8 TPC-H
 tables, rows generated on demand. The reference delegates to io.airlift.tpch
-(a dbgen port); here a seeded NumPy generator produces the same schema and
-spec-shaped distributions (correctness is asserted engine-vs-oracle on the
-SAME generated data, the H2QueryRunner pattern, so exact dbgen bitstreams are
-not load-bearing).
+(a dbgen port); data here comes from `tpch_gen` — stateless counter-hash
+column streams reproducing dbgen's seekability (any column, any row range,
+any process, identical bytes) so scans materialize only the columns and row
+ranges they touch. That is what makes SF100 runnable on one host: a q9 scan
+of 600M-row lineitem generates 7 of 16 columns, chunk by chunk, and pooled
+varchar columns are emitted directly as dictionary codes (no Python string
+objects on the scan path).
+
+Correctness contract: engine and sqlite oracle read the SAME generated data
+(the H2QueryRunner pattern); see tpch_gen's docstring for the documented
+re-scope vs dbgen bit-identical rows.
 
 All varchar columns come dictionary-encoded; dates are int32 days since epoch;
 prices are short decimals (scaled int64).
@@ -15,18 +22,17 @@ prices are short decimals (scaled int64).
 from __future__ import annotations
 
 import math
-import zlib
 from typing import Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
 from trino_tpu import types as T
+from trino_tpu.connector import tpch_gen as G
 from trino_tpu.connector.spi import (
     ColumnHandle, ColumnMetadata, Connector, ConnectorMetadata,
     ConnectorPageSource, ConnectorSplitManager, ConnectorTableHandle,
     ColumnStatistics, SchemaTableName, Split, TableMetadata, TableStatistics,
     pad_to_capacity, split_range)
-from trino_tpu.expr.functions import days_from_civil
 from trino_tpu.page import Column, Dictionary, Page
 
 _D12_2 = T.DecimalType(12, 2)
@@ -78,280 +84,44 @@ TABLES: Dict[str, tuple] = {
                   ("l_comment", T.VarcharType(44))), None),  # ~4x orders
 }
 
-_REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
-_NATIONS = [  # (name, regionkey) per TPC-H spec
-    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1),
-    ("EGYPT", 4), ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3),
-    ("INDIA", 2), ("INDONESIA", 2), ("IRAN", 4), ("IRAQ", 4), ("JAPAN", 2),
-    ("JORDAN", 4), ("KENYA", 0), ("MOROCCO", 0), ("MOZAMBIQUE", 0),
-    ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3), ("SAUDI ARABIA", 4),
-    ("VIETNAM", 2), ("RUSSIA", 3), ("UNITED KINGDOM", 3),
-    ("UNITED STATES", 1)]
-_SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
-_PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
-_SHIPMODES = ["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK"]
-_INSTRUCTS = ["COLLECT COD", "DELIVER IN PERSON", "NONE", "TAKE BACK RETURN"]
-_CONTAINERS = [f"{a} {b}" for a in ("SM", "LG", "MED", "JUMBO", "WRAP")
-               for b in ("CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN",
-                         "DRUM")]
-_TYPE_S1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
-_TYPE_S2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
-_TYPE_S3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
-_COLORS = [
-    "almond", "antique", "aquamarine", "azure", "beige", "bisque", "black",
-    "blanched", "blue", "blush", "brown", "burlywood", "burnished",
-    "chartreuse", "chiffon", "chocolate", "coral", "cornflower", "cornsilk",
-    "cream", "cyan", "dark", "deep", "dim", "dodger", "drab", "firebrick",
-    "floral", "forest", "frosted", "gainsboro", "ghost", "goldenrod", "green",
-    "grey", "honeydew", "hot", "indian", "ivory", "khaki", "lace", "lavender",
-    "lawn", "lemon", "light", "lime", "linen", "magenta", "maroon", "medium",
-    "metallic", "midnight", "mint", "misty", "moccasin", "navajo", "navy",
-    "olive", "orange", "orchid", "pale", "papaya", "peach", "peru", "pink",
-    "plum", "powder", "puff", "purple", "red", "rose", "rosy", "royal",
-    "saddle", "salmon", "sandy", "seashell", "sienna", "sky", "slate", "smoke",
-    "snow", "spring", "steel", "tan", "thistle", "tomato", "turquoise",
-    "violet", "wheat", "white", "yellow"]
-_WORDS = [
-    "about", "above", "according", "accounts", "after", "against", "along",
-    "among", "around", "asymptotes", "attainments", "bold", "braids",
-    "carefully", "courts", "deposits", "dependencies", "depths", "dolphins",
-    "dugouts", "engage", "escapades", "even", "excuses", "express", "final",
-    "fluffily", "foxes", "furiously", "gifts", "grouches", "ideas",
-    "instructions", "ironic", "packages", "pending", "pinto", "platelets",
-    "quickly", "quietly", "regular", "requests", "sauternes", "sentiments",
-    "silent", "sleepy", "slyly", "special", "theodolites", "unusual",
-    "waters", "wishes"]
 
-_MIN_DATE = days_from_civil(1992, 1, 1)
-_MAX_ORDER_DATE = days_from_civil(1998, 8, 2)
-_CURRENT_DATE = days_from_civil(1995, 6, 17)
+def table_row_count(table: str, sf: float) -> int:
+    return G.row_count(table, sf)
 
 
-def _comments(rng: np.ndarray, n: int, max_len: int) -> np.ndarray:
-    """Deterministic word-salad comments: pool of 2048 phrases indexed by rng."""
-    pool_size = min(2048, max(64, n // 4))
-    pr = np.random.default_rng(12345)
-    words = np.array(_WORDS)
-    picks = pr.integers(0, len(words), size=(pool_size, 5))
-    pool = np.array([" ".join(words[r])[:max_len] for r in picks],
-                    dtype=object)
-    return pool[rng % pool_size]
-
-
-def _phone(rng_nation: np.ndarray, seq: np.ndarray) -> np.ndarray:
-    country = rng_nation + 10
-    p1 = (seq * 7919 + 13) % 900 + 100
-    p2 = (seq * 104729 + 7) % 900 + 100
-    p3 = (seq * 1299709 + 3) % 9000 + 1000
-    return np.array([f"{c}-{a}-{b}-{d}" for c, a, b, d in
-                     zip(country, p1, p2, p3)], dtype=object)
-
-
-def _table_seed(table: str, sf: float) -> int:
-    """Stable across processes (unlike hash(): PYTHONHASHSEED-randomized) so
-    every worker generating a split sees the same data."""
-    return zlib.crc32(f"{table}:{round(sf * 1000)}".encode())
-
-
-def _gen_table(table: str, sf: float) -> Dict[str, np.ndarray]:
-    """Generate full host arrays for one table at one scale factor."""
-    rng = np.random.default_rng(_table_seed(table, sf))
-    if table == "region":
-        n = 5
-        return {
-            "r_regionkey": np.arange(n, dtype=np.int64),
-            "r_name": np.array(_REGIONS, dtype=object),
-            "r_comment": _comments(np.arange(n), n, 152),
-        }
-    if table == "nation":
-        n = 25
-        return {
-            "n_nationkey": np.arange(n, dtype=np.int64),
-            "n_name": np.array([x[0] for x in _NATIONS], dtype=object),
-            "n_regionkey": np.array([x[1] for x in _NATIONS], dtype=np.int64),
-            "n_comment": _comments(np.arange(n), n, 152),
-        }
-    if table == "supplier":
-        n = max(1, int(10_000 * sf))
-        seq = np.arange(n)
-        nation = rng.integers(0, 25, n)
-        return {
-            "s_suppkey": np.arange(1, n + 1, dtype=np.int64),
-            "s_name": np.array([f"Supplier#{i:09d}" for i in range(1, n + 1)],
-                               dtype=object),
-            "s_address": _comments(rng.integers(0, 1 << 30, n), n, 40),
-            "s_nationkey": nation.astype(np.int64),
-            "s_phone": _phone(nation, seq),
-            "s_acctbal": rng.integers(-99999, 999999, n).astype(np.int64),
-            "s_comment": _comments(rng.integers(0, 1 << 30, n), n, 101),
-        }
-    if table == "customer":
-        n = max(1, int(150_000 * sf))
-        seq = np.arange(n)
-        nation = rng.integers(0, 25, n)
-        return {
-            "c_custkey": np.arange(1, n + 1, dtype=np.int64),
-            "c_name": np.array([f"Customer#{i:09d}" for i in range(1, n + 1)],
-                               dtype=object),
-            "c_address": _comments(rng.integers(0, 1 << 30, n), n, 40),
-            "c_nationkey": nation.astype(np.int64),
-            "c_phone": _phone(nation, seq),
-            "c_acctbal": rng.integers(-99999, 999999, n).astype(np.int64),
-            "c_mktsegment": np.array(_SEGMENTS, dtype=object)[
-                rng.integers(0, 5, n)],
-            "c_comment": _comments(rng.integers(0, 1 << 30, n), n, 117),
-        }
-    if table == "part":
-        n = max(1, int(200_000 * sf))
-        c1 = rng.integers(0, len(_COLORS), n)
-        c2 = rng.integers(0, len(_COLORS), n)
-        colors = np.array(_COLORS)
-        mfgr = rng.integers(1, 6, n)
-        brand = mfgr * 10 + rng.integers(1, 6, n)
-        t1 = rng.integers(0, len(_TYPE_S1), n)
-        t2 = rng.integers(0, len(_TYPE_S2), n)
-        t3 = rng.integers(0, len(_TYPE_S3), n)
-        types_arr = np.array(
-            [f"{_TYPE_S1[a]} {_TYPE_S2[b]} {_TYPE_S3[c]}"
-             for a, b, c in zip(t1, t2, t3)], dtype=object)
-        # retailprice formula per spec: 90000+((pk/10)%20001)+100*(pk%1000)
-        pk = np.arange(1, n + 1, dtype=np.int64)
-        retail = 90000 + (pk // 10) % 20001 + 100 * (pk % 1000)
-        return {
-            "p_partkey": pk,
-            "p_name": np.array(
-                [f"{colors[a]} {colors[b]}" for a, b in zip(c1, c2)],
-                dtype=object),
-            "p_mfgr": np.array([f"Manufacturer#{m}" for m in mfgr],
-                               dtype=object),
-            "p_brand": np.array([f"Brand#{b}" for b in brand], dtype=object),
-            "p_type": types_arr,
-            "p_size": rng.integers(1, 51, n).astype(np.int32),
-            "p_container": np.array(_CONTAINERS, dtype=object)[
-                rng.integers(0, len(_CONTAINERS), n)],
-            "p_retailprice": retail,
-            "p_comment": _comments(rng.integers(0, 1 << 30, n), n, 23),
-        }
-    if table == "partsupp":
-        nparts = max(1, int(200_000 * sf))
-        nsupp = max(1, int(10_000 * sf))
-        # 4 suppliers per part, spec formula spreads across supplier space
-        pk = np.repeat(np.arange(1, nparts + 1, dtype=np.int64), 4)
-        i = np.tile(np.arange(4, dtype=np.int64), nparts)
-        sk = (pk + i * (nsupp // 4 + (pk - 1) // nsupp)) % nsupp + 1
-        n = len(pk)
-        return {
-            "ps_partkey": pk,
-            "ps_suppkey": sk,
-            "ps_availqty": rng.integers(1, 10000, n).astype(np.int32),
-            "ps_supplycost": rng.integers(100, 100001, n).astype(np.int64),
-            "ps_comment": _comments(rng.integers(0, 1 << 30, n), n, 199),
-        }
-    if table == "orders":
-        n = max(1, int(1_500_000 * sf))
-        ncust = max(1, int(150_000 * sf))
-        # only 2/3 of customers have orders (spec: custkey % 3 != 0 ... keep
-        # simple: random custkey among non-multiples of 3)
-        ck = rng.integers(1, max(ncust, 2), n).astype(np.int64)
-        ck = np.where(ck % 3 == 0, np.maximum((ck + 1) % (ncust + 1), 1), ck)
-        odate = rng.integers(_MIN_DATE, _MAX_ORDER_DATE - 151, n).astype(
-            np.int32)
-        status_roll = odate + 151 < _CURRENT_DATE
-        half = rng.random(n) < 0.5
-        status = np.where(status_roll, "F",
-                          np.where(half, "O", "P")).astype(object)
-        return {
-            "o_orderkey": np.arange(1, n + 1, dtype=np.int64),
-            "o_custkey": ck,
-            "o_orderstatus": status,
-            "o_totalprice": rng.integers(85000, 55558642, n).astype(np.int64),
-            "o_orderdate": odate,
-            "o_orderpriority": np.array(_PRIORITIES, dtype=object)[
-                rng.integers(0, 5, n)],
-            "o_clerk": np.array(
-                [f"Clerk#{c:09d}" for c in
-                 rng.integers(1, max(2, int(1000 * sf)) + 1, n)],
-                dtype=object),
-            "o_shippriority": np.zeros(n, dtype=np.int32),
-            "o_comment": _comments(rng.integers(0, 1 << 30, n), n, 79),
-        }
-    if table == "lineitem":
-        orders = get_table("orders", sf)
-        norders = len(orders["o_orderkey"])
-        lines = rng.integers(1, 8, norders)  # 1..7 lines per order
-        okey = np.repeat(orders["o_orderkey"], lines)
-        odate = np.repeat(orders["o_orderdate"], lines)
-        n = len(okey)
-        linenumber = (np.arange(n, dtype=np.int64)
-                      - np.repeat(np.cumsum(lines) - lines, lines) + 1)
-        nparts = max(1, int(200_000 * sf))
-        nsupp = max(1, int(10_000 * sf))
-        pk = rng.integers(1, nparts + 1, n).astype(np.int64)
-        i4 = rng.integers(0, 4, n).astype(np.int64)
-        sk = (pk + i4 * (nsupp // 4 + (pk - 1) // nsupp)) % nsupp + 1
-        qty = rng.integers(1, 51, n).astype(np.int64)
-        # extendedprice = qty * retailprice-of-part (decimal(12,2) scaled)
-        part_retail = 90000 + (pk // 10) % 20001 + 100 * (pk % 1000)
-        eprice = qty * part_retail
-        discount = rng.integers(0, 11, n).astype(np.int64)  # 0.00-0.10
-        tax = rng.integers(0, 9, n).astype(np.int64)        # 0.00-0.08
-        sdate = odate + rng.integers(1, 122, n)
-        cdate = odate + rng.integers(30, 91, n)
-        rdate = sdate + rng.integers(1, 31, n)
-        returned = rdate <= _CURRENT_DATE
-        rflag_roll = rng.random(n) < 0.5
-        rflag = np.where(returned, np.where(rflag_roll, "R", "A"), "N").astype(
-            object)
-        lstatus = np.where(sdate > _CURRENT_DATE, "O", "F").astype(object)
-        return {
-            "l_orderkey": okey,
-            "l_partkey": pk,
-            "l_suppkey": sk,
-            "l_linenumber": linenumber.astype(np.int32),
-            "l_quantity": qty * 100,  # decimal(12,2) scaled
-            "l_extendedprice": eprice,
-            "l_discount": discount,
-            "l_tax": tax,
-            "l_returnflag": rflag,
-            "l_linestatus": lstatus,
-            "l_shipdate": sdate.astype(np.int32),
-            "l_commitdate": cdate.astype(np.int32),
-            "l_receiptdate": rdate.astype(np.int32),
-            "l_shipinstruct": np.array(_INSTRUCTS, dtype=object)[
-                rng.integers(0, 4, n)],
-            "l_shipmode": np.array(_SHIPMODES, dtype=object)[
-                rng.integers(0, 7, n)],
-            "l_comment": _comments(rng.integers(0, 1 << 30, n), n, 44),
-        }
-    raise KeyError(table)
-
-
-_TABLE_CACHE: Dict[tuple, Dict[str, np.ndarray]] = {}
-_DICT_CACHE: Dict[tuple, Dictionary] = {}
-_ROWCOUNT_CACHE: Dict[tuple, int] = {}
+def _host_chunk(table: str, sf: float, column: str, start: int,
+                end: int) -> np.ndarray:
+    """Object strings or numerics for a row range (oracle / CTAS path)."""
+    if G.string_kind(table, column) is not None:
+        return G.object_chunk(table, sf, column, start, end)
+    return G.numeric_chunk(table, sf, column, start, end)
 
 
 def get_table(table: str, sf: float) -> Dict[str, np.ndarray]:
-    key = (table, round(sf * 1000))
-    if key not in _TABLE_CACHE:
-        _TABLE_CACHE[key] = _gen_table(table, sf)
-    return _TABLE_CACHE[key]
+    """Full host arrays for one table (oracle loading; small sf only —
+    large-sf scans go through the chunked code path instead)."""
+    n = G.row_count(table, sf)
+    return {name: _host_chunk(table, sf, name, 0, n)
+            for name, _ in TABLES[table][0]}
 
 
-def _column_type(table: str, column: str) -> T.Type:
-    for name, typ in TABLES[table][0]:
-        if name == column:
-            return typ
-    raise KeyError(column)
+_DICT_CACHE: Dict[tuple, Dictionary] = {}
 
 
 def table_dictionary(table: str, sf: float, column: str) -> Dictionary:
     """Shared per-(table, sf, column) dictionary so every page of a scan uses
-    one pool (stable codes across splits; one trace per table)."""
+    one pool (stable codes across splits; one trace per table). Pooled
+    columns build from their fixed pool without materializing the column;
+    formatted (per-row unique) columns materialize once on first use."""
     key = (table, round(sf * 1000), column)
     if key not in _DICT_CACHE:
-        data = get_table(table, sf)[column]
-        _DICT_CACHE[key] = Dictionary.build(data)[0]
+        if G.string_kind(table, column) == "pooled":
+            _DICT_CACHE[key] = Dictionary(
+                G.pool_values(table, column, sf))
+        else:
+            n = G.row_count(table, sf)
+            data = G.object_chunk(table, sf, column, 0, n)
+            _DICT_CACHE[key] = Dictionary.build(data)[0]
     return _DICT_CACHE[key]
 
 
@@ -397,26 +167,6 @@ class TpchMetadata(ConnectorMetadata):
         return ConnectorTableHandle(handle.name, handle.constraint, limit)
 
 
-def table_row_count(table: str, sf: float) -> int:
-    if table == "region":
-        return 5
-    if table == "nation":
-        return 25
-    if table == "lineitem":
-        # replay only the generator's FIRST draw (lines-per-order) — metadata
-        # and split planning must not materialize the table (sf1000 = ~6B rows)
-        key = ("lineitem_rows", round(sf * 1000))
-        if key not in _ROWCOUNT_CACHE:
-            norders = max(1, int(1_500_000 * sf))
-            rng = np.random.default_rng(_table_seed("lineitem", sf))
-            _ROWCOUNT_CACHE[key] = int(rng.integers(1, 8, norders).sum())
-        return _ROWCOUNT_CACHE[key]
-    if table == "partsupp":
-        return max(1, int(200_000 * sf)) * 4
-    base = TABLES[table][1]
-    return max(1, int(base * sf))
-
-
 class TpchSplitManager(ConnectorSplitManager):
     def get_splits(self, handle: ConnectorTableHandle,
                    target_splits: int = 1) -> List[Split]:
@@ -440,7 +190,7 @@ _DEVICE_COL_CACHE_USED = 0
 
 def _staged_column(table: str, sf: float, name: str, typ: T.Type,
                    off: int, hi: int, page_capacity: int) -> Column:
-    """Encode + pad + stage one column slice to device, once per
+    """Generate + pad + stage one column slice to device, once per
     (table, sf, column, slice, capacity), LRU-evicted under a byte budget.
 
     The reference streams table data from storage per query; TPC-H data here
@@ -453,14 +203,18 @@ def _staged_column(table: str, sf: float, name: str, typ: T.Type,
     if col is not None:
         _DEVICE_COL_CACHE.move_to_end(key)
         return col
-    raw = get_table(table, sf)[name][off:hi]
     if T.is_string(typ):
         d = table_dictionary(table, sf, name)
-        codes = pad_to_capacity(d.encode(raw), page_capacity, 0)
-        col = Column.from_numpy(codes, typ, dictionary=d)
+        if G.string_kind(table, name) == "pooled":
+            codes = G.codes_chunk(table, sf, name, off, hi)
+        else:
+            codes = d.encode(G.object_chunk(table, sf, name, off, hi))
+        col = Column.from_numpy(pad_to_capacity(codes, page_capacity, 0),
+                                typ, dictionary=d)
     else:
-        arr = pad_to_capacity(np.asarray(raw, T.to_numpy_dtype(typ)),
-                              page_capacity, 0)
+        arr = pad_to_capacity(
+            np.asarray(G.numeric_chunk(table, sf, name, off, hi),
+                       T.to_numpy_dtype(typ)), page_capacity, 0)
         col = Column.from_numpy(arr, typ)
     nbytes = col.nbytes
     if nbytes > _DEVICE_COL_CACHE_BYTES:

@@ -340,6 +340,15 @@ class LocalExecutionPlanner:
         self.memory.reserve(page_bytes(page), "collect")
         return page
 
+    def _free_collected(self, page: Optional[Page]) -> None:
+        """Release a _collect reservation at operator scope (the reference
+        frees per-operator memory contexts on finish — without this a
+        query's sequential peak would be accounted as the SUM of every
+        build side / sort input ever held)."""
+        if page is not None:
+            from trino_tpu.exec.memory import page_bytes
+            self.memory.free(page_bytes(page), "collect")
+
     def _exec_AggregationNode(self, node: AggregationNode) -> PageStream:
         src = self.execute(node.source)
         lay, typ = _layout(src.symbols)
@@ -385,7 +394,10 @@ class LocalExecutionPlanner:
                     if not key_channels:
                         yield self._empty_global_agg(node, specs)
                     return
-                yield single_op(page)
+                try:
+                    yield single_op(page)
+                finally:
+                    self._free_collected(page)
             return PageStream(gen_distinct(), node.outputs)
         # fuse the upstream filter/project chain into the partial-agg kernel:
         # scan -> filter -> project -> partial agg is ONE device program per
@@ -483,7 +495,10 @@ class LocalExecutionPlanner:
             page = self._collect(src)
             if page is None:
                 return
-            yield sort_op(page)
+            try:
+                yield sort_op(page)
+            finally:
+                self._free_collected(page)
         return PageStream(gen(), src.symbols)
 
     def _exec_TopNNode(self, node: TopNNode) -> PageStream:
@@ -561,15 +576,19 @@ class LocalExecutionPlanner:
                  cap, post_pred), build)
 
         def gen():
-            nonlocal build_page
-            if build_page is None:
+            collected = build_page   # only the _collect'ed page was reserved
+            bp = build_page
+            if bp is None:
                 if join_kind == JoinType.INNER:
                     return
                 # LEFT join with empty build: emit null-extended probe rows
-                build_page = self._null_build_page(node.right.outputs)
-            prepared = self._prepare_build(build_keys, build_page)
-            yield from _run_with_overflow(
-                probe_stream, prepared, join_op, self.page_capacity)
+                bp = self._null_build_page(node.right.outputs)
+            try:
+                prepared = self._prepare_build(build_keys, bp)
+                yield from _run_with_overflow(
+                    probe_stream, prepared, join_op, self.page_capacity)
+            finally:
+                self._free_collected(collected)
         return PageStream(gen(), out_symbols)
 
     def _prepare_build(self, build_keys, build_page):
@@ -741,7 +760,8 @@ class LocalExecutionPlanner:
         def semi_op(cap: int):
             def build():
                 op = hash_join(probe_keys, build_keys, jt,
-                               output_capacity=cap, prepared=True)
+                               output_capacity=cap, prepared=True,
+                               null_aware=semi.null_aware)
                 fn = None if rest_lowered is None \
                     else compile_filter(rest_lowered)
 
@@ -761,7 +781,7 @@ class LocalExecutionPlanner:
                 return run
             return cached_kernel(
                 ("semijoin", tuple(probe_keys), tuple(build_keys), jt,
-                 cap, rest_lowered), build)
+                 cap, rest_lowered, semi.null_aware), build)
 
         def gen():
             bp = build_page
@@ -769,9 +789,12 @@ class LocalExecutionPlanner:
                 if jt == JoinType.SEMI:
                     return
                 bp = self._null_build_page(semi.filtering_source.outputs)
-            prepared = self._prepare_build(build_keys, bp)
-            yield from _run_with_overflow(
-                probe_stream, prepared, semi_op, self.page_capacity)
+            try:
+                prepared = self._prepare_build(build_keys, bp)
+                yield from _run_with_overflow(
+                    probe_stream, prepared, semi_op, self.page_capacity)
+            finally:
+                self._free_collected(build_page)
         return PageStream(gen(),
                           semi.source.outputs + (semi.match_symbol,))
 
@@ -790,9 +813,11 @@ class LocalExecutionPlanner:
 
         def mark_op(cap: int):
             return cached_kernel(
-                ("markjoin", tuple(probe_keys), tuple(build_keys), cap),
+                ("markjoin", tuple(probe_keys), tuple(build_keys), cap,
+                 node.null_aware),
                 lambda: hash_join(probe_keys, build_keys, JoinType.MARK,
-                                  output_capacity=cap, prepared=True))
+                                  output_capacity=cap, prepared=True,
+                                  null_aware=node.null_aware))
 
         def no_match(page: Page) -> Page:
             mark = Column(jnp.zeros(page.capacity, dtype=jnp.bool_), None,
@@ -805,9 +830,12 @@ class LocalExecutionPlanner:
                 for page in probe_stream.iter_pages():
                     yield no_match(page)
                 return
-            prepared = self._prepare_build(build_keys, bp)
-            yield from _run_with_overflow(
-                probe_stream, prepared, mark_op, self.page_capacity)
+            try:
+                prepared = self._prepare_build(build_keys, bp)
+                yield from _run_with_overflow(
+                    probe_stream, prepared, mark_op, self.page_capacity)
+            finally:
+                self._free_collected(build_page)
         return PageStream(gen(), out_symbols)
 
     def _exec_AssignUniqueIdNode(self, node) -> PageStream:
@@ -926,7 +954,10 @@ class LocalExecutionPlanner:
             page = self._collect(src)
             if page is None:
                 return
-            yield win(page)
+            try:
+                yield win(page)
+            finally:
+                self._free_collected(page)
         return PageStream(gen(), node.outputs)
 
     @staticmethod

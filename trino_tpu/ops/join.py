@@ -148,6 +148,7 @@ def hash_join(
     output_capacity: Optional[int] = None,
     verify_composite: bool = True,
     prepared: bool = False,
+    null_aware: bool = True,
 ) -> Callable[[Page, Page], Tuple[Page, jnp.ndarray]]:
     """Build op(probe_page, build) -> (output_page, true_total_rows).
 
@@ -157,6 +158,19 @@ def hash_join(
     output_capacity: static result capacity; defaults to probe capacity.
     true_total_rows may exceed num_rows when the capacity was too small —
     the executor re-plans at a larger bucket (never silently truncates).
+
+    null_aware governs SEMI/ANTI/MARK null semantics (reference:
+    sql/planner/QueryPlanner IN-predicate planning vs correlated-EXISTS
+    decorrelation):
+      True  — IN-subquery 3VL: a NULL probe key or a NULL in a non-empty
+              build side makes the membership UNKNOWN, so ANTI keeps a
+              non-matching row only when the build side is null-free, and a
+              NULL probe key survives ANTI only against an EMPTY build
+              (x NOT IN (empty) is TRUE even for NULL x).
+      False — EXISTS semantics: NULL correlation keys simply never match
+              (the correlated equality evaluates to NULL -> no inner row
+              qualifies), so ANTI keeps every unmatched live probe row
+              including NULL-key rows, and build-side NULLs are irrelevant.
     """
     probe_keys = tuple(probe_keys)
     build_keys = tuple(build_keys)
@@ -198,17 +212,33 @@ def hash_join(
         hi = jnp.minimum(hi, n_live_build)
         counts = jnp.where(p_dead, 0, hi - lo).astype(jnp.int64)
 
+        def anti_keep(matched: jnp.ndarray) -> jnp.ndarray:
+            live = probe.row_mask()
+            if null_aware:
+                # NOT IN: non-null probe keeps iff unmatched AND build has
+                # no NULLs; NULL probe keeps only against an empty build
+                return live & jnp.where(
+                    pnull, n_build_rows == 0, ~matched & ~build_has_null)
+            # NOT EXISTS: unmatched live rows keep (NULL keys never match)
+            return live & ~matched
+
+        def mark_page(matched: jnp.ndarray) -> Page:
+            if null_aware:
+                return _mark_page(probe, matched, pnull, n_build_rows,
+                                  build_has_null)
+            value = matched & ~pnull
+            mark = Column(value, None, T.BOOLEAN, None)
+            return Page(tuple(probe.columns) + (mark,), probe.num_rows)
+
         if join_type in (JoinType.SEMI, JoinType.ANTI, JoinType.MARK) \
                 and not (composite and verify_composite):
             # single-column keys: to_u64 is injective, hash match == key match
             if join_type == JoinType.MARK:
-                return _mark_page(probe, counts > 0, pnull, n_build_rows,
-                                  build_has_null), \
-                    probe.num_rows.astype(jnp.int64)
+                return mark_page(counts > 0), probe.num_rows.astype(jnp.int64)
             if join_type == JoinType.SEMI:
                 out = probe.filter((counts > 0) & ~p_dead)
             else:
-                out = probe.filter((counts == 0) & ~p_dead & probe.row_mask())
+                out = probe.filter(anti_keep(counts > 0))
             return out, out.num_rows.astype(jnp.int64)
 
         emit = counts
@@ -247,13 +277,12 @@ def hash_join(
                 keep, mode="drop")
             if join_type == JoinType.MARK:
                 rows = probe.num_rows.astype(jnp.int64)
-                return _mark_page(probe, verified, pnull, n_build_rows,
-                                  build_has_null), \
+                return mark_page(verified), \
                     jnp.where(total <= cap, rows, total)
             if join_type == JoinType.SEMI:
                 out = probe.filter(verified & ~p_dead)
             else:
-                out = probe.filter(~verified & ~p_dead & probe.row_mask())
+                out = probe.filter(anti_keep(verified))
             rows = out.num_rows.astype(jnp.int64)
             return out, jnp.where(total <= cap, rows, total)
 

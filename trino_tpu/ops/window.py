@@ -13,9 +13,12 @@ prefix-scans / segment-reduces on the VPU:
                   peer-inclusive default frame)
   whole-partition frames -> segment-reduce + gather back
 
-Supported frames: UNBOUNDED PRECEDING .. CURRENT ROW (ROWS and RANGE) and
-UNBOUNDED PRECEDING .. UNBOUNDED FOLLOWING. Bounded (<expr> PRECEDING/
-FOLLOWING) frames raise at lowering.
+Supported frames: UNBOUNDED PRECEDING .. CURRENT ROW (ROWS and RANGE),
+UNBOUNDED PRECEDING .. UNBOUNDED FOLLOWING, and bounded ROWS frames with
+literal offsets (<k> PRECEDING/FOLLOWING): sum/avg/count evaluate as
+prefix-sum differences, min/max via segmented pow-2 doubling tables, and
+value functions index directly into the [lo, hi] range. RANGE frames with
+value offsets and GROUPS frames raise at lowering.
 """
 
 from __future__ import annotations
@@ -204,7 +207,11 @@ def _eval(spec: WindowSpec, page: Page, live, idx, seg_b, seg_id, seg_start,
             else:
                 nth = arg(1).values.astype(jnp.int64)
                 tgt = lo + nth - 1
-                nonempty = nonempty & (tgt <= hi)
+                # lower guard: literal n <= 0 is rejected at planning
+                # (Trino INVALID_FUNCTION_ARGUMENT); a dynamic n <= 0
+                # yields NULL here rather than reading before the frame
+                # (potentially the previous partition)
+                nonempty = nonempty & (tgt <= hi) & (tgt >= lo)
             in_frame = nonempty
         else:
             if name == "first_value":

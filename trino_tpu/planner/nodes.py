@@ -294,6 +294,10 @@ class SemiJoinNode(PlanNode):
     filtering_keys: Tuple[Symbol, ...]
     match_symbol: Symbol  # boolean output
     negate: bool = False  # True -> NOT IN / NOT EXISTS consumed as anti
+    # IN-subquery 3VL (NULL key or NULL in build -> UNKNOWN membership) vs
+    # EXISTS semantics (NULL correlation keys just never match); see
+    # ops/join.py hash_join(null_aware=...)
+    null_aware: bool = True
 
     @property
     def sources(self):
@@ -306,7 +310,7 @@ class SemiJoinNode(PlanNode):
     def with_sources(self, sources):
         return SemiJoinNode(sources[0], sources[1], self.source_keys,
                             self.filtering_keys, self.match_symbol,
-                            self.negate)
+                            self.negate, self.null_aware)
 
 
 @_D

@@ -486,7 +486,7 @@ class PredicatePushDown(Rule):
             inner = FilterNode(src.source, combine(pushable))
             out = SemiJoinNode(inner, src.filtering_source, src.source_keys,
                                src.filtering_keys, src.match_symbol,
-                               src.negate)
+                               src.negate, src.null_aware)
             if kept:
                 out = FilterNode(out, combine(kept))
             return out
@@ -580,7 +580,7 @@ def prune_unreferenced(root: OutputNode) -> OutputNode:
             filtering = needed_of(node.filtering_source, filt_req)
             return SemiJoinNode(source, filtering, node.source_keys,
                                 node.filtering_keys, node.match_symbol,
-                                node.negate)
+                                node.negate, node.null_aware)
         if isinstance(node, AggregationNode):
             kept_aggs = tuple((s, a) for s, a in node.aggregations
                               if s.name in required or not required)

@@ -10,9 +10,12 @@ Subquery support (SubqueryPlanner + TransformCorrelated* rules condensed):
                                 -> group-by-correlation-keys + LEFT join
 - [NOT] IN (subquery)           -> SemiJoinNode (+ NOT via negated filter)
 - [NOT] EXISTS with equality correlation -> SemiJoinNode on the keys
-NOT IN null semantics caveat: planned as anti-join, which matches Trino only
-when the subquery column has no NULLs (TPC-H/DS safe); exactness tracked for
-a later round.
+NOT IN is null-aware (SemiJoinNode.null_aware): the executor applies full
+IN-subquery three-valued logic — a NULL probe value or a NULL in a non-empty
+subquery result makes membership UNKNOWN, so NOT IN keeps a row only when
+the subquery column is null-free (and x NOT IN (empty) is TRUE even for
+NULL x). NOT EXISTS uses non-null-aware anti semantics (NULL correlation
+keys simply never match).
 """
 
 from __future__ import annotations
@@ -769,6 +772,14 @@ class _PlanBuilder:
                          nf if nf is not None else not asc)
                 for e, asc, nf in order_items)
             args = tuple(tr.translate(a) for a in fc.args)
+            if name == "nth_value" and len(args) > 1 \
+                    and isinstance(args[1], Literal) \
+                    and args[1].value is not None \
+                    and int(args[1].value) <= 0:
+                # window/NthValueFunction parity: INVALID_FUNCTION_ARGUMENT
+                raise SemanticError(
+                    "Argument of NTH_VALUE must be greater than zero "
+                    f"(actual value: {args[1].value})")
             arg_syms = tuple(sym_for(a).ref() for a in args)
             if any(not isinstance(e, SymbolRef) for _, e in pre):
                 self.node = ProjectNode(self.node, tuple(pre))
@@ -1141,7 +1152,8 @@ class _PlanBuilder:
         inner_keys = [inner_tr.translate(iast) for _, iast in corr_pairs]
         outer_tr = self.translator()
         outer_keys = [outer_tr.translate(oast) for oast, _ in corr_pairs]
-        return self._semi_join(outer_keys, inner_keys, ib, negate)
+        return self._semi_join(outer_keys, inner_keys, ib, negate,
+                               null_aware=False)
 
     def _exists_general(self, spec: t.QuerySpecification,
                         negate: bool) -> RowExpression:
@@ -1191,7 +1203,7 @@ class _PlanBuilder:
         proj = ProjectNode(filtered, ((uid, uid.ref()),))
         match = planner.symbols.new("match", T.BOOLEAN)
         self.node = SemiJoinNode(probe_node, proj, (uid,), (uid,), match,
-                                 negate)
+                                 negate, null_aware=False)
         out = match.ref()
         return SpecialForm(SpecialKind.NOT, (out,), T.BOOLEAN) \
             if negate else out
@@ -1206,11 +1218,11 @@ class _PlanBuilder:
         outer_tr = self.translator()
         v = outer_tr.translate(value_ast)
         return self._semi_join([v], [sub.scope.fields[0].symbol.ref()], ib,
-                               negate=False)
+                               negate=False, null_aware=True)
 
     def _semi_join(self, outer_keys: List[RowExpression],
                    inner_keys: List[RowExpression], ib: "_PlanBuilder",
-                   negate: bool) -> RowExpression:
+                   negate: bool, null_aware: bool = True) -> RowExpression:
         planner = self.planner
         # coerce pairwise
         okeys, ikeys = [], []
@@ -1237,7 +1249,7 @@ class _PlanBuilder:
         match = planner.symbols.new("match", T.BOOLEAN)
         self.node = SemiJoinNode(
             probe.node, build_plan.node, tuple(probe_syms),
-            tuple(build_syms), match, negate)
+            tuple(build_syms), match, negate, null_aware)
         self._scope = Scope(probe.scope.fields, self._scope.parent)
         out = match.ref()
         if negate:

@@ -103,6 +103,12 @@ class TrinoServer:
         session = self.runner.session
         with self._lock:
             saved = (session.catalog, session.schema)
+            # snapshot ALL properties: restoring only header-derived keys
+            # would leak one client's SET SESSION into every other client
+            # (the protocol is stateless — the X-Trino-Set-Session response
+            # header hands the state back to THIS client, which re-sends it
+            # via X-Trino-Session on its next request)
+            saved_props = dict(session.properties)
             try:
                 catalog = headers.get("X-Trino-Catalog")
                 schema = headers.get("X-Trino-Schema")
@@ -112,25 +118,24 @@ class TrinoServer:
                     session.schema = schema
                 overrides = {}
                 props_header = headers.get("X-Trino-Session", "")
+                # reference wire format (ProtocolHeaders/StatementClientV1):
+                # comma-separated key=value pairs, values URL-encoded (so
+                # raw commas never appear inside a value)
+                from urllib.parse import unquote
                 for part in props_header.split(","):
                     if "=" in part:
                         k, _, v = part.partition("=")
-                        overrides[k.strip()] = v.strip()
-                saved_props = {k: session.properties.get(k)
-                               for k in overrides}
+                        overrides[k.strip()] = unquote(v.strip())
                 for k, v in overrides.items():
                     try:
                         session.set(k, v)
                     except Exception:
-                        saved_props.pop(k, None)
+                        pass
                 try:
                     q.result = self.runner.execute(sql)
                 finally:
-                    for k, v in saved_props.items():
-                        if v is None:
-                            session.properties.pop(k, None)
-                        else:
-                            session.properties[k] = v
+                    session.properties.clear()
+                    session.properties.update(saved_props)
                 m = _SET_SESSION.match(sql)
                 if m:
                     q.update_type = "SET SESSION"
@@ -197,8 +202,10 @@ class TrinoServer:
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 if q is not None and q.set_session is not None:
+                    from urllib.parse import quote
                     k, v = q.set_session
-                    self.send_header("X-Trino-Set-Session", f"{k}={v}")
+                    self.send_header("X-Trino-Set-Session",
+                                     f"{k}={quote(str(v))}")
                 if q is not None and q.clear_session is not None:
                     self.send_header("X-Trino-Clear-Session",
                                      q.clear_session)
