@@ -35,6 +35,7 @@ from trino_tpu.expr.ir import (Call, InputRef, Literal, RowExpression,
 from trino_tpu.metadata import Metadata, Session
 from trino_tpu.ops import (AggSpec, JoinType, SortKey, Step, hash_aggregate,
                            hash_join, order_by, prepare_build, top_n)
+from trino_tpu.ops.join import unique_inner_probe
 from trino_tpu.page import Column, Page, concat_pages
 from trino_tpu.planner.nodes import (
     AggregationNode, AggStep, DistinctLimitNode, EnforceSingleRowNode,
@@ -323,22 +324,87 @@ class LocalExecutionPlanner:
         """Materialize a stream (blocking-operator input). The result is
         reserved against query_max_memory: blocking materializations are
         what consumes HBM (streamed pages flow through one fused kernel).
-        Reservations live for the query (a conservative upper bound — the
-        reference frees per-operator contexts on finish)."""
+        Freed at operator scope via _free_collected."""
         from trino_tpu.exec.memory import page_bytes
-        pages = [p for p in stream.iter_pages() if int(p.num_rows) > 0]
+        pages = list(stream.iter_pages())
         if not pages:
             return None
-        page = pages[0] if len(pages) == 1 else concat_pages(pages)
-        # shrink heavily padded intermediates (e.g. a filtered scan page at
-        # table capacity): downstream blocking work — build-side sorts,
-        # aggregation/window sorts — costs O(capacity log capacity), so a
-        # 64M-capacity page carrying 3M live rows would pay 20x
-        tight = _next_pow2(max(int(page.num_rows), 1))
-        if page.capacity > 2 * tight:
-            page = page.shrink_to(tight)
+        # concatenate ON DEVICE (dynamic_update_slice cascade) with ONE
+        # batched count fetch — the host bounce (concat_pages) moved every
+        # live row through the tunnel, and the old per-page num_rows
+        # check cost a ~95ms round trip per page. Each page is shrunk to
+        # its live pow2 first so the concat transient is O(live), not
+        # O(sum of scan capacities) — a selective filter over many scan
+        # pages would otherwise allocate the whole unfiltered footprint.
+        counts = [int(c) for c in jax.device_get(
+            [p.num_rows for p in pages])]
+        total = sum(counts)
+        if total == 0:
+            return None
+        live = [self._tight(p, c) for p, c in zip(pages, counts) if c > 0]
+        page = self._merge_buf(live, total)
         self.memory.reserve(page_bytes(page), "collect")
         return page
+
+    @staticmethod
+    def _tight(page: Page, n: int) -> Page:
+        """Shrink a page to the pow2 envelope of its live count (free
+        device slice; downstream sorts/builds then run at live size)."""
+        tight = _next_pow2(max(n, 1))
+        if page.capacity > 2 * tight:
+            return page.shrink_to(tight)
+        return page
+
+    def _device_concat(self, pages: List[Page]) -> Page:
+        """Jitted device-side page concatenation (page.device_concat) —
+        one compiled program per (capacities, ncols) combination."""
+        from trino_tpu.page import device_concat
+        key = ("dconcat", tuple(p.capacity for p in pages),
+               pages[0].num_columns)
+        op = cached_kernel(key, lambda: lambda *ps: device_concat(ps))
+        return op(*pages)
+
+    def _coalesce_stream(self, stream: PageStream,
+                         target_rows: Optional[int] = None) -> PageStream:
+        """Batch filtered pages into few large buffers before a probe.
+
+        A probe kernel launch has a large fixed cost (sort-engine passes at
+        static capacity, regardless of live rows): round-4 profiling showed
+        q3 SF10 paying ~23s across 19 per-page probe calls on ~2M-live
+        pages. Lookahead windows keep the transfer discipline (one batched
+        count fetch per window, JAX dispatch stays async)."""
+        if target_rows is None:
+            target_rows = int(self.session.get("probe_coalesce_rows"))
+
+        def gen():
+            import itertools
+            it = stream.iter_pages()
+            buf: List[Page] = []
+            buf_rows = 0
+            while True:
+                window = list(itertools.islice(it, 8))
+                if not window:
+                    break
+                counts = jax.device_get([p.num_rows for p in window])
+                for p, c in zip(window, counts):
+                    n = int(c)
+                    if n == 0:
+                        continue
+                    if n >= target_rows:
+                        yield self._merge_buf([p], n)
+                        continue
+                    buf.append(self._tight(p, n))
+                    buf_rows += n
+                    if buf_rows >= target_rows:
+                        yield self._merge_buf(buf, buf_rows)
+                        buf, buf_rows = [], 0
+            if buf:
+                yield self._merge_buf(buf, buf_rows)
+        return PageStream(gen(), stream.symbols)
+
+    def _merge_buf(self, buf: List[Page], rows: int) -> Page:
+        page = buf[0] if len(buf) == 1 else self._device_concat(buf)
+        return self._tight(page, rows)
 
     def _free_collected(self, page: Optional[Page]) -> None:
         """Release a _collect reservation at operator scope (the reference
@@ -575,6 +641,28 @@ class LocalExecutionPlanner:
                 ("join", tuple(probe_keys), tuple(build_keys), join_kind,
                  cap, post_pred), build)
 
+        n_probe_cols = len(node.left.outputs)
+
+        def unique_ops():
+            probe_op = cached_kernel(
+                ("uprobe", tuple(probe_keys), tuple(build_keys)),
+                lambda: unique_inner_probe(probe_keys, build_keys))
+
+            def build_attach():
+                from trino_tpu.ops.join import attach_build
+                at = attach_build(n_probe_cols)
+                fn = None if post_pred is None else compile_filter(post_pred)
+
+                def run(pre, prepared):
+                    out = at(pre, prepared)
+                    if fn is not None:
+                        out = out.filter(fn(out))
+                    return out
+                return run
+            attach_op = cached_kernel(
+                ("uattach", n_probe_cols, post_pred), build_attach)
+            return probe_op, attach_op
+
         def gen():
             collected = build_page   # only the _collect'ed page was reserved
             bp = build_page
@@ -585,11 +673,41 @@ class LocalExecutionPlanner:
                 bp = self._null_build_page(node.right.outputs)
             try:
                 prepared = self._prepare_build(build_keys, bp)
-                yield from _run_with_overflow(
-                    probe_stream, prepared, join_op, self.page_capacity)
+                coalesced = self._coalesce_stream(probe_stream)
+                if join_kind == JoinType.INNER and \
+                        int(jax.device_get(prepared[7])) <= 1:
+                    # unique build side (primary/dimension key): the
+                    # no-expansion probe + live-size build attach
+                    probe_op, attach_op = unique_ops()
+                    yield from self._run_unique_inner(
+                        coalesced, prepared, probe_op, attach_op)
+                else:
+                    yield from _run_with_overflow(
+                        coalesced, prepared, join_op, self.page_capacity)
             finally:
                 self._free_collected(collected)
         return PageStream(gen(), out_symbols)
+
+    def _run_unique_inner(self, probe_stream, prepared, probe_op,
+                          attach_op) -> Iterator[Page]:
+        """Drive the unique-build INNER fast path: probe+filter kernel per
+        page, batched count fetch, shrink to live size, THEN gather build
+        columns — so the attach gathers run at match count, not probe
+        capacity. No overflow loop: output rows <= probe rows always."""
+        import itertools
+        it = probe_stream if isinstance(probe_stream, Iterator) \
+            else probe_stream.iter_pages()
+        while True:
+            batch = list(itertools.islice(it, 8))
+            if not batch:
+                return
+            results = [probe_op(page, prepared) for page in batch]
+            totals = jax.device_get([t for _, t in results])
+            for (pre, _), total in zip(results, totals):
+                total = int(total)
+                if total == 0:
+                    continue
+                yield attach_op(self._tight(pre, total), prepared)
 
     def _prepare_build(self, build_keys, build_page):
         """Sort the build side ONCE per join (LookupSourceFactory analog) —
@@ -639,7 +757,7 @@ class LocalExecutionPlanner:
                 bp = self._null_build_page(node.right.outputs)
             prepared = self._prepare_build(build_keys, bp)
             matched = jnp.zeros(bp.capacity, dtype=jnp.bool_)
-            it = probe_stream.iter_pages()
+            it = self._coalesce_stream(probe_stream).iter_pages()
             while True:
                 # lookahead-batched overflow resolution (same transfer
                 # discipline as _run_with_overflow: one device_get per
@@ -792,7 +910,8 @@ class LocalExecutionPlanner:
             try:
                 prepared = self._prepare_build(build_keys, bp)
                 yield from _run_with_overflow(
-                    probe_stream, prepared, semi_op, self.page_capacity)
+                    self._coalesce_stream(probe_stream), prepared, semi_op,
+                    self.page_capacity)
             finally:
                 self._free_collected(build_page)
         return PageStream(gen(),
@@ -833,7 +952,8 @@ class LocalExecutionPlanner:
             try:
                 prepared = self._prepare_build(build_keys, bp)
                 yield from _run_with_overflow(
-                    probe_stream, prepared, mark_op, self.page_capacity)
+                    self._coalesce_stream(probe_stream), prepared, mark_op,
+                    self.page_capacity)
             finally:
                 self._free_collected(build_page)
         return PageStream(gen(), out_symbols)

@@ -27,6 +27,11 @@ SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     "page_capacity": 1 << 16,      # rows per device page
     "scan_page_capacity": 1 << 22,  # max rows per scan page (big fused scans)
     "join_broadcast_threshold_rows": 1_000_000,
+    # coalesce filtered probe pages into buffers of ~this many rows before
+    # join probes: a probe kernel has a large fixed cost (sort-engine
+    # passes), so fewer, larger launches win (round-4 profiling: q3 SF10
+    # spent ~23s in 19 per-page probe calls)
+    "probe_coalesce_rows": 1 << 25,
     "distributed_sort": True,
     "enable_dynamic_filtering": True,
     "push_aggregation_through_outer_join": True,

@@ -136,8 +136,14 @@ def prepare_build(build_keys: Sequence[int]):
         next_start = jnp.concatenate(
             [suffix_min[1:], jnp.full((1,), n, dtype=suffix_min.dtype)])
         run_len = (next_start - run_start).astype(jnp.int32)
+        # max duplicate-key run among LIVE build rows: 1 means the build
+        # side is unique (a primary/dimension key) and probes can take the
+        # no-expansion fast path (unique_inner_probe) — the executor
+        # fetches this once per join
+        max_run_live = jnp.max(jnp.where(jnp.arange(n, dtype=jnp.int32)
+                                         < n_live_build, run_len, 0))
         return (build, bkey_s, bperm, n_live_build, n_build_rows,
-                build_has_null, run_len)
+                build_has_null, run_len, max_run_live)
     return prep
 
 
@@ -179,10 +185,11 @@ def hash_join(
     def op(probe: Page, build) -> Tuple[Page, jnp.ndarray]:
         if prepared:
             (build, bkey_s, bperm, n_live_build, n_build_rows,
-             build_has_null, run_len) = build
+             build_has_null, run_len, _max_run) = build
         else:
             (build, bkey_s, bperm, n_live_build, n_build_rows,
-             build_has_null, run_len) = prepare_build(build_keys)(build)
+             build_has_null, run_len, _max_run) = \
+                prepare_build(build_keys)(build)
         n_build = build.capacity
         n_probe = probe.capacity
         n_probe_cols = probe.num_columns
@@ -338,6 +345,78 @@ def hash_join(
                 .at[brow].max(verified_slot, mode="drop")
             return out_page, total, build_matched
         return out_page, total
+
+    return op
+
+
+def unique_inner_probe(
+    probe_keys: Sequence[int],
+    build_keys: Sequence[int],
+    verify_composite: bool = True,
+) -> Callable[[Page, tuple], Tuple[Page, jnp.ndarray]]:
+    """INNER-join probe against a UNIQUE build side (max key run == 1) —
+    the dimension/primary-key case covering every TPC-H/DS fact-to-dim
+    join. No cumsum expansion, no output-slot searchsorted, no
+    capacity-sized gathers (round-4 profiling: those cost ~0.7s per
+    MILLION probe rows in the general kernel):
+
+      searchsorted (sort engine)  ->  found mask
+      ONE stable-sort filter compacting probe cols + matched build-row
+      index carried as a payload column
+
+    Returns (pre_page, match_count): pre_page is probe columns ++ a BIGINT
+    `brow` channel; the executor shrinks it to live size (one count fetch
+    it batches anyway) and then runs attach_build to gather build columns
+    at LIVE size instead of probe capacity. Output can never overflow
+    (<= probe rows), so no capacity re-run loop is needed."""
+    probe_keys = tuple(probe_keys)
+    build_keys = tuple(build_keys)
+    composite = len(probe_keys) > 1
+
+    def op(probe: Page, prepared) -> Tuple[Page, jnp.ndarray]:
+        (build, bkey_s, bperm, n_live_build, n_build_rows,
+         build_has_null, run_len, _max_run) = prepared
+        n_build = build.capacity
+        for pk, bk in zip(probe_keys, build_keys):
+            pd = probe.column(pk).dictionary
+            bd = build.column(bk).dictionary
+            if pd is not None and bd is not None and pd is not bd:
+                raise NotImplementedError(
+                    "string join keys across distinct dictionaries; "
+                    "re-encode to a shared dictionary first")
+        pkey, pnull = _key_u64(probe, probe_keys)
+        p_dead = ~probe.row_mask() | pnull
+        n_build_m1 = jnp.maximum(n_build - 1, 0)
+        lo = jnp.searchsorted(bkey_s, pkey, side="left", method="sort")
+        lo_c = jnp.minimum(lo, n_build_m1)
+        found = (jnp.take(bkey_s, lo_c, mode="clip") == pkey) & \
+            (lo < n_live_build) & ~p_dead
+        brow = jnp.take(bperm, lo_c, mode="clip").astype(jnp.int64)
+        if composite and verify_composite:
+            # unique build: at most one candidate — verify it directly
+            for pk, bk in zip(probe_keys, build_keys):
+                bv = jnp.take(build.column(bk).values, brow, mode="clip")
+                found = found & (probe.column(pk).values == bv)
+        brow_col = Column(brow, None, T.BIGINT, None)
+        pre = Page(tuple(probe.columns) + (brow_col,), probe.num_rows)
+        out = pre.filter(found)
+        return out, out.num_rows.astype(jnp.int64)
+
+    return op
+
+
+def attach_build(n_probe_cols: int) -> Callable[[Page, tuple], Page]:
+    """Second phase of the unique-build fast path: gather build columns at
+    the compacted (live-size) brow indices and restore the probe++build
+    output layout."""
+
+    def op(pre: Page, prepared) -> Page:
+        build = prepared[0]
+        brow = pre.columns[n_probe_cols].values.astype(jnp.int32)
+        live = pre.row_mask()
+        brow = jnp.where(live, brow, 0)
+        bcols = tuple(c.gather(brow) for c in build.columns)
+        return Page(tuple(pre.columns[:n_probe_cols]) + bcols, pre.num_rows)
 
     return op
 

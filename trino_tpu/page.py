@@ -414,3 +414,55 @@ def concat_pages(pages: Sequence[Page]) -> Page:
                 else ref.valid_mask()[:0]
         cols.append(Column(values, valid, ref.type, ref.dictionary))
     return Page(tuple(cols), jnp.asarray(total, dtype=jnp.int32))
+
+
+def device_concat(pages: Sequence[Page]) -> Page:
+    """Concatenate pages ON DEVICE into one page of capacity sum(capacities).
+
+    jit-safe (traced num_rows; static capacities): each page's FULL-capacity
+    column is written with lax.dynamic_update_slice at the running live
+    offset, in page order — page i+1's write starts where page i's live rows
+    end, so it overwrites page i's padding tail; whatever garbage the last
+    page leaves beyond the total live count is ordinary output padding
+    (row_mask never reads it). Pure HBM-bandwidth copies — no host round
+    trip (concat_pages bounces every live row through the host, ~100ms+ on
+    a remote-tunnel device) and no sort pass.
+
+    All pages must share column types/dictionaries (caller contract, same
+    as concat_pages)."""
+    if not pages:
+        raise ValueError("no pages")
+    if len(pages) == 1:
+        return pages[0]
+    ncols = pages[0].num_columns
+    for ci in range(ncols):
+        ref = pages[0].column(ci)
+        if any(p.column(ci).dictionary is not ref.dictionary for p in pages):
+            raise ValueError(
+                f"column {ci}: pages use different dictionaries; re-encode "
+                "to a shared dictionary before concatenating")
+    out_cap = sum(p.capacity for p in pages)
+    counts = [p.num_rows.astype(jnp.int64) for p in pages]
+    offs = []
+    off = jnp.int64(0)
+    for c in counts:
+        offs.append(off)
+        off = off + c
+    total = off
+    needs_valid = [any(p.column(ci).valid is not None for p in pages)
+                   for ci in range(ncols)]
+    cols = []
+    for ci in range(ncols):
+        ref = pages[0].column(ci)
+        out = jnp.zeros(out_cap, dtype=ref.values.dtype)
+        for p, o in zip(pages, offs):
+            out = jax.lax.dynamic_update_slice(out, p.column(ci).values,
+                                               (o,))
+        valid = None
+        if needs_valid[ci]:
+            valid = jnp.zeros(out_cap, dtype=jnp.bool_)
+            for p, o in zip(pages, offs):
+                valid = jax.lax.dynamic_update_slice(
+                    valid, p.column(ci).valid_mask(), (o,))
+        cols.append(Column(out, valid, ref.type, ref.dictionary))
+    return Page(tuple(cols), total.astype(jnp.int32))
