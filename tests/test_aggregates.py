@@ -234,3 +234,74 @@ def test_min_by_distinct_rejected(runner):
     with pytest.raises(Exception):
         runner.execute("SELECT min_by(DISTINCT n_name, n_nationkey) "
                        "FROM nation")
+
+
+# ------------------------------------------------- sketch aggregates (r4)
+
+def test_approx_distinct_accuracy(runner):
+    # HLL m=2048 -> 2.30% standard error; orders.o_custkey at tiny has
+    # ~1000 distinct customers with orders
+    exact = runner.execute(
+        "SELECT count(DISTINCT o_custkey) FROM orders").only_value()
+    approx = runner.execute(
+        "SELECT approx_distinct(o_custkey) FROM orders").only_value()
+    assert abs(approx - exact) <= max(3 * 0.023 * exact, 2), (approx, exact)
+
+
+def test_approx_distinct_grouped(runner):
+    rows = runner.execute(
+        "SELECT o_orderpriority, approx_distinct(o_custkey), "
+        "count(DISTINCT o_custkey) FROM orders "
+        "GROUP BY o_orderpriority").rows
+    assert len(rows) == 5
+    for _, approx, exact in rows:
+        assert abs(approx - exact) <= max(3 * 0.023 * exact, 2)
+
+
+def test_approx_distinct_small_exact(runner):
+    # linear-counting range: tiny cardinalities must be near-exact
+    v = runner.execute(
+        "SELECT approx_distinct(n_regionkey) FROM nation").only_value()
+    assert v == 5
+    v = runner.execute(
+        "SELECT approx_distinct(n_nationkey) FROM nation").only_value()
+    assert v == 25
+
+
+def test_approx_distinct_empty_and_null(runner):
+    v = runner.execute("SELECT approx_distinct(n_nationkey) FROM nation "
+                       "WHERE n_nationkey < 0").only_value()
+    assert v == 0
+
+
+def test_approx_percentile(runner):
+    # exact nearest-rank at single step
+    rows = runner.execute(
+        "SELECT approx_percentile(o_totalprice, 0.5e0), "
+        "approx_percentile(o_totalprice, 0.9e0) FROM orders").rows
+    med, p90 = rows[0]
+    exact = runner.execute(
+        "SELECT o_totalprice FROM orders ORDER BY o_totalprice").rows
+    vals = [r[0] for r in exact]
+    n = len(vals)
+    import math
+    assert med == vals[max(1, math.ceil(0.5 * n)) - 1]
+    assert p90 == vals[max(1, math.ceil(0.9 * n)) - 1]
+
+
+def test_approx_percentile_grouped(runner):
+    rows = runner.execute(
+        "SELECT o_orderpriority, approx_percentile(o_totalprice, 0.5e0) "
+        "FROM orders GROUP BY o_orderpriority ORDER BY 1").rows
+    assert len(rows) == 5 and all(r[1] is not None for r in rows)
+
+
+def test_checksum(runner):
+    a = runner.execute("SELECT checksum(n_nationkey) FROM nation").only_value()
+    # order-independent: same value regardless of scan order
+    b = runner.execute("SELECT checksum(k) FROM (SELECT n_nationkey AS k "
+                       "FROM nation ORDER BY n_name)").only_value()
+    assert a == b and a != 0
+    c = runner.execute("SELECT checksum(n_nationkey) FROM nation "
+                       "WHERE n_nationkey < 0").only_value()
+    assert c is None        # ChecksumAggregationFunction: NULL on empty

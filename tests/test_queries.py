@@ -787,3 +787,58 @@ def test_nth_value_nonpositive_rejected(outer_runner):
         outer_runner.execute(
             "SELECT nth_value(a, 0) OVER (ORDER BY k) "
             "FROM memory.default.lft")
+
+
+def test_dynamic_filtering_matches_disabled(runner, oracle):
+    # build-side key range prefilter must not change INNER join results
+    sql = ("SELECT o_orderkey, o_totalprice FROM orders, customer "
+           "WHERE o_custkey = c_custkey AND c_custkey BETWEEN 40 AND 55")
+    runner.execute("SET SESSION enable_dynamic_filtering = false")
+    try:
+        off = runner.execute(sql).rows
+    finally:
+        runner.execute("RESET SESSION enable_dynamic_filtering")
+    on = runner.execute(sql).rows
+    assert sorted(off) == sorted(on)
+    cur = oracle.execute(sql)
+    assert_same(on, cur.fetchall(), ordered=False)
+
+
+def test_spilled_join_matches_inmemory(runner, oracle):
+    # force the spill path (build keys only in HBM, host-side attach)
+    sql = ("SELECT o_orderkey, c_name FROM orders, customer "
+           "WHERE o_custkey = c_custkey AND o_orderkey <= 100")
+    runner.execute("SET SESSION join_spill_threshold_bytes = 1024")
+    try:
+        spilled = runner.execute(sql).rows
+    finally:
+        runner.execute("RESET SESSION join_spill_threshold_bytes")
+    normal = runner.execute(sql).rows
+    assert sorted(spilled) == sorted(normal)
+    assert_same(spilled, oracle.execute(sql).fetchall(), ordered=False)
+
+
+def test_spilled_composite_key_join(runner, oracle):
+    sql = ("SELECT l_orderkey, l_linenumber, ps_availqty "
+           "FROM lineitem, partsupp "
+           "WHERE l_partkey = ps_partkey AND l_suppkey = ps_suppkey "
+           "AND l_orderkey <= 40")
+    runner.execute("SET SESSION join_spill_threshold_bytes = 1024")
+    try:
+        spilled = runner.execute(sql).rows
+    finally:
+        runner.execute("RESET SESSION join_spill_threshold_bytes")
+    assert_same(spilled, oracle.execute(sql).fetchall(), ordered=False)
+
+
+def test_spilled_nonunique_build_falls_back(runner, oracle):
+    # build side (lineitem keyed by l_orderkey) has duplicate keys: the
+    # spill path must detect it and fall back to the expansion kernel
+    sql = ("SELECT o_orderkey, l_linenumber FROM orders, lineitem "
+           "WHERE o_orderkey = l_orderkey AND o_orderkey <= 30")
+    runner.execute("SET SESSION join_spill_threshold_bytes = 1024")
+    try:
+        spilled = runner.execute(sql).rows
+    finally:
+        runner.execute("RESET SESSION join_spill_threshold_bytes")
+    assert_same(spilled, oracle.execute(sql).fetchall(), ordered=False)

@@ -1,0 +1,52 @@
+"""system.runtime introspection tables.
+
+Reference parity: connector/system/ (GlobalSystemConnector,
+QuerySystemTable, NodeSystemTable) + execution/QueryTracker.java states.
+"""
+
+from trino_tpu.exec import LocalQueryRunner
+
+
+def test_runtime_queries_shows_current_and_past():
+    r = LocalQueryRunner.tpch("tiny")
+    r.execute("SELECT count(*) FROM nation")
+    rows = r.execute(
+        "SELECT query_id, state, query, rows FROM system.runtime.queries "
+        "ORDER BY query_id").rows
+    states = {row[2]: row[1] for row in rows}
+    assert states.get("SELECT count(*) FROM nation") == "FINISHED"
+    # the introspection query itself is RUNNING while it scans the table
+    running = [row for row in rows if row[1] == "RUNNING"]
+    assert len(running) == 1
+    assert "system.runtime.queries" in running[0][2]
+    finished = [row for row in rows if row[2].startswith("SELECT count")]
+    assert finished[0][3] == 1     # one result row recorded
+
+
+def test_runtime_queries_records_failure():
+    r = LocalQueryRunner.tpch("tiny")
+    try:
+        r.execute("SELECT * FROM tpch.tiny.nonexistent_table")
+    except Exception:
+        pass
+    rows = r.execute(
+        "SELECT state, error FROM system.runtime.queries "
+        "WHERE query LIKE '%nonexistent_table%' AND state = 'FAILED'").rows
+    assert rows and rows[0][1] is not None
+
+
+def test_runtime_nodes_and_tasks():
+    r = LocalQueryRunner.tpch("tiny")
+    nodes = r.execute("SELECT node_id, coordinator, state "
+                      "FROM system.runtime.nodes").rows
+    assert nodes and any(n[1] for n in nodes)
+    assert all(n[2] == "active" for n in nodes)
+    tasks = r.execute("SELECT query_id, task_id, state "
+                      "FROM system.runtime.tasks").rows
+    assert tasks
+
+
+def test_show_tables_system():
+    r = LocalQueryRunner.tpch("tiny")
+    rows = r.execute("SHOW TABLES FROM system.runtime").rows
+    assert ("queries",) in rows and ("nodes",) in rows

@@ -1,0 +1,129 @@
+"""system catalog: runtime introspection tables.
+
+Reference parity: core/trino-main connector/system/ —
+system.runtime.{queries,tasks,nodes} backed by live engine state
+(GlobalSystemConnector + QuerySystemTable/TaskSystemTable/NodeSystemTable).
+Tables materialize a snapshot page at scan time from the process-wide
+QueryTracker and the JAX device topology (the node inventory of a
+single-controller TPU engine is its device list, not a discovery service).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.connector.spi import (
+    ColumnHandle, ColumnMetadata, Connector, ConnectorMetadata,
+    ConnectorPageSource, ConnectorSplitManager, ConnectorTableHandle,
+    SchemaTableName, Split, TableMetadata, TableStatistics)
+from trino_tpu.page import Column, Dictionary, Page
+
+TABLES: Dict[str, tuple] = {
+    "queries": (
+        ("query_id", T.VarcharType()), ("state", T.VarcharType()),
+        ("user", T.VarcharType()), ("query", T.VarcharType()),
+        ("rows", T.BIGINT), ("wall_ms", T.BIGINT),
+        ("error", T.VarcharType())),
+    "tasks": (
+        ("query_id", T.VarcharType()), ("task_id", T.VarcharType()),
+        ("state", T.VarcharType()), ("rows", T.BIGINT),
+        ("wall_ms", T.BIGINT)),
+    "nodes": (
+        ("node_id", T.VarcharType()), ("node_version", T.VarcharType()),
+        ("coordinator", T.BOOLEAN), ("state", T.VarcharType())),
+}
+
+
+def _rows_for(table: str) -> List[tuple]:
+    from trino_tpu.exec.query_tracker import TRACKER
+    if table == "queries":
+        return [(q.query_id, q.state, q.user, q.query, q.rows,
+                 q.wall_ms if q.wall_ms is not None else 0, q.error)
+                for q in TRACKER.list()]
+    if table == "tasks":
+        # single-controller engine: one task per query (the mesh's shards
+        # are lanes inside one program, not separately tracked tasks)
+        return [(q.query_id, f"{q.query_id}.0.0", q.state, q.rows,
+                 q.wall_ms if q.wall_ms is not None else 0)
+                for q in TRACKER.list()]
+    if table == "nodes":
+        import jax
+        try:
+            devices = jax.devices()
+        except Exception:
+            devices = []
+        return [(f"{d.platform}-{d.id}", jax.__version__, d.id == 0,
+                 "active") for d in devices]
+    raise KeyError(table)
+
+
+class SystemMetadata(ConnectorMetadata):
+    def list_schemas(self) -> List[str]:
+        return ["runtime"]
+
+    def list_tables(self, schema: Optional[str] = None
+                    ) -> List[SchemaTableName]:
+        return [SchemaTableName("runtime", t) for t in sorted(TABLES)]
+
+    def get_table_handle(self, name: SchemaTableName
+                         ) -> Optional[ConnectorTableHandle]:
+        if name.schema == "runtime" and name.table in TABLES:
+            return ConnectorTableHandle(name)
+        return None
+
+    def get_table_metadata(self, handle: ConnectorTableHandle
+                           ) -> TableMetadata:
+        cols = tuple(ColumnMetadata(n, ty)
+                     for n, ty in TABLES[handle.name.table])
+        return TableMetadata(handle.name, cols)
+
+    def get_table_statistics(self, handle: ConnectorTableHandle
+                             ) -> TableStatistics:
+        return TableStatistics(float(len(_rows_for(handle.name.table))))
+
+
+class SystemSplitManager(ConnectorSplitManager):
+    def get_splits(self, handle: ConnectorTableHandle,
+                   target_splits: int = 1) -> List[Split]:
+        return [Split(handle, 0, 1, host=0)]
+
+
+class SystemPageSource(ConnectorPageSource):
+    def pages(self, split: Split, columns: Sequence[ColumnHandle],
+              page_capacity: int) -> Iterator[Page]:
+        table = split.table.name.table
+        rows = _rows_for(table)
+        n = len(rows)
+        cap = max(8, 1 << max(3, (n - 1).bit_length()) if n else 8)
+        cols = []
+        spec = TABLES[table]
+        for ch in columns:
+            pos = next(i for i, (nm, _) in enumerate(spec) if nm == ch.name)
+            vals = [r[pos] for r in rows]
+            if T.is_string(ch.type):
+                d, codes = Dictionary.build(np.asarray(
+                    [v if v is not None else "" for v in vals] or [""],
+                    dtype=object))
+                arr = np.zeros(cap, dtype=np.int32)
+                arr[:n] = codes[:n]
+                valid = None
+                if any(v is None for v in vals):
+                    va = np.zeros(cap, dtype=bool)
+                    va[:n] = [v is not None for v in vals]
+                    valid = va
+                cols.append(Column.from_numpy(arr, ch.type, valid=valid,
+                                              dictionary=d))
+            else:
+                dt = T.to_numpy_dtype(ch.type)
+                arr = np.zeros(cap, dtype=dt)
+                arr[:n] = [0 if v is None else v for v in vals]
+                cols.append(Column.from_numpy(arr, ch.type))
+        yield Page(tuple(cols), n)
+
+
+def create_connector() -> Connector:
+    return Connector("system", SystemMetadata(), SystemSplitManager(),
+                     SystemPageSource())

@@ -602,10 +602,266 @@ _TABLE_CACHE: Dict[tuple, Dict[str, np.ndarray]] = {}
 _DICT_CACHE: Dict[tuple, Dictionary] = {}
 
 
+# --------------------------------------------------------------------------
+# chunked fact streams (round 4): the big tables become stateless
+# counter-hash column streams (the tpch_gen design — any column, any row
+# range, identical bytes everywhere), which is what makes SF100 q64/q72
+# runnable: store_sales SF100 is 288M rows and a scan materializes only the
+# columns it reads, chunk by chunk, with no sequential RNG state. The
+# dimension tables keep the materialized generator (small).
+
+from trino_tpu.connector import tpch_gen as _HG
+
+_CHUNKED = {"store_sales", "store_returns", "catalog_sales",
+            "catalog_returns", "inventory", "customer_demographics"}
+
+
+def _hui(table, col, sf, idx, lo, hi):
+    return _HG._ui("tpcds." + table, col, sf, idx, lo, hi)
+
+
+def _hu64(table, col, sf, idx):
+    return _HG._u64("tpcds." + table, col, sf, idx)
+
+
+def _ss_col(sf, col, idx, c):
+    t = "store_sales"
+    if col == "ss_sold_date_sk":
+        return _hui(t, col, sf, idx, _SALES_MIN, _SALES_MAX)
+    if col == "ss_item_sk":
+        return _hui(t, col, sf, idx, 1, c["item"])
+    if col == "ss_customer_sk":
+        return _hui(t, col, sf, idx, 1, c["customer"])
+    if col == "ss_cdemo_sk":
+        return _hui(t, col, sf, idx, 1, c["customer_demographics"])
+    if col == "ss_hdemo_sk":
+        return _hui(t, col, sf, idx, 1, 7200)
+    if col == "ss_addr_sk":
+        return _hui(t, col, sf, idx, 1, c["customer_address"])
+    if col == "ss_store_sk":
+        return _hui(t, col, sf, idx, 1, c["store"])
+    if col == "ss_promo_sk":
+        return _hui(t, col, sf, idx, 1, c["promotion"])
+    if col == "ss_ticket_number":
+        return idx.astype(np.int64) // 4 + 1
+    if col == "ss_quantity":
+        return _hui(t, "ss_quantity", sf, idx, 1, 100)
+    qty = _hui(t, "ss_quantity", sf, idx, 1, 100)
+    wholesale = _hui(t, "ss_wholesale", sf, idx, 100, 8999)
+    lp = wholesale * _hui(t, "ss_lp", sf, idx, 110, 219) // 100
+    sp = lp * _hui(t, "ss_sp", sf, idx, 30, 100) // 100
+    if col == "ss_wholesale_cost":
+        return wholesale
+    if col == "ss_list_price":
+        return lp
+    if col == "ss_sales_price":
+        return sp
+    if col == "ss_ext_discount_amt":
+        return (lp - sp) * qty
+    if col == "ss_ext_sales_price":
+        return sp * qty
+    if col == "ss_ext_wholesale_cost":
+        return wholesale * qty
+    if col == "ss_ext_list_price":
+        return lp * qty
+    if col == "ss_coupon_amt":
+        disc = (lp - sp) * qty
+        return np.where(_hu64(t, "ss_coupon", sf, idx)
+                        % np.uint64(1000) < 200, disc // 2, 0)
+    if col == "ss_net_paid":
+        return sp * qty
+    if col == "ss_net_profit":
+        return (sp - wholesale) * qty
+    raise KeyError(col)
+
+
+def _cs_col(sf, col, idx, c):
+    t = "catalog_sales"
+    if col == "cs_sold_date_sk":
+        return _hui(t, col, sf, idx, _SALES_MIN, _SALES_MAX)
+    if col == "cs_ship_date_sk":
+        return _hui(t, "cs_sold_date_sk", sf, idx, _SALES_MIN, _SALES_MAX) \
+            + _hui(t, "cs_ship_delay", sf, idx, 2, 89)
+    if col == "cs_bill_customer_sk":
+        return _hui(t, col, sf, idx, 1, c["customer"])
+    if col == "cs_bill_cdemo_sk":
+        return _hui(t, col, sf, idx, 1, c["customer_demographics"])
+    if col == "cs_bill_hdemo_sk":
+        return _hui(t, col, sf, idx, 1, 7200)
+    if col == "cs_bill_addr_sk":
+        return _hui(t, col, sf, idx, 1, c["customer_address"])
+    if col == "cs_warehouse_sk":
+        return _hui(t, col, sf, idx, 1, c["warehouse"])
+    if col == "cs_item_sk":
+        return _hui(t, col, sf, idx, 1, c["item"])
+    if col == "cs_promo_sk":
+        return _hui(t, col, sf, idx, 1, c["promotion"])
+    if col == "cs_order_number":
+        return idx.astype(np.int64) // 3 + 1
+    if col == "cs_quantity":
+        return _hui(t, "cs_quantity", sf, idx, 1, 100)
+    qty = _hui(t, "cs_quantity", sf, idx, 1, 100)
+    wholesale = _hui(t, "cs_wholesale", sf, idx, 100, 8999)
+    lp = wholesale * _hui(t, "cs_lp", sf, idx, 110, 219) // 100
+    sp = lp * _hui(t, "cs_sp", sf, idx, 30, 100) // 100
+    if col == "cs_wholesale_cost":
+        return wholesale
+    if col == "cs_list_price":
+        return lp
+    if col == "cs_sales_price":
+        return sp
+    if col == "cs_ext_discount_amt":
+        return (lp - sp) * qty
+    if col == "cs_ext_sales_price":
+        return sp * qty
+    if col == "cs_ext_wholesale_cost":
+        return wholesale * qty
+    if col == "cs_ext_list_price":
+        return lp * qty
+    if col == "cs_net_paid":
+        return sp * qty
+    if col == "cs_net_profit":
+        return (sp - wholesale) * qty
+    raise KeyError(col)
+
+
+def _returns_rowmap(table: str, sf: float, idx: np.ndarray) -> np.ndarray:
+    """Return row j references sale row j*10 + jitter — a deterministic
+    injective pick (stride 10 > jitter range), the seekable replacement
+    for rng.choice(replace=False), so every return matches a real sale
+    (q64's ss JOIN sr on ticket+item needs real pairs)."""
+    jitter = (_hu64(table, "pick", sf, idx) % np.uint64(10)).astype(np.int64)
+    return idx.astype(np.int64) * 10 + jitter
+
+
+def _sr_col(sf, col, idx, c):
+    t = "store_returns"
+    r = _returns_rowmap(t, sf, idx).astype(np.uint64)
+    if col == "sr_returned_date_sk":
+        return _ss_col(sf, "ss_sold_date_sk", r, c) \
+            + _hui(t, "sr_delay", sf, idx, 1, 59)
+    if col == "sr_return_quantity":
+        return _hui(t, col, sf, idx, 1, 49)
+    if col == "sr_return_amt":
+        qty = _ss_col(sf, "ss_quantity", r, c)
+        mult = 1 + (_hu64(t, "sr_amt", sf, idx)
+                    % qty.astype(np.uint64)).astype(np.int64)
+        return _ss_col(sf, "ss_sales_price", r, c) * mult
+    if col == "sr_net_loss":
+        return _sr_col(sf, "sr_return_amt", idx, c) // 2
+    mapping = {"sr_item_sk": "ss_item_sk", "sr_customer_sk":
+               "ss_customer_sk", "sr_cdemo_sk": "ss_cdemo_sk",
+               "sr_hdemo_sk": "ss_hdemo_sk", "sr_addr_sk": "ss_addr_sk",
+               "sr_store_sk": "ss_store_sk",
+               "sr_ticket_number": "ss_ticket_number"}
+    if col in mapping:
+        return _ss_col(sf, mapping[col], r, c)
+    raise KeyError(col)
+
+
+def _cr_col(sf, col, idx, c):
+    t = "catalog_returns"
+    r = _returns_rowmap(t, sf, idx).astype(np.uint64)
+    if col == "cr_returned_date_sk":
+        return _cs_col(sf, "cs_sold_date_sk", r, c) \
+            + _hui(t, "cr_delay", sf, idx, 1, 59)
+    if col == "cr_return_quantity":
+        return _hui(t, col, sf, idx, 1, 49)
+    if col == "cr_return_amount":
+        return _cs_col(sf, "cs_sales_price", r, c) \
+            * _hui(t, "cr_amt", sf, idx, 1, 19)
+    if col == "cr_refunded_cash":
+        return _cr_col(sf, "cr_return_amount", idx, c) // 2
+    mapping = {"cr_item_sk": "cs_item_sk",
+               "cr_order_number": "cs_order_number"}
+    if col in mapping:
+        return _cs_col(sf, mapping[col], r, c)
+    raise KeyError(col)
+
+
+def _inv_col(sf, col, idx, c):
+    n_items = c["item"]
+    n_wh = c["warehouse"]
+    per_week = n_items * n_wh
+    i = idx.astype(np.int64)
+    if col == "inv_date_sk":
+        return _SALES_MIN + 7 * (i // per_week)
+    if col == "inv_warehouse_sk":
+        return (i % per_week) // n_items + 1
+    if col == "inv_item_sk":
+        return i % n_items + 1
+    if col == "inv_quantity_on_hand":
+        return _hui("inventory", col, sf, idx, 0, 999)
+    raise KeyError(col)
+
+
+def _cd_col(sf, col, idx, c):
+    seq = idx.astype(np.int64)
+    if col == "cd_demo_sk":
+        return seq + 1
+    if col == "cd_purchase_estimate":
+        return (seq // 70) % 20 * 500 + 500
+    if col == "cd_dep_count":
+        return (seq // 5600) % 7
+    raise KeyError(col)   # string columns handled via pools below
+
+
+_CD_POOLS = {
+    "cd_gender": (["M", "F"], lambda seq: seq % 2),
+    "cd_marital_status": (["M", "S", "D", "W", "U"],
+                          lambda seq: (seq // 2) % 5),
+}
+
+
+def chunk_numeric(table: str, sf: float, col: str, start: int,
+                  end: int) -> np.ndarray:
+    c = _row_counts(sf)
+    idx = np.arange(start, end, dtype=np.uint64)
+    fn = {"store_sales": _ss_col, "catalog_sales": _cs_col,
+          "store_returns": _sr_col, "catalog_returns": _cr_col,
+          "inventory": _inv_col, "customer_demographics": _cd_col}[table]
+    out = fn(sf, col, idx, c)
+    return np.asarray(out, dtype=np.int64)
+
+
+def chunk_string(table: str, sf: float, col: str, start: int, end: int):
+    """(codes int32, sorted pool) for a chunked table's pooled varchar."""
+    seq = np.arange(start, end, dtype=np.int64)
+    if table == "customer_demographics":
+        if col in _CD_POOLS:
+            pool, pick = _CD_POOLS[col]
+        elif col == "cd_education_status":
+            pool, pick = _EDUCATION, lambda s: (s // 10) % len(_EDUCATION)
+        elif col == "cd_credit_rating":
+            pool, pick = _CREDIT, lambda s: (s // 1400) % len(_CREDIT)
+        else:
+            raise KeyError(col)
+        arr = np.asarray(pool, dtype=object)
+        sorted_vals, inv = np.unique(arr, return_inverse=True)
+        return inv.astype(np.int32)[pick(seq)], sorted_vals
+    raise KeyError((table, col))
+
+
+def _chunked_get_table(table: str, sf: float) -> Dict[str, np.ndarray]:
+    """Materialize a chunked table fully (oracle loading at tiny SF)."""
+    n = table_row_count(table, sf)
+    out = {}
+    for name, typ in TABLES[table][0]:
+        if T.is_string(typ):
+            codes, pool = chunk_string(table, sf, name, 0, n)
+            out[name] = pool[codes]
+        else:
+            out[name] = chunk_numeric(table, sf, name, 0, n)
+    return out
+
+
 def get_table(table: str, sf: float) -> Dict[str, np.ndarray]:
     key = (table, round(sf * 1000))
     if key not in _TABLE_CACHE:
-        _TABLE_CACHE[key] = _gen_table(table, sf)
+        if table in _CHUNKED:
+            _TABLE_CACHE[key] = _chunked_get_table(table, sf)
+        else:
+            _TABLE_CACHE[key] = _gen_table(table, sf)
     return _TABLE_CACHE[key]
 
 
@@ -624,8 +880,12 @@ def table_row_count(table: str, sf: float) -> int:
 def table_dictionary(table: str, sf: float, column: str) -> Dictionary:
     key = (table, round(sf * 1000), column)
     if key not in _DICT_CACHE:
-        data = get_table(table, sf)[column]
-        _DICT_CACHE[key] = Dictionary.build(data)[0]
+        if table in _CHUNKED:
+            _, pool = chunk_string(table, sf, column, 0, 1)
+            _DICT_CACHE[key] = Dictionary(pool)
+        else:
+            data = get_table(table, sf)[column]
+            _DICT_CACHE[key] = Dictionary.build(data)[0]
     return _DICT_CACHE[key]
 
 
@@ -693,23 +953,38 @@ class TpcdsPageSource(ConnectorPageSource):
         start, end = split_range(total, split.part, split.total_parts)
         if handle.limit is not None:
             end = min(end, start + handle.limit)
-        data = get_table(table, sf)
+        chunked = table in _CHUNKED
+        data = None if chunked else get_table(table, sf)
+        from trino_tpu.connector.tpch import _host_cached
         for off in range(start, end, page_capacity):
             hi = min(off + page_capacity, end)
             n = hi - off
             cols = []
             for ch in columns:
-                raw = data[ch.name][off:hi]
+                hkey = ("tpcds", table, round(sf * 1000), ch.name, off, hi)
                 if T.is_string(ch.type):
                     d = table_dictionary(table, sf, ch.name)
-                    codes = pad_to_capacity(d.encode(raw), page_capacity, 0)
-                    cols.append(Column.from_numpy(codes, ch.type,
-                                                  dictionary=d))
+                    if chunked:
+                        codes = _host_cached(hkey, lambda: chunk_string(
+                            table, sf, ch.name, off, hi)[0])
+                    else:
+                        codes = _host_cached(hkey, lambda: d.encode(
+                            data[ch.name][off:hi]))
+                    cols.append(Column.from_numpy(
+                        pad_to_capacity(codes, page_capacity, 0), ch.type,
+                        dictionary=d))
                 else:
-                    arr = pad_to_capacity(
-                        np.asarray(raw, T.to_numpy_dtype(ch.type)),
-                        page_capacity, 0)
-                    cols.append(Column.from_numpy(arr, ch.type))
+                    if chunked:
+                        arr = _host_cached(hkey, lambda: np.asarray(
+                            chunk_numeric(table, sf, ch.name, off, hi),
+                            T.to_numpy_dtype(ch.type)))
+                    else:
+                        # materialized tables: slicing is free — caching
+                        # would duplicate _TABLE_CACHE bytes in the LRU
+                        arr = np.asarray(data[ch.name][off:hi],
+                                         T.to_numpy_dtype(ch.type))
+                    cols.append(Column.from_numpy(
+                        pad_to_capacity(arr, page_capacity, 0), ch.type))
             yield Page(tuple(cols), n)
 
 

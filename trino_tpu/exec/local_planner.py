@@ -365,27 +365,61 @@ class LocalExecutionPlanner:
         return op(*pages)
 
     def _coalesce_stream(self, stream: PageStream,
-                         target_rows: Optional[int] = None) -> PageStream:
+                         target_rows: Optional[int] = None,
+                         prefilter=None) -> PageStream:
         """Batch filtered pages into few large buffers before a probe.
 
         A probe kernel launch has a large fixed cost (sort-engine passes at
         static capacity, regardless of live rows): round-4 profiling showed
         q3 SF10 paying ~23s across 19 per-page probe calls on ~2M-live
         pages. Lookahead windows keep the transfer discipline (one batched
-        count fetch per window, JAX dispatch stays async)."""
+        count fetch per window, JAX dispatch stays async).
+
+        `prefilter` is an optional (op, args) dynamic filter (build-side
+        key range) applied per page BEFORE buffering; it is adaptive — if
+        the first window prunes less than 25% of rows, the filter is
+        dropped for the rest of the stream (its compaction sort would only
+        add cost on uniformly-spread keys)."""
         if target_rows is None:
             target_rows = int(self.session.get("probe_coalesce_rows"))
+        row_bytes = 8 * max(len(stream.symbols), 1)
+        # cap a buffer at ~512MB regardless of width: the probe's stable
+        # sort carries every column as payload, and a wider buffer's sort
+        # scratch is what exhausted the device at SF100 (measured: 21M-row
+        # x 11-operand sorts fail, 4M-row buffers stream 600M rows fine)
+        target_rows = max(1 << 16, min(target_rows, (1 << 29) // row_bytes))
 
         def gen():
             import itertools
             it = stream.iter_pages()
             buf: List[Page] = []
             buf_rows = 0
+            use_df = prefilter is not None
+            df_measured = False
             while True:
                 window = list(itertools.islice(it, 8))
                 if not window:
                     break
-                counts = jax.device_get([p.num_rows for p in window])
+                if use_df:
+                    pf_op, pf_args = prefilter
+                    filtered = [pf_op(p, *pf_args) for p in window]
+                    if not df_measured:
+                        pre = jax.device_get(
+                            [p.num_rows for p in window])
+                        post = jax.device_get(
+                            [p.num_rows for p in filtered])
+                        df_measured = True
+                        if sum(int(c) for c in post) > 0.75 * max(
+                                sum(int(c) for c in pre), 1):
+                            use_df = False   # not selective enough
+                        window = filtered
+                        counts = post
+                    else:
+                        window = filtered
+                        counts = jax.device_get(
+                            [p.num_rows for p in window])
+                else:
+                    counts = jax.device_get([p.num_rows for p in window])
                 for p, c in zip(window, counts):
                     n = int(c)
                     if n == 0:
@@ -514,7 +548,7 @@ class LocalExecutionPlanner:
         cols = []
         for (sym, call), spec in zip(node.aggregations, specs):
             typ = sym.type
-            if call.name in ("count", "count_if"):
+            if call.name in ("count", "count_if", "approx_distinct"):
                 cols.append(Column(jnp.zeros(8, typ.dtype), None, typ, None))
             else:
                 cols.append(Column(jnp.zeros(8, typ.dtype),
@@ -671,9 +705,35 @@ class LocalExecutionPlanner:
                     return
                 # LEFT join with empty build: emit null-extended probe rows
                 bp = self._null_build_page(node.right.outputs)
+            from trino_tpu.exec.memory import page_bytes
+            if join_kind == JoinType.INNER and build_page is not None and \
+                    self.session.get("spill_enabled") and \
+                    page_bytes(build_page) > int(self.session.get(
+                        "join_spill_threshold_bytes")):
+                yield from self._run_spilled_inner(
+                    probe_stream, build_page, probe_keys, build_keys,
+                    post_pred, n_probe_cols, join_op)
+                return
             try:
                 prepared = self._prepare_build(build_keys, bp)
-                coalesced = self._coalesce_stream(probe_stream)
+                prefilter = None
+                if join_kind == JoinType.INNER and \
+                        self.session.get("enable_dynamic_filtering") and \
+                        not T.is_string(
+                            probe_stream.symbols[probe_keys[0]].type):
+                    # dynamic filtering: build-side key range -> probe-side
+                    # scan prefilter (first join key bounds any composite)
+                    from trino_tpu.ops.join import (build_key_bounds,
+                                                    range_prefilter)
+                    bounds_op = cached_kernel(
+                        ("dfbounds", build_keys[0]),
+                        lambda: build_key_bounds(build_keys))
+                    pf_op = cached_kernel(
+                        ("dfrange", probe_keys[0]),
+                        lambda: range_prefilter(probe_keys[0]))
+                    prefilter = (pf_op, bounds_op(bp))
+                coalesced = self._coalesce_stream(probe_stream,
+                                                  prefilter=prefilter)
                 if join_kind == JoinType.INNER and \
                         int(jax.device_get(prepared[7])) <= 1:
                     # unique build side (primary/dimension key): the
@@ -688,19 +748,106 @@ class LocalExecutionPlanner:
                 self._free_collected(collected)
         return PageStream(gen(), out_symbols)
 
+    def _run_spilled_inner(self, probe_stream, build_page,
+                           probe_keys, build_keys, post_pred,
+                           n_probe_cols, fallback_join_op) -> Iterator[Page]:
+        """Spill-mode INNER join (HashBuilderOperator spill states +
+        SpillingJoinProcessor analog): sort the build keys on device, move
+        the build's payload columns to HOST RAM, keep only (sorted keys,
+        permutation) in HBM (~12B/row), probe streams against the key
+        array, and gather build columns host-side at match count. Falls
+        back to the in-memory path for duplicate-key builds (rare for the
+        >threshold case: big builds are fact/dimension primary keys)."""
+        from trino_tpu.exec.memory import page_bytes
+        from trino_tpu.ops.join import (attach_build_host,
+                                        prepare_build_spilled,
+                                        spilled_unique_probe)
+        # varchar join keys compare by per-dictionary code — the spilled
+        # probe never sees the build dictionaries, so it cannot apply the
+        # shared-dictionary guard the in-memory kernels enforce; route
+        # string-keyed builds through the in-memory path (which verifies)
+        string_keyed = any(
+            build_page.columns[bk].dictionary is not None
+            for bk in build_keys)
+        if not string_keyed:
+            try:
+                prep = cached_kernel(
+                    ("spill-prep", tuple(build_keys)),
+                    lambda: prepare_build_spilled(build_keys))
+                bkey_s, bperm, n_live, n_rows_d, has_null, is_unique_d = \
+                    prep(build_page)
+                is_unique = bool(jax.device_get(is_unique_d))
+                n_rows = int(jax.device_get(n_rows_d))
+            except Exception:
+                self._free_collected(build_page)
+                raise
+        if string_keyed or not is_unique:
+            # duplicate keys need the expansion kernel; run in-memory
+            try:
+                prepared = self._prepare_build(build_keys, build_page)
+                yield from _run_with_overflow(
+                    self._coalesce_stream(probe_stream), prepared,
+                    fallback_join_op, self.page_capacity)
+            finally:
+                self._free_collected(build_page)
+            return
+        # move payload columns to host, free the device page
+        try:
+            host_cols = []
+            fetch = []
+            for c in build_page.columns:
+                fetch.append(c.values[:max(n_rows, 1)])
+                fetch.append(None if c.valid is None
+                             else c.valid[:max(n_rows, 1)])
+            got = jax.device_get([f for f in fetch if f is not None])
+        except Exception:
+            self._free_collected(build_page)
+            raise
+        it = iter(got)
+        for c in build_page.columns:
+            vals = np.asarray(next(it))
+            valid = None if c.valid is None else np.asarray(next(it))
+            host_cols.append((vals, valid, c.type, c.dictionary))
+        self._free_collected(build_page)
+        self.memory.reserve(
+            int(bkey_s.nbytes + bperm.nbytes), "join-spill-keys")
+        probe_op = cached_kernel(
+            ("spill-probe", tuple(probe_keys)),
+            lambda: spilled_unique_probe(probe_keys))
+        verify = list(zip(probe_keys, build_keys)) \
+            if len(probe_keys) > 1 else None
+        post_filter = None if post_pred is None else \
+            compile_filter(post_pred)
+        try:
+            it2 = probe_stream if isinstance(probe_stream, Iterator) \
+                else self._coalesce_stream(probe_stream).iter_pages()
+            for batch in _byte_bounded_batches(it2, 1 << 29):
+                results = [probe_op(p, bkey_s, bperm, n_live)
+                           for p in batch]
+                totals = jax.device_get([t for _, t in results])
+                for (pre, _), total in zip(results, totals):
+                    total = int(total)
+                    if total == 0:
+                        continue
+                    pre = self._tight(pre, total)
+                    out = attach_build_host(pre, n_probe_cols, host_cols,
+                                            verify=verify)
+                    if post_filter is not None:
+                        out = out.filter(post_filter(out))
+                    yield out
+        finally:
+            self.memory.free(int(bkey_s.nbytes + bperm.nbytes),
+                             "join-spill-keys")
+
     def _run_unique_inner(self, probe_stream, prepared, probe_op,
                           attach_op) -> Iterator[Page]:
         """Drive the unique-build INNER fast path: probe+filter kernel per
         page, batched count fetch, shrink to live size, THEN gather build
         columns — so the attach gathers run at match count, not probe
         capacity. No overflow loop: output rows <= probe rows always."""
-        import itertools
         it = probe_stream if isinstance(probe_stream, Iterator) \
             else probe_stream.iter_pages()
-        while True:
-            batch = list(itertools.islice(it, 8))
-            if not batch:
-                return
+        for batch in _byte_bounded_batches(it, 1 << 29):
             results = [probe_op(page, prepared) for page in batch]
             totals = jax.device_get([t for _, t in results])
             for (pre, _), total in zip(results, totals):
@@ -1165,9 +1312,30 @@ def _reorder_stream(src: PageStream, symbols: Tuple[Symbol, ...]
                             p.num_rows)),))
 
 
+
+
+def _byte_bounded_batches(it: Iterator[Page], budget_bytes: int,
+                          max_pages: int = 8) -> Iterator[List[Page]]:
+    """Lookahead batching bounded by BYTES, not page count: dispatching 8
+    32M-row probe buffers ahead of one sync pinned >10GB of intermediates
+    in HBM at SF100 (the round-4 OOM). Small pages still amortize the sync
+    across up to max_pages dispatches."""
+    batch: List[Page] = []
+    used = 0
+    for page in it:
+        nbytes = sum(c.nbytes for c in page.columns)
+        if batch and (used + nbytes > budget_bytes
+                      or len(batch) >= max_pages):
+            yield batch
+            batch, used = [], 0
+        batch.append(page)
+        used += nbytes
+    if batch:
+        yield batch
+
+
 def _run_with_overflow(probe_stream: PageStream, build_page: Page,
-                       make_op, page_capacity: int,
-                       lookahead: int = 8) -> Iterator[Page]:
+                       make_op, page_capacity: int) -> Iterator[Page]:
     """Dispatch a capacity-laddered binary page op over probe pages in
     bounded lookahead windows, resolving each window's overflow counters in
     one batched device_get (a sync per page costs a full round trip on
@@ -1175,12 +1343,8 @@ def _run_with_overflow(probe_stream: PageStream, build_page: Page,
     would pin every intermediate output in HBM simultaneously); only pages
     that actually overflowed re-run at the next capacity bucket (SURVEY §7
     contract)."""
-    import itertools
     it = probe_stream.iter_pages()
-    while True:
-        probe_pages = list(itertools.islice(it, lookahead))
-        if not probe_pages:
-            return
+    for probe_pages in _byte_bounded_batches(it, 1 << 29):
         results = []
         for page in probe_pages:
             cap = max(page_capacity, page.capacity)

@@ -1,0 +1,88 @@
+"""Process-wide query registry + lifecycle states.
+
+Reference parity: execution/QueryTracker.java + QueryStateMachine.java —
+every statement entering a runner is registered with a monotonically
+assigned id and walks QUEUED -> RUNNING -> FINISHED | FAILED, carrying the
+stats rollup (row count, wall time, error) that system.runtime.queries and
+the HTTP server surface. The reference's CAS state machine with listeners
+collapses to a lock-guarded registry: execution here is synchronous per
+query (the mesh, not threads, is the concurrency), so states never race.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional
+
+QUEUED = "QUEUED"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+
+
+@dataclasses.dataclass
+class QueryInfo:
+    query_id: str
+    state: str
+    user: str
+    query: str
+    created: float
+    started: Optional[float] = None
+    ended: Optional[float] = None
+    rows: int = 0
+    error: Optional[str] = None
+
+    @property
+    def wall_ms(self) -> Optional[int]:
+        if self.started is None:
+            return None
+        end = self.ended if self.ended is not None else time.monotonic()
+        return int((end - self.started) * 1000)
+
+
+class QueryTracker:
+    def __init__(self, keep: int = 200):
+        self._lock = threading.Lock()
+        self._seq = itertools.count(1)
+        self._queries: Dict[str, QueryInfo] = {}
+        self._keep = keep
+
+    def begin(self, sql: str, user: str = "user",
+              query_id: Optional[str] = None) -> QueryInfo:
+        with self._lock:
+            qid = query_id or f"{time.strftime('%Y%m%d')}_{next(self._seq):06d}"
+            info = QueryInfo(qid, QUEUED, user, sql, time.monotonic())
+            self._queries[qid] = info
+            # bound the registry (QueryTracker prunes expired queries)
+            while len(self._queries) > self._keep:
+                done = next((k for k, v in self._queries.items()
+                             if v.state in (FINISHED, FAILED)), None)
+                if done is None:
+                    break
+                del self._queries[done]
+            return info
+
+    def running(self, info: QueryInfo) -> None:
+        info.state = RUNNING
+        info.started = time.monotonic()
+
+    def finish(self, info: QueryInfo, rows: int) -> None:
+        info.rows = rows
+        info.ended = time.monotonic()
+        info.state = FINISHED
+
+    def fail(self, info: QueryInfo, error: str) -> None:
+        info.error = error
+        info.ended = time.monotonic()
+        info.state = FAILED
+
+    def list(self) -> List[QueryInfo]:
+        with self._lock:
+            return list(self._queries.values())
+
+
+# the process-wide tracker (DiscoveryNodeManager-style singleton scope)
+TRACKER = QueryTracker()

@@ -84,13 +84,27 @@ class LocalQueryRunner:
         runner.catalogs.register("tpcds", tpcds.create_connector())
         runner.catalogs.register("memory", memory.create_connector())
         runner.catalogs.register("blackhole", blackhole.create_connector())
+        from trino_tpu.connector import system
+        runner.catalogs.register("system", system.create_connector())
         return runner
 
     # ------------------------------------------------------------- execute
 
     def execute(self, sql: str) -> MaterializedResult:
-        stmt = parse_statement(sql)
-        return self._execute_statement(stmt)
+        """Run one statement through the query lifecycle registry
+        (QueryStateMachine analog): QUEUED -> RUNNING -> FINISHED/FAILED,
+        visible in system.runtime.queries while executing and after."""
+        from trino_tpu.exec.query_tracker import TRACKER
+        info = TRACKER.begin(sql, user=self.session.user)
+        TRACKER.running(info)
+        try:
+            stmt = parse_statement(sql)
+            result = self._execute_statement(stmt)
+        except Exception as e:
+            TRACKER.fail(info, f"{type(e).__name__}: {e}")
+            raise
+        TRACKER.finish(info, len(result.rows))
+        return result
 
     def _execute_statement(self, stmt: t.Statement) -> MaterializedResult:
         if isinstance(stmt, t.Query):

@@ -19,10 +19,12 @@ so string predicates cost one int32 compare/gather per row on device.
 
 from __future__ import annotations
 
+import re
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from trino_tpu import types as T
 from trino_tpu.expr import functions as F
@@ -84,14 +86,17 @@ def _eval_call(expr: Call, page: Page) -> Column:
     if name == "like":
         return _like(expr, page)
     if name in ("lower", "upper", "trim", "ltrim", "rtrim", "substr",
-                "substring", "concat", "replace", "reverse"):
+                "substring", "concat", "replace", "reverse", "lpad", "rpad",
+                "split_part", "regexp_replace", "regexp_extract",
+                "concat_ws"):
         return _string_transform(expr, page)
-    if name == "length":
-        arg = _eval(expr.args[0], page)
-        table = F.dictionary_table(arg.dictionary, ("length",),
-                                   lambda s: len(s))
-        return Column(jnp.take(table, arg.values, mode="clip").astype(jnp.int64),
-                      arg.valid, expr.type, None)
+    if name in ("length", "codepoint", "strpos", "regexp_like",
+                "starts_with"):
+        return _string_scalar(expr, page)
+    if name in ("date_trunc", "date_diff", "date_add"):
+        return _date_unit_call(expr, page)
+    if name == "try_cast":
+        return _try_cast(expr, page)
     # --- generic null-propagating scalar ----------------------------------
     impl = F.lookup(name)
     args = [_eval(a, page) for a in expr.args]
@@ -167,24 +172,212 @@ def _like(expr: Call, page: Page) -> Column:
     return Column(vals, col.valid, expr.type, None)
 
 
+def _column_and_literals(expr: Call, page: Page):
+    """First non-literal arg is THE column; every other arg must be a
+    literal. Returns (column, call(s) -> py fn applied with the column's
+    string substituted at its ORIGINAL argument position, memo key)."""
+    col_i = None
+    for i, a in enumerate(expr.args):
+        if not isinstance(a, Literal):
+            if col_i is not None:
+                raise NotImplementedError(
+                    f"{expr.name} over two non-literal string args")
+            col_i = i
+    if col_i is None:
+        col_i = 0   # all-literal: fold through the first arg's singleton
+    col = _eval(expr.args[col_i], page)
+    lit_by_pos = {i: a.value for i, a in enumerate(expr.args) if i != col_i}
+
+    def call(fn, s):
+        args = [s if i == col_i else lit_by_pos[i]
+                for i in range(len(expr.args))]
+        return fn(*args)
+    key = (col_i,) + tuple(sorted(lit_by_pos.items()))
+    return col, call, key
+
+
 def _string_transform(expr: Call, page: Page) -> Column:
-    """str->str functions as dictionary remap (host transform, device gather)."""
+    """str->str functions as dictionary remap (host transform, device
+    gather). NULL-producing transforms (split_part past the last field,
+    regexp_extract without a match) carry a per-pool-value ok-table."""
     name = expr.name
-    col = _eval(expr.args[0], page)
+    col, call, akey = _column_and_literals(expr, page)
     if col.dictionary is None:
         raise NotImplementedError(f"{name} requires dictionary-encoded input")
-    lits = [a for a in expr.args[1:]]
-    lit_vals = []
-    for a in lits:
-        if not isinstance(a, Literal):
-            raise NotImplementedError(f"{name} with non-literal extra args")
-        lit_vals.append(a.value)
     py = _PY_STRING_FNS[name]
-    key = (name,) + tuple(lit_vals)
+    key = (name, akey)
+    if name in _NULLABLE_STRING_FNS:
+        nd, remap, ok = F.transform_dictionary_nullable(
+            col.dictionary, key, lambda s: call(py, s))
+        codes = jnp.take(remap, col.values, mode="clip")
+        okv = jnp.take(jnp.asarray(ok), col.values, mode="clip")
+        valid = okv if col.valid is None else (okv & col.valid)
+        return Column(codes, valid, expr.type, nd)
     nd, remap = F.transform_dictionary(col.dictionary, key,
-                                       lambda s: py(s, *lit_vals))
+                                       lambda s: call(py, s))
     codes = jnp.take(remap, col.values, mode="clip")
     return Column(codes, col.valid, expr.type, nd)
+
+
+_STRING_SCALAR_FNS = {
+    "length": (lambda s: len(s), jnp.int64),
+    "codepoint": (lambda s: ord(s[0]) if s else 0, jnp.int64),
+    "strpos": (lambda s, sub: s.find(sub) + 1, jnp.int64),
+    "regexp_like": (lambda s, pat: re.search(pat, s) is not None, jnp.bool_),
+    "starts_with": (lambda s, pre: s.startswith(pre), jnp.bool_),
+}
+
+
+def _string_scalar(expr: Call, page: Page) -> Column:
+    """str -> number/bool functions as a memoized per-pool host table +
+    device gather (the joni/re2j per-row regex replacement)."""
+    name = expr.name
+    col, call, akey = _column_and_literals(expr, page)
+    if col.dictionary is None:
+        raise NotImplementedError(f"{name} requires dictionary-encoded input")
+    fn, dtype = _STRING_SCALAR_FNS[name]
+    table = F.dictionary_table(col.dictionary, (name, akey),
+                               lambda s: call(fn, s))
+    vals = jnp.take(jnp.asarray(table), col.values,
+                    mode="clip").astype(dtype)
+    return Column(vals, col.valid, expr.type, None)
+
+
+_DATE_UNITS_TS = {"second": 1_000_000, "minute": 60_000_000,
+                  "hour": 3_600_000_000, "day": 86_400_000_000,
+                  "millisecond": 1_000}
+
+
+def _date_unit_call(expr: Call, page: Page) -> Column:
+    """date_trunc / date_diff / date_add with a literal unit
+    (DateTimeFunctions.java parity for DATE; micros arithmetic for the
+    sub-day TIMESTAMP units)."""
+    unit_arg = expr.args[0]
+    if not isinstance(unit_arg, Literal):
+        raise NotImplementedError(f"{expr.name} unit must be a literal")
+    unit = str(unit_arg.value).lower()
+    rest = [_eval(a, page) for a in expr.args[1:]]
+    valid = None
+    for a in rest:
+        valid = _vand(valid, a.valid)
+    name = expr.name
+    if name == "date_trunc":
+        (col,) = rest
+        if isinstance(expr.type, T.DateType):
+            vals = F.date_trunc_days(unit, col.values)
+        elif unit in _DATE_UNITS_TS:
+            step = jnp.int64(_DATE_UNITS_TS[unit])
+            v = col.values.astype(jnp.int64)
+            vals = (jax.lax.div(jnp.where(v >= 0, v, v - step + 1), step)
+                    * step)
+        else:
+            raise NotImplementedError(
+                f"date_trunc({unit!r}) on {expr.type.display()}")
+        return Column(vals, valid, expr.type, None)
+    if name == "date_diff":
+        a, b = rest
+        at, bt = expr.args[1].type, expr.args[2].type
+        if isinstance(at, T.DateType) and isinstance(bt, T.DateType):
+            vals = F.date_diff_days(unit, a.values, b.values)
+        elif isinstance(at, T.TimestampType) and \
+                isinstance(bt, T.TimestampType) and unit in _DATE_UNITS_TS:
+            step = jnp.int64(_DATE_UNITS_TS[unit])
+            vals = jax.lax.div(b.values.astype(jnp.int64)
+                               - a.values.astype(jnp.int64), step)
+        else:
+            # mixed DATE/TIMESTAMP operands must be coerced upstream —
+            # day-number vs microsecond arithmetic would be garbage
+            raise NotImplementedError(
+                f"date_diff({unit!r}) over {at.display()}, {bt.display()}")
+        return Column(vals, valid, expr.type, None)
+    # date_add(unit, n, temporal)
+    n, d = rest
+    dt = expr.args[2].type
+    if isinstance(expr.type, T.DateType) and isinstance(dt, T.DateType):
+        vals = F.date_add_days(unit, n.values.astype(jnp.int64), d.values)
+    elif isinstance(dt, T.TimestampType) and unit in _DATE_UNITS_TS:
+        vals = d.values.astype(jnp.int64) + n.values.astype(jnp.int64) \
+            * jnp.int64(_DATE_UNITS_TS[unit])
+    else:
+        raise NotImplementedError(
+            f"date_add({unit!r}) on {dt.display()}")
+    return Column(vals, valid, expr.type, None)
+
+
+def _try_cast(expr: Call, page: Page) -> Column:
+    """TRY_CAST: NULL instead of failure. Non-string sources delegate to
+    the saturating cast kernel (which cannot raise per-row); varchar
+    sources parse the dictionary pool host-side into a value table + an
+    ok-mask table."""
+    target = expr.type
+    src_t = expr.args[0].type
+    col = _eval(expr.args[0], page)
+    if not T.is_string(src_t):
+        values = F.lookup("cast")(target, [src_t], col.values)
+        return Column(values, col.valid, target,
+                      col.dictionary if T.is_string(target) else None)
+    if col.dictionary is None:
+        raise NotImplementedError("try_cast requires dictionary input")
+    if T.is_string(target):
+        return Column(col.values, col.valid, target, col.dictionary)
+    parse = _py_parser_for(target)
+    table = F.dictionary_table(
+        col.dictionary, ("try_cast", target.display()),
+        lambda s: parse(s))
+    vals_np = np.asarray(
+        [0 if v is None else v for v in table],
+        dtype=T.to_numpy_dtype(target))
+    ok_np = np.asarray([v is not None for v in table])
+    vals = jnp.take(jnp.asarray(vals_np), col.values, mode="clip")
+    okv = jnp.take(jnp.asarray(ok_np), col.values, mode="clip")
+    valid = okv if col.valid is None else (okv & col.valid)
+    return Column(vals, valid, target, None)
+
+
+def _py_parser_for(target):
+    """Python parser matching Trino varchar->X cast semantics; None = NULL."""
+    import decimal as _dec
+    if isinstance(target, (T.BigintType, T.IntegerType, T.SmallintType,
+                           T.TinyintType)):
+        def parse_int(s):
+            try:
+                return int(s.strip())
+            except ValueError:
+                return None
+        return parse_int
+    if isinstance(target, (T.DoubleType, T.RealType)):
+        def parse_float(s):
+            try:
+                return float(s.strip())
+            except ValueError:
+                return None
+        return parse_float
+    if isinstance(target, T.DecimalType):
+        def parse_dec(s):
+            try:
+                q = _dec.Decimal(s.strip()).scaleb(target.scale)
+                return int(q.to_integral_value(rounding=_dec.ROUND_HALF_UP))
+            except (_dec.InvalidOperation, ValueError):
+                return None
+        return parse_dec
+    if isinstance(target, T.DateType):
+        def parse_date(s):
+            try:
+                y, m, d = s.strip().split("-")
+                return F.days_from_civil(int(y), int(m), int(d))
+            except (ValueError, AttributeError):
+                return None
+        return parse_date
+    if isinstance(target, T.BooleanType):
+        def parse_bool(s):
+            v = s.strip().lower()
+            if v in ("true", "t", "1"):
+                return True
+            if v in ("false", "f", "0"):
+                return False
+            return None
+        return parse_bool
+    raise NotImplementedError(f"try_cast to {target.display()}")
 
 
 def _py_substr(s: str, start: int, length: Optional[int] = None) -> str:
@@ -203,6 +396,32 @@ def _py_substr(s: str, start: int, length: Optional[int] = None) -> str:
     return piece
 
 
+def _py_pad(s: str, size: int, pad: str, left: bool) -> str:
+    # StringFunctions.java lpad/rpad: truncate when longer; cycle the pad
+    size = int(size)
+    if len(s) >= size:
+        return s[:size]
+    fill = (pad * ((size - len(s)) // max(len(pad), 1) + 1))[:size - len(s)]
+    return fill + s if left else s + fill
+
+
+def _py_split_part(s: str, delim: str, index: int):
+    parts = s.split(delim) if delim else [s]
+    return parts[index - 1] if 1 <= index <= len(parts) else None
+
+
+def _py_regexp_replace(s: str, pattern: str, repl: str = "") -> str:
+    # Trino uses $g group references; re wants \g
+    return re.sub(pattern, re.sub(r"\$(\d+)", r"\\\1", repl), s)
+
+
+def _py_regexp_extract(s: str, pattern: str, group: int = 0):
+    m = re.search(pattern, s)
+    if m is None:
+        return None
+    return m.group(group)
+
+
 _PY_STRING_FNS = {
     "lower": lambda s: s.lower(),
     "upper": lambda s: s.upper(),
@@ -214,7 +433,16 @@ _PY_STRING_FNS = {
     "concat": lambda s, suffix: s + suffix,
     "replace": lambda s, find, repl="": s.replace(find, repl),
     "reverse": lambda s: s[::-1],
+    "lpad": lambda s, size, pad=" ": _py_pad(s, size, pad, True),
+    "rpad": lambda s, size, pad=" ": _py_pad(s, size, pad, False),
+    "split_part": _py_split_part,
+    "regexp_replace": _py_regexp_replace,
+    "regexp_extract": _py_regexp_extract,
+    "concat_ws": lambda sep, *vals: sep.join(vals),
 }
+
+# transforms that may yield NULL per input value (carry an ok-table)
+_NULLABLE_STRING_FNS = {"split_part", "regexp_extract"}
 
 
 def _eval_special(expr: SpecialForm, page: Page) -> Column:

@@ -248,6 +248,69 @@ def _log10(out_type, arg_types, a):
     return jnp.log10(a)
 
 
+@scalar("cbrt")
+def _cbrt(out_type, arg_types, a):
+    return jnp.cbrt(a.astype(jnp.float64))
+
+
+@scalar("log2")
+def _log2(out_type, arg_types, a):
+    return jnp.log2(a.astype(jnp.float64))
+
+
+@scalar("log")
+def _log(out_type, arg_types, b, x):
+    # Trino log(b, x) = ln(x) / ln(b)
+    return jnp.log(x.astype(jnp.float64)) / jnp.log(b.astype(jnp.float64))
+
+
+@scalar("radians")
+def _radians(out_type, arg_types, a):
+    return jnp.deg2rad(a.astype(jnp.float64))
+
+
+@scalar("degrees")
+def _degrees(out_type, arg_types, a):
+    return jnp.rad2deg(a.astype(jnp.float64))
+
+
+for _trig, _jfn in (("sin", jnp.sin), ("cos", jnp.cos), ("tan", jnp.tan),
+                    ("asin", jnp.arcsin), ("acos", jnp.arccos),
+                    ("atan", jnp.arctan), ("sinh", jnp.sinh),
+                    ("cosh", jnp.cosh), ("tanh", jnp.tanh)):
+    def _mk(jfn):
+        def impl(out_type, arg_types, a):
+            return jfn(a.astype(jnp.float64))
+        return impl
+    _SCALARS[_trig] = _mk(_jfn)
+
+
+@scalar("atan2")
+def _atan2(out_type, arg_types, a, b):
+    return jnp.arctan2(a.astype(jnp.float64), b.astype(jnp.float64))
+
+
+@scalar("pi")
+def _pi(out_type, arg_types):
+    return jnp.asarray(math.pi, dtype=jnp.float64)
+
+
+@scalar("e")
+def _e(out_type, arg_types):
+    return jnp.asarray(math.e, dtype=jnp.float64)
+
+
+@scalar("truncate")
+def _truncate(out_type, arg_types, a, n=None):
+    # MathFunctions.java truncate: drop the fractional part toward zero;
+    # two-arg form truncates to n decimal places
+    a = a.astype(jnp.float64)
+    if n is None:
+        return jnp.trunc(a)
+    factor = 10.0 ** n.astype(jnp.float64)
+    return jnp.trunc(a * factor) / factor
+
+
 @scalar("sign")
 def _sign(out_type, arg_types, a):
     return jnp.sign(a)
@@ -360,6 +423,117 @@ def _add_months_device(days, months):
     return (era * 146097 + doe - 719468).astype(jnp.int32)
 
 
+@scalar("day_of_week")
+def _day_of_week(out_type, arg_types, a):
+    # ISO: 1 = Monday .. 7 = Sunday (1970-01-01 was a Thursday, days=0 -> 4)
+    days = _days_of(arg_types[0], a).astype(jnp.int64)
+    return jax.lax.rem(jax.lax.rem(days + 3, jnp.int64(7)) + 7,
+                       jnp.int64(7)) + 1
+
+
+def _trunc_year_days(days):
+    y, _, _ = _civil_from_days(days)
+    return _days_from_civil_device(y, jnp.int64(1), jnp.int64(1))
+
+
+def _days_from_civil_device(y, m, d):
+    yy = y - (m <= 2)
+    era = jax.lax.div(jnp.where(yy >= 0, yy, yy - 399), jnp.int64(400))
+    yoe = yy - era * 400
+    doy = jax.lax.div(153 * (m + jnp.where(m > 2, -3, 9)) + 2,
+                      jnp.int64(5)) + d - 1
+    doe = yoe * 365 + jax.lax.div(yoe, jnp.int64(4)) - jax.lax.div(
+        yoe, jnp.int64(100)) + doy
+    return era * 146097 + doe - 719468
+
+
+@scalar("day_of_year")
+def _day_of_year(out_type, arg_types, a):
+    days = _days_of(arg_types[0], a).astype(jnp.int64)
+    return days - _trunc_year_days(days) + 1
+
+
+@scalar("week")
+def _week(out_type, arg_types, a):
+    # ISO 8601 week-of-year: the week containing this date's Thursday
+    days = _days_of(arg_types[0], a).astype(jnp.int64)
+    dow0 = jax.lax.rem(jax.lax.rem(days + 3, jnp.int64(7)) + 7,
+                       jnp.int64(7))          # 0 = Monday
+    thursday = days - dow0 + 3
+    return jax.lax.div(thursday - _trunc_year_days(thursday),
+                       jnp.int64(7)) + 1
+
+
+@scalar("last_day_of_month")
+def _last_day_of_month(out_type, arg_types, a):
+    days = _days_of(arg_types[0], a).astype(jnp.int64)
+    y, m, _ = _civil_from_days(days)
+    nxt_m = jnp.where(m == 12, 1, m + 1)
+    nxt_y = jnp.where(m == 12, y + 1, y)
+    return (_days_from_civil_device(nxt_y, nxt_m, jnp.int64(1)) - 1) \
+        .astype(jnp.int32)
+
+
+def date_trunc_days(unit: str, days):
+    """DATE date_trunc (DateTimeFunctions.java truncateDate analog)."""
+    days = days.astype(jnp.int64)
+    if unit == "day":
+        return days.astype(jnp.int32)
+    if unit == "week":
+        dow0 = jax.lax.rem(jax.lax.rem(days + 3, jnp.int64(7)) + 7,
+                           jnp.int64(7))
+        return (days - dow0).astype(jnp.int32)
+    y, m, _ = _civil_from_days(days)
+    if unit == "month":
+        return _days_from_civil_device(y, m, jnp.int64(1)).astype(jnp.int32)
+    if unit == "quarter":
+        qm = (jax.lax.div(m - 1, jnp.int64(3))) * 3 + 1
+        return _days_from_civil_device(y, qm, jnp.int64(1)).astype(jnp.int32)
+    if unit == "year":
+        return _days_from_civil_device(y, jnp.int64(1),
+                                       jnp.int64(1)).astype(jnp.int32)
+    raise NotImplementedError(f"date_trunc unit {unit!r} on DATE")
+
+
+def date_diff_days(unit: str, a, b):
+    """date_diff(unit, a, b) = b - a in whole units (DateTimeFunctions
+    diffDate analog: LocalDate.until semantics for month/year)."""
+    a = a.astype(jnp.int64)
+    b = b.astype(jnp.int64)
+    if unit == "day":
+        return b - a
+    if unit == "week":
+        # ChronoUnit.WEEKS.between: whole weeks, truncated toward zero
+        return jax.lax.div(b - a, jnp.int64(7))
+    if unit in ("month", "quarter", "year"):
+        ay, am, ad = _civil_from_days(a)
+        by, bm, bd = _civil_from_days(b)
+        months = (by - ay) * 12 + (bm - am)
+        # not a full month yet if the day-of-month hasn't been reached
+        months = months - jnp.where((months > 0) & (bd < ad), 1, 0)
+        months = months + jnp.where((months < 0) & (bd > ad), 1, 0)
+        if unit == "month":
+            return months
+        div = 3 if unit == "quarter" else 12
+        q = jax.lax.div(months, jnp.int64(div))
+        return q
+    raise NotImplementedError(f"date_diff unit {unit!r} on DATE")
+
+
+def date_add_days(unit: str, n, days):
+    if unit == "day":
+        return (days + n).astype(jnp.int32)
+    if unit == "week":
+        return (days + 7 * n).astype(jnp.int32)
+    if unit == "month":
+        return _add_months_device(days, n)
+    if unit == "quarter":
+        return _add_months_device(days, 3 * n)
+    if unit == "year":
+        return _add_months_device(days, 12 * n)
+    raise NotImplementedError(f"date_add unit {unit!r} on DATE")
+
+
 @scalar("date_add_ym")
 def _date_add_ym(out_type, arg_types, days, months):
     return _add_months_device(days, months)
@@ -464,6 +638,21 @@ def like_table(d: Dictionary, pattern: str,
     rx = re.compile(like_pattern_to_regex(pattern, escape), re.DOTALL)
     return dictionary_table(d, ("like", pattern, escape),
                             lambda s: rx.match(s) is not None)
+
+
+def transform_dictionary_nullable(d: Dictionary, key, fn):
+    """Like transform_dictionary but fn may return None (SQL NULL):
+    (new dictionary, code remap, ok mask per input code)."""
+    cache = _dict_cache(d)
+    ck = (key, "xform-null")
+    if ck not in cache:
+        transformed = [fn(s) for s in d.values]
+        ok = np.asarray([t is not None for t in transformed])
+        vals = np.asarray(["" if t is None else t for t in transformed],
+                          dtype=object)
+        new_vals, remap = np.unique(vals, return_inverse=True)
+        cache[ck] = (Dictionary(new_vals), remap.astype(np.int32), ok)
+    return cache[ck]
 
 
 def transform_dictionary(d: Dictionary, key, fn) -> Tuple[Dictionary, jnp.ndarray]:

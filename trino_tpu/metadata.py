@@ -36,7 +36,11 @@ SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     "enable_dynamic_filtering": True,
     "push_aggregation_through_outer_join": True,
     "colocated_join": True,
-    "spill_enabled": False,
+    # spill defaults ON (SystemSessionProperties spill_enabled; the v5e
+    # HBM is the scarce resource — a >threshold INNER build keeps only its
+    # sorted key array on device and pays host gathers at match count)
+    "spill_enabled": True,
+    "join_spill_threshold_bytes": 1 << 30,
 }
 
 

@@ -158,6 +158,194 @@ def _bool_final(state, _):
     return value > 0, nnz > 0
 
 
+def _hash64(v: jnp.ndarray) -> jnp.ndarray:
+    """splitmix64 over value bits (floats canonicalized so SQL-equal values
+    hash equal) — the XxHash64 role in HLL/checksum states."""
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        v = jax.lax.bitcast_convert_type(v.astype(jnp.float64) + 0.0,
+                                         jnp.uint64)
+    x = v.astype(jnp.uint64)
+    x = (x + jnp.uint64(0x9E3779B97F4A7C15))
+    x = (x ^ (x >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> 27)) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> 31)
+
+
+def _checksum_state(in_type):
+    """Order-independent checksum: wrapping int64 sum of per-value hashes.
+    Reference: operator/aggregation/ChecksumAggregationFunction — which
+    emits varbinary(8); here the same 64 bits surface as BIGINT. The mask
+    folds NULL rows to a zero contribution (the reference hashes SQL NULL
+    to a constant — observable only when comparing checksums across
+    engines, out of scope for the BIGINT surface)."""
+    def contrib(v, m):
+        return jnp.where(m, _hash64(v).astype(jnp.int64), 0)
+    return (StateColumn(T.BIGINT, contrib, "sum"),
+            StateColumn(T.BIGINT, lambda v, m: m.astype(jnp.int64), "sum"))
+
+
+def _checksum_final(state, _):
+    total, nnz = state
+    # NULL over zero non-null rows (ChecksumAggregationFunction)
+    return total.astype(jnp.int64), nnz > 0
+
+
+_HLL_P = 11            # 2^11 = 2048 registers -> standard error 2.30%
+_HLL_M = 1 << _HLL_P
+
+
+def _hll_register_inputs(vals, elig):
+    h = _hash64(vals)
+    bucket = (h >> jnp.uint64(64 - _HLL_P)).astype(jnp.int32)
+    w = (h & jnp.uint64((1 << 53) - 1)).astype(jnp.float64)
+    # rho = 53 - floor(log2(w)) for w>0 (P(rho=r) = 2^-r), 54 when w == 0;
+    # ints < 2^53 are exact in float64, so floor(log2) is exact
+    rho = jnp.where(w > 0,
+                    53 - jnp.floor(jnp.log2(jnp.maximum(w, 1.0))),
+                    54.0).astype(jnp.int32)
+    return jnp.where(elig, bucket, 0), jnp.where(elig, rho, 0)
+
+
+def _hll_estimate(sum_present, cnt_present):
+    """Raw HLL estimator + small-range linear counting (absent buckets
+    contribute 2^0 = 1). Reference:
+    operator/aggregation/ApproximateCountDistinctAggregation + airlift
+    HyperLogLog."""
+    m = float(_HLL_M)
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    sum_full = sum_present + (m - cnt_present)
+    est = alpha * m * m / jnp.maximum(sum_full, 1e-12)
+    zeros = m - cnt_present
+    lc = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+    use_lc = (est <= 2.5 * m) & (zeros > 0)
+    return jnp.round(jnp.where(use_lc, lc, est)).astype(jnp.int64)
+
+
+def _hll_grouped(page: Page, spec: "AggSpec",
+                 key_channels: Sequence[int]) -> Column:
+    """approx_distinct over sorted groups: re-sort by (keys, bucket), fold
+    registers per (group, bucket) RUN with one segment_max, then reduce
+    runs per group — static shapes throughout (no [groups x m] registers
+    materialized)."""
+    n = page.capacity
+    fn = get_aggregate("approx_distinct", spec.input_type)
+    vals, elig, _ = _agg_inputs(page, spec, fn, page.row_mask())
+    bucket, rho = _hll_register_inputs(vals, elig)
+    operands = _sort_key_arrays(page, key_channels)
+    sorted_ops = jax.lax.sort(
+        operands + [bucket, rho.astype(jnp.int32),
+                    elig.astype(jnp.int32)],
+        num_keys=len(operands) + 1)
+    live_s = ~sorted_ops[0]
+    key_ops_s = sorted_ops[1:-3]
+    bucket_s, rho_s, elig_s = sorted_ops[-3], sorted_ops[-2], sorted_ops[-1]
+    # group ids on the sorted order
+    gboundary = _boundary_scan(key_ops_s, n) & live_s
+    group = jnp.cumsum(gboundary.astype(jnp.int32)) - 1
+    # (group, bucket) runs
+    rboundary = (gboundary |
+                 (bucket_s != jnp.roll(bucket_s, 1)).at[0].set(True)) & live_s
+    run = jnp.cumsum(rboundary.astype(jnp.int32)) - 1
+    run_seg = jnp.where(live_s, run, n)
+    reg_run = jax.ops.segment_max(jnp.where(elig_s > 0, rho_s, 0), run_seg,
+                                  num_segments=n + 1)[:n]
+    has_run = jax.ops.segment_max(elig_s, run_seg,
+                                  num_segments=n + 1)[:n] > 0
+    grp_run = jax.ops.segment_max(jnp.where(live_s, group, -1), run_seg,
+                                  num_segments=n + 1)[:n]
+    inv = jnp.where(has_run, jnp.exp2(-reg_run.astype(jnp.float64)), 0.0)
+    grp_seg = jnp.where(has_run, grp_run, n)
+    sum_present = jax.ops.segment_sum(inv, grp_seg, num_segments=n + 1)[:n]
+    cnt_present = jax.ops.segment_sum(has_run.astype(jnp.float64), grp_seg,
+                                      num_segments=n + 1)[:n]
+    return Column(_hll_estimate(sum_present, cnt_present), None, T.BIGINT,
+                  None)
+
+
+def _hll_global(page: Page, spec: "AggSpec", live) -> Column:
+    n = page.capacity
+    fn = get_aggregate("approx_distinct", spec.input_type)
+    vals, elig, _ = _agg_inputs(page, spec, fn, live)
+    bucket, rho = _hll_register_inputs(vals, elig)
+    seg = jnp.where(elig, bucket, _HLL_M)
+    reg = jax.ops.segment_max(rho, seg, num_segments=_HLL_M + 1)[:_HLL_M]
+    present = reg > 0
+    sum_present = jnp.sum(
+        jnp.where(present, jnp.exp2(-reg.astype(jnp.float64)), 0.0),
+        keepdims=True)
+    cnt_present = jnp.sum(present.astype(jnp.float64), keepdims=True)
+    return Column(_hll_estimate(sum_present, cnt_present), None, T.BIGINT,
+                  None)
+
+
+def _percentile_grouped(page: Page, spec: "AggSpec",
+                        key_channels: Sequence[int]) -> Column:
+    """approx_percentile(x, p): nearest-rank pick within each sorted group
+    (the qdigest role; exact at single step — error 0 <= any digest)."""
+    n = page.capacity
+    xcol = page.column(spec.input)
+    vals, dictionary = xcol.values, xcol.dictionary
+    elig = page.row_mask() & xcol.valid_mask()
+    if spec.mask_channel is not None:
+        fcol = page.column(spec.mask_channel)
+        elig = elig & fcol.values & fcol.valid_mask()
+    sort_vals = _nan_as_largest(vals) if jnp.issubdtype(
+        vals.dtype, jnp.floating) else vals
+    operands = _sort_key_arrays(page, key_channels)
+    perm = jnp.arange(n, dtype=jnp.int32)
+    sorted_ops = jax.lax.sort(
+        operands + [(~elig), sort_vals, perm],
+        num_keys=len(operands) + 2)
+    live_s = ~sorted_ops[0]
+    key_ops_s = sorted_ops[1:-3]
+    elig_s = ~sorted_ops[-3]
+    perm_s = sorted_ops[-1]
+    gboundary = _boundary_scan(key_ops_s, n) & live_s
+    group = jnp.cumsum(gboundary.astype(jnp.int32)) - 1
+    seg = jnp.where(live_s, group, n)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    start = jax.ops.segment_min(jnp.where(live_s, pos, n), seg,
+                                num_segments=n + 1)[:n]
+    cnt = jax.ops.segment_sum(elig_s.astype(jnp.int32), seg,
+                              num_segments=n + 1)[:n]
+    pcol = page.column(spec.input2)
+    p_sorted = jnp.take(pcol.values, perm_s, mode="clip") \
+        .astype(jnp.float64)
+    p_g = jax.ops.segment_max(jnp.where(live_s, p_sorted, -jnp.inf), seg,
+                              num_segments=n + 1)[:n]
+    k = jnp.clip(jnp.ceil(p_g * cnt.astype(jnp.float64)).astype(jnp.int32),
+                 1, jnp.maximum(cnt, 1))
+    idx = jnp.clip(start + k - 1, 0, n - 1)
+    vals_s = jnp.take(vals, perm_s, mode="clip")
+    out_vals = jnp.take(vals_s, idx, mode="clip")
+    return Column(out_vals, cnt > 0, xcol.type, dictionary)
+
+
+def _percentile_global(page: Page, spec: "AggSpec", live) -> Column:
+    n = page.capacity
+    xcol = page.column(spec.input)
+    vals, dictionary = xcol.values, xcol.dictionary
+    elig = live & xcol.valid_mask()
+    if spec.mask_channel is not None:
+        fcol = page.column(spec.mask_channel)
+        elig = elig & fcol.values & fcol.valid_mask()
+    sort_vals = _nan_as_largest(vals) if jnp.issubdtype(
+        vals.dtype, jnp.floating) else vals
+    perm = jnp.arange(n, dtype=jnp.int32)
+    sorted_ops = jax.lax.sort([(~elig), sort_vals, perm], num_keys=2)
+    elig_s = ~sorted_ops[0]
+    perm_s = sorted_ops[-1]
+    cnt = jnp.sum(elig_s.astype(jnp.int32))
+    pcol = page.column(spec.input2)
+    p = jnp.max(jnp.where(live, pcol.values.astype(jnp.float64), -jnp.inf))
+    k = jnp.clip(jnp.ceil(p * cnt.astype(jnp.float64)).astype(jnp.int32),
+                 1, jnp.maximum(cnt, 1))
+    idx = jnp.clip(k - 1, 0, n - 1)
+    vals_s = jnp.take(vals, perm_s, mode="clip")
+    out_vals = jnp.take(vals_s, idx[None], mode="clip")
+    return Column(out_vals, (cnt > 0)[None], xcol.type, dictionary)
+
+
 def _geomean_state_factory(in_type):
     def state(t):
         return (
@@ -191,8 +379,14 @@ CENTERED_AGGREGATES = frozenset({
     "variance", "var_samp", "var_pop", "stddev", "stddev_samp", "stddev_pop",
     "corr", "covar_pop", "covar_samp", "regr_slope", "regr_intercept"})
 
+# sketch aggregates with their own sorted evaluation (HyperLogLog register
+# folding / rank selection) — single-step like DISTINCT: the whole group's
+# rows must be colocated in one kernel call
+SKETCH_AGGREGATES = frozenset({"approx_distinct", "approx_percentile"})
+
 # aggregates that must see every row of a group in ONE kernel invocation
-SINGLE_STEP_AGGREGATES = POSITIONAL_AGGREGATES | CENTERED_AGGREGATES
+SINGLE_STEP_AGGREGATES = (POSITIONAL_AGGREGATES | CENTERED_AGGREGATES
+                          | SKETCH_AGGREGATES)
 
 
 def get_aggregate(name: str, in_type: Optional[T.Type]) -> AggregateFunction:
@@ -222,6 +416,13 @@ def get_aggregate(name: str, in_type: Optional[T.Type]) -> AggregateFunction:
     if n in POSITIONAL_AGGREGATES:
         # state/final unused — executed by the positional row-selection path
         return AggregateFunction(n, lambda t: (), None, lambda t: tx)
+    if n == "approx_distinct":
+        return AggregateFunction(n, lambda t: (), None, lambda t: T.BIGINT)
+    if n == "approx_percentile":
+        return AggregateFunction(n, lambda t: (), None, lambda t: tx)
+    if n == "checksum":
+        return AggregateFunction("checksum", _checksum_state,
+                                 _checksum_final, lambda t: T.BIGINT)
     if n == "sum":
         out = in_type if isinstance(in_type, (T.DecimalType, T.DoubleType,
                                               T.RealType)) else T.BIGINT
@@ -641,6 +842,10 @@ def _accumulate(page, aggs, resolved, step, partial_state_channels,
             values, valid = fn.final(merged, None)
             out.append(_agg_out_column(fn, spec, values, valid,
                                        page.column(chans[0]).dictionary))
+        elif spec.name == "approx_distinct":
+            out.append(_hll_grouped(page, spec, key_channels))
+        elif spec.name == "approx_percentile":
+            out.append(_percentile_grouped(page, spec, key_channels))
         elif spec.name in POSITIONAL_AGGREGATES:
             out.append(_positional_grouped(page, spec, perm_sorted, seg, n))
         elif spec.name in CENTERED_AGGREGATES:
@@ -856,6 +1061,12 @@ def _global_aggregate(page, aggs, resolved, step, partial_state_channels):
         return dmask_cache[key]
 
     for ai, (spec, fn) in enumerate(zip(aggs, resolved)):
+        if spec.name == "approx_distinct":
+            out_cols.append(_hll_global(page, spec, live))
+            continue
+        if spec.name == "approx_percentile":
+            out_cols.append(_percentile_global(page, spec, live))
+            continue
         if spec.name in POSITIONAL_AGGREGATES:
             out_cols.append(_positional_global(page, spec, live))
             continue

@@ -349,6 +349,146 @@ def hash_join(
     return op
 
 
+def prepare_build_spilled(build_keys: Sequence[int]):
+    """Spilling build phase (HashBuilderOperator.java:163 spill state
+    machine, re-thought for HBM): device memory holds ONLY what probing
+    needs — the sorted u64 key array and the sort permutation — while the
+    build's payload columns move to host RAM (the executor fetches them
+    once and frees the device page). Probing then runs entirely against
+    the key array; matched rows' build columns are gathered HOST-side at
+    match count (attach_build_host), so a 150M-row build costs ~12 bytes/
+    row of HBM instead of the full page + run-length structures.
+
+    Returns op(build_page) -> (bkey_s, bperm, n_live, n_build_rows,
+    build_has_null, is_unique)."""
+    build_keys = tuple(build_keys)
+
+    def prep(build: Page):
+        bkey, bnull = _key_u64(build, build_keys)
+        b_dead = ~build.row_mask() | bnull
+        u64max = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+        bkey_masked = jnp.where(b_dead, u64max, bkey)
+        bkey_s, b_dead_s, bperm = jax.lax.sort(
+            [bkey_masked, b_dead,
+             jnp.arange(build.capacity, dtype=jnp.int32)], num_keys=2)
+        n_live = jnp.sum(~b_dead_s).astype(jnp.int32)
+        live_b = build.row_mask()
+        n_build_rows = jnp.sum(live_b).astype(jnp.int32)
+        build_has_null = jnp.any(bnull & live_b)
+        idx = jnp.arange(build.capacity, dtype=jnp.int32)
+        dup = (bkey_s[1:] == bkey_s[:-1]) & (idx[1:] < n_live)
+        is_unique = ~jnp.any(dup)
+        return bkey_s, bperm, n_live, n_build_rows, build_has_null, is_unique
+    return prep
+
+
+_ANCHOR_LOG2 = 10
+
+
+def _searchsorted_anchored(bkey_s: jnp.ndarray, pkey: jnp.ndarray
+                           ) -> jnp.ndarray:
+    """side='left' searchsorted for HUGE sorted arrays: method='sort'
+    co-sorts the whole build array with every probe batch (a ~5GB
+    workspace per call against a 150M-key build — the SF100 OOM), so
+    instead (1) one sort-method search against a 1/2^10 anchor subsample,
+    then (2) 2^10-window lower_bound via ~11 branchless gather rounds.
+    Workspace is O(probe + build/1024); gathers run at probe size."""
+    n = bkey_s.shape[0]
+    stride = 1 << _ANCHOR_LOG2
+    anchors = bkey_s[::stride]
+    coarse = jnp.searchsorted(anchors, pkey, side="left", method="sort")
+    pos = (jnp.maximum(coarse, 1) - 1) * stride
+    # invariant: bkey_s[pos-1] < key (anchor strictly below); advance in
+    # halving steps while the probe stays below the key
+    step = stride
+    while step > 0:
+        nxt = pos + step
+        v = jnp.take(bkey_s, jnp.minimum(nxt - 1, n - 1), mode="clip")
+        advance = (nxt <= n) & (v < pkey)
+        pos = jnp.where(advance, nxt, pos)
+        step //= 2
+    return pos
+
+
+def spilled_unique_probe(probe_keys: Sequence[int]):
+    """Probe phase against a spilled build: identical to unique_inner_probe
+    but consuming only (bkey_s, bperm, n_live) — no build Page on device.
+    Composite-key verification happens host-side in attach_build_host
+    (the build columns live there)."""
+    probe_keys = tuple(probe_keys)
+
+    def op(probe: Page, bkey_s, bperm, n_live):
+        n_build = bkey_s.shape[0]
+        pkey, pnull = _key_u64(probe, probe_keys)
+        p_dead = ~probe.row_mask() | pnull
+        lo = _searchsorted_anchored(bkey_s, pkey)
+        lo_c = jnp.minimum(lo, jnp.maximum(n_build - 1, 0))
+        found = (jnp.take(bkey_s, lo_c, mode="clip") == pkey) & \
+            (lo < n_live) & ~p_dead
+        brow = jnp.take(bperm, lo_c, mode="clip").astype(jnp.int64)
+        brow_col = Column(brow, None, T.BIGINT, None)
+        pre = Page(tuple(probe.columns) + (brow_col,), probe.num_rows)
+        out = pre.filter(found)
+        return out, out.num_rows.astype(jnp.int64)
+
+    return op
+
+
+def attach_build_host(pre: Page, n_probe_cols: int, host_cols,
+                      verify: Optional[Sequence[Tuple[int, int]]] = None
+                      ) -> Page:
+    """Host-side attach for the spilled path: gather build columns from
+    host numpy arrays at the matched rows' original indices and stage only
+    the match-count-sized result. `host_cols` is [(values_np, valid_np or
+    None, type, dictionary)]. `verify` = [(probe_ch, build_col_idx)] pairs
+    re-checked for composite keys (hash collisions)."""
+    import numpy as np
+    n = int(pre.num_rows)
+    brow = np.asarray(
+        jax.device_get(pre.columns[n_probe_cols].values[:max(n, 1)]))[:n] \
+        .astype(np.int64)
+    keep = None
+    if verify:
+        for pch, bci in verify:
+            pv = np.asarray(jax.device_get(
+                pre.columns[pch].values[:max(n, 1)]))[:n]
+            bv = host_cols[bci][0][brow]
+            eq = pv == bv
+            keep = eq if keep is None else (keep & eq)
+    if keep is not None and not keep.all():
+        sel = np.nonzero(keep)[0]
+        brow = brow[sel]
+    else:
+        sel = None
+    cap = pre.capacity
+    bcols = []
+    for values, valid, typ, d in host_cols:
+        g = values[brow]
+        v = valid[brow] if valid is not None else None
+        bcols.append(Column.from_numpy(
+            _pad_np(g, cap), typ,
+            valid=None if v is None else _pad_np(v, cap), dictionary=d))
+    pcols = pre.columns[:n_probe_cols]
+    if sel is not None:
+        keep_dev = jnp.zeros(cap, dtype=jnp.bool_) \
+            .at[jnp.asarray(sel)].set(True)
+        filtered = Page(pcols, pre.num_rows).filter(keep_dev)
+        pcols = filtered.columns
+        nrows = filtered.num_rows
+    else:
+        nrows = pre.num_rows
+    return Page(tuple(pcols) + tuple(bcols), nrows)
+
+
+def _pad_np(arr, cap):
+    import numpy as np
+    if len(arr) == cap:
+        return arr
+    out = np.zeros(cap, dtype=arr.dtype)
+    out[:len(arr)] = arr
+    return out
+
+
 def unique_inner_probe(
     probe_keys: Sequence[int],
     build_keys: Sequence[int],
@@ -401,6 +541,50 @@ def unique_inner_probe(
         pre = Page(tuple(probe.columns) + (brow_col,), probe.num_rows)
         out = pre.filter(found)
         return out, out.num_rows.astype(jnp.int64)
+
+    return op
+
+
+def build_key_bounds(build_keys: Sequence[int]):
+    """Dynamic-filter source (operator/DynamicFilterSourceOperator.java +
+    server/DynamicFilterService.java:102 analog, collapsed to the
+    single-controller design): after the build side is collected, its key
+    min/max become device scalars the probe-side scan stream filters by —
+    no coordinator round trip, the scalars never leave the device.
+
+    Exact-set pruning (Trino's small-build IN-list filter) is deliberately
+    NOT a separate pass here: the unique-build probe path already compacts
+    non-matching probe rows with one stable sort before any build-column
+    gather, which is the same work an exact-set semi prefilter would do."""
+    build_keys = tuple(build_keys)
+
+    def op(build: Page):
+        c = build.column(build_keys[0])
+        live = build.row_mask()
+        if c.valid is not None:
+            live = live & c.valid
+        v = c.values
+        if jnp.issubdtype(v.dtype, jnp.integer):
+            big, small = jnp.iinfo(v.dtype).max, jnp.iinfo(v.dtype).min
+        else:
+            big, small = jnp.inf, -jnp.inf
+        lo = jnp.min(jnp.where(live, v, big))
+        hi = jnp.max(jnp.where(live, v, small))
+        return lo, hi
+
+    return op
+
+
+def range_prefilter(probe_key: int):
+    """Probe-side dynamic-filter application: drop rows whose key can't be
+    in [lo, hi] (NULL keys never match an INNER join, so they drop too)."""
+
+    def op(page: Page, lo, hi) -> Page:
+        c = page.column(probe_key)
+        keep = (c.values >= lo) & (c.values <= hi)
+        if c.valid is not None:
+            keep = keep & c.valid
+        return page.filter(keep)
 
     return op
 

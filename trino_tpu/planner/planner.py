@@ -686,11 +686,10 @@ class _PlanBuilder:
                          for a, ty in zip(args, resolved.arg_types))
             agg_name, distinct = resolved.name, fc.distinct
             if agg_name == "approx_distinct":
-                # executed as an exact DISTINCT count (standard error 0);
-                # the optional max-standard-error argument is advisory and
-                # dropped before symbolization so it never materializes.
+                # real HyperLogLog sketch (ops/aggregate._hll_grouped,
+                # m=2048 -> 2.30% standard error); the optional
+                # max-standard-error argument is advisory and dropped.
                 # Reference: ApproximateCountDistinctAggregation.java
-                agg_name, distinct = "count", True
                 args = args[:1]
             arg_syms = tuple(to_symbol(a, "aggarg") for a in args)
             filt_sym = None
@@ -1287,8 +1286,7 @@ class _PlanBuilder:
                          for a, ty in zip(args, resolved.arg_types))
             agg_name, distinct = resolved.name, fc.distinct
             if agg_name == "approx_distinct":
-                # same exact-DISTINCT-count rewrite as plan_aggregation
-                agg_name, distinct = "count", True
+                # HLL sketch; advisory error argument dropped
                 args = args[:1]
             arg_syms = tuple(to_symbol(a, "aggarg") for a in args)
             out_sym = planner.symbols.new(name, resolved.return_type)

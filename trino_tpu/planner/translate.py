@@ -295,6 +295,9 @@ class ExpressionTranslator:
             target = T.parse_type(node.target_type)
             if isinstance(a, Literal) and a.value is None:
                 return Literal(None, target)
+            if node.safe:
+                # TRY_CAST: NULL instead of error on unconvertible values
+                return Call("try_cast", (a,), target)
             return cast_to(a, target)
         if isinstance(node, t.Extract):
             a = self._translate(node.value)

@@ -39,7 +39,7 @@ AGGREGATE_NAMES = frozenset({
     "every", "arbitrary", "any_value", "stddev", "stddev_pop", "stddev_samp",
     "variance", "var_pop", "var_samp", "approx_distinct", "corr", "covar_pop",
     "covar_samp", "regr_slope", "regr_intercept", "checksum", "geometric_mean",
-    "min_by", "max_by",
+    "min_by", "max_by", "approx_percentile",
 })
 
 WINDOW_NAMES = frozenset({
@@ -221,10 +221,24 @@ def resolve_scalar(name: str, arg_types: Sequence[T.Type]) -> ResolvedFunction:
                 out = T.DecimalType(args[0].precision - args[0].scale + 1, 0)
             return ResolvedFunction("round", args, out)
         return ResolvedFunction("round_digits", args, args[0])
-    if n in ("sqrt", "exp", "ln", "log10", "power", "pow", "cbrt"):
+    if n == "truncate":
+        if len(args) == 2:
+            return ResolvedFunction("truncate", (T.DOUBLE, T.BIGINT),
+                                    T.DOUBLE)
+        return ResolvedFunction("truncate", (T.DOUBLE,), T.DOUBLE)
+    if n in ("sqrt", "exp", "ln", "log10", "log2", "power", "pow", "cbrt",
+             "sin", "cos", "tan", "asin", "acos", "atan", "atan2", "sinh",
+             "cosh", "tanh", "radians", "degrees", "log"):
         canonical = "power" if n == "pow" else n
         coerced = tuple(T.DOUBLE for _ in args)
         return ResolvedFunction(canonical, coerced, T.DOUBLE)
+    if n in ("pi", "e"):
+        if args:
+            raise SemanticError(f"{n}() takes no arguments")
+        return ResolvedFunction(n, (), T.DOUBLE)
+    if n == "mod":
+        return ResolvedFunction("modulus", args,
+                                common_type(args[0], args[1]) or args[0])
     if n == "sign":
         return sig(args[0])
     if n in ("greatest", "least"):
@@ -235,25 +249,42 @@ def resolve_scalar(name: str, arg_types: Sequence[T.Type]) -> ResolvedFunction:
                 raise SemanticError(f"{n}() mixed argument types")
             ct = nt
         return ResolvedFunction(n, tuple(ct for _ in args), ct)
-    if n in ("year", "month", "day", "quarter", "day_of_week", "day_of_year",
-             "week", "hour", "minute", "second"):
-        return sig(T.BIGINT)
+    if n in ("year", "month", "day", "quarter", "day_of_week", "dow",
+             "day_of_year", "doy", "week", "week_of_year", "day_of_month",
+             "hour", "minute", "second"):
+        canonical = {"dow": "day_of_week", "doy": "day_of_year",
+                     "week_of_year": "week", "day_of_month": "day"}.get(n, n)
+        return ResolvedFunction(canonical, args, T.BIGINT)
     if n == "date_trunc":
         return sig(args[1] if len(args) > 1 else T.DATE)
+    if n == "date_diff":
+        if len(args) == 3 and {type(args[1]), type(args[2])} == \
+                {T.DateType, T.TimestampType}:
+            # mixed operands: DATE coerces to TIMESTAMP (TypeCoercion)
+            coerced = (args[0], T.TIMESTAMP, T.TIMESTAMP)
+            return ResolvedFunction(n, coerced, T.BIGINT)
+        return sig(T.BIGINT)
+    if n == "date_add":
+        return sig(args[2] if len(args) > 2 else T.DATE)
+    if n == "last_day_of_month":
+        return sig(T.DATE)
     if n in ("lower", "upper", "trim", "ltrim", "rtrim", "reverse"):
         return sig(args[0])
     if n in ("substr", "substring"):
         return ResolvedFunction("substr", args, args[0])
-    if n == "replace":
-        return sig(args[0])
+    if n in ("replace", "lpad", "rpad", "split_part", "regexp_replace",
+             "regexp_extract", "concat_ws"):
+        return sig(T.VarcharType())
     if n == "concat":
         return sig(args[0] if T.is_string(args[0]) else T.VarcharType())
-    if n == "length":
-        return sig(T.BIGINT)
-    if n == "like":
+    if n in ("length", "strpos", "codepoint"):
+        return ResolvedFunction(n, args, T.BIGINT)
+    if n in ("like", "regexp_like", "starts_with"):
         return sig(T.BOOLEAN)
-    if n == "strpos":
-        return sig(T.BIGINT)
+    if n == "try_cast":
+        # synthesized by the translator for TRY_CAST; target type is
+        # pre-resolved there
+        return sig(args[0])
     raise SemanticError(f"unknown function: {name}()")
 
 
@@ -297,6 +328,12 @@ def resolve_aggregate(name: str, arg_types: Sequence[T.Type]
         return ResolvedFunction("arbitrary", args, a)
     if n == "approx_distinct":
         return ResolvedFunction("approx_distinct", args, T.BIGINT)
+    if n == "approx_percentile":
+        if len(args) != 2:
+            raise SemanticError(
+                "approx_percentile(x, percentile) takes two arguments")
+        return ResolvedFunction("approx_percentile",
+                                (args[0], T.DOUBLE), args[0])
     if n == "checksum":
         return ResolvedFunction("checksum", args, T.BIGINT)
     if n in ("corr", "covar_pop", "covar_samp", "regr_slope",
