@@ -44,7 +44,7 @@ from trino_tpu.exec.runner import LocalQueryRunner, MaterializedResult
 from trino_tpu.metadata import Metadata, Session
 from trino_tpu.ops import AggSpec, SortKey, Step, hash_aggregate, order_by
 from trino_tpu.ops.aggregate import get_aggregate
-from trino_tpu.page import Column, Page, concat_pages, union_dictionaries
+from trino_tpu.page import Column, Page, union_dictionaries
 from trino_tpu.parallel.exchange import (all_to_all_by_key, broadcast_page)
 from trino_tpu.parallel.mesh import QueryMesh
 from trino_tpu.planner.nodes import (
@@ -71,11 +71,17 @@ class ShardExecutionPlanner(LocalExecutionPlanner):
 
     def __init__(self, metadata: Metadata, session: Session, shard: int,
                  n_shards: int,
-                 exchange_inputs: Dict[int, List[Optional[Page]]]):
+                 exchange_inputs: Dict[int, List[Optional[Page]]],
+                 device=None):
         super().__init__(metadata, session)
         self.shard = shard
         self.n_shards = n_shards
         self.exchange_inputs = exchange_inputs
+        # the mesh device this task's pipelines run on: leaf pages are
+        # placed here, and every downstream kernel follows its inputs, so
+        # per-shard work queues on per-device streams and OVERLAPS across
+        # the mesh (NodeScheduler split->node assignment analog)
+        self.device = device
 
     # ------------------------------------------------------------- leaves
 
@@ -89,7 +95,10 @@ class ShardExecutionPlanner(LocalExecutionPlanner):
 
         def gen():
             for split in mine:
-                yield from conn.page_source.pages(split, columns, cap)
+                for page in conn.page_source.pages(split, columns, cap):
+                    if self.device is not None:
+                        page = jax.device_put(page, self.device)
+                    yield page
         return PageStream(gen(), tuple(s for s, _ in node.assignments))
 
     def _split_capacity(self, conn, node: TableScanNode, splits) -> int:
@@ -280,17 +289,25 @@ class DistributedQueryRunner(LocalQueryRunner):
         exchange_inputs = self._schedule_children(frag)
         shards = [0] if frag.partitioning == "single" else \
             list(range(self.mesh.n))
-        out: List[Optional[Page]] = [None] * self.mesh.n
+        # dispatch every shard's pipeline before the batched result sync.
+        # Leaf pages are device_put onto mesh device `shard`, so each
+        # task's kernels queue on ITS device's stream: STREAMING fragments
+        # (scan/filter/partial-agg) overlap across the mesh, while a
+        # fragment with a blocking operator still serializes at that
+        # operator's internal count fetch — full overlap needs the
+        # per-fragment shard_map program (SURVEY §7 step 7, next round).
+        # Reference: SqlQueryScheduler.java:538 concurrent stage tasks.
+        dispatched: List[Tuple[int, ShardExecutionPlanner, list]] = []
         for shard in shards:
             executor = ShardExecutionPlanner(
                 self.metadata, self.session, shard, self.mesh.n,
-                exchange_inputs)
-            stream = executor.execute(frag.root)
-            pages = [p for p in stream.iter_pages()
-                     if int(p.num_rows) > 0]
-            if pages:
-                out[shard] = pages[0] if len(pages) == 1 \
-                    else concat_pages(pages)
+                exchange_inputs, device=self.mesh.device_of(shard))
+            dispatched.append(
+                (shard, executor, list(executor.execute(frag.root)
+                                       .iter_pages())))
+        out: List[Optional[Page]] = [None] * self.mesh.n
+        for shard, executor, pages in dispatched:
+            out[shard] = executor.merge_counted(pages)
         return out
 
     # ------------------------------------------------------ exchange plane

@@ -326,25 +326,29 @@ class LocalExecutionPlanner:
         what consumes HBM (streamed pages flow through one fused kernel).
         Freed at operator scope via _free_collected."""
         from trino_tpu.exec.memory import page_bytes
-        pages = list(stream.iter_pages())
+        page = self.merge_counted(list(stream.iter_pages()))
+        if page is None:
+            return None
+        self.memory.reserve(page_bytes(page), "collect")
+        return page
+
+    def merge_counted(self, pages: List[Page]) -> Optional[Page]:
+        """Concatenate pages ON DEVICE (dynamic_update_slice cascade) with
+        ONE batched count fetch — the host bounce (concat_pages) moved
+        every live row through the tunnel, and a per-page num_rows check
+        costs a ~95ms round trip each. Pages shrink to their live pow2
+        first so the concat transient is O(live rows), not O(sum of scan
+        capacities). Shared by blocking collects and the distributed
+        runner's per-shard fragment outputs."""
         if not pages:
             return None
-        # concatenate ON DEVICE (dynamic_update_slice cascade) with ONE
-        # batched count fetch — the host bounce (concat_pages) moved every
-        # live row through the tunnel, and the old per-page num_rows
-        # check cost a ~95ms round trip per page. Each page is shrunk to
-        # its live pow2 first so the concat transient is O(live), not
-        # O(sum of scan capacities) — a selective filter over many scan
-        # pages would otherwise allocate the whole unfiltered footprint.
         counts = [int(c) for c in jax.device_get(
             [p.num_rows for p in pages])]
         total = sum(counts)
         if total == 0:
             return None
         live = [self._tight(p, c) for p, c in zip(pages, counts) if c > 0]
-        page = self._merge_buf(live, total)
-        self.memory.reserve(page_bytes(page), "collect")
-        return page
+        return self._merge_buf(live, total)
 
     @staticmethod
     def _tight(page: Page, n: int) -> Page:

@@ -35,6 +35,10 @@ class QueryMesh:
         self.mesh = Mesh(np.array(devices), (self.AXIS,))
         self.n = len(devices)
 
+    def device_of(self, shard: int):
+        """The physical device executing worker `shard`'s task pipelines."""
+        return self.mesh.devices.flat[shard]
+
     # ---------------------------------------------------------- placement
 
     def replicated(self, tree):
