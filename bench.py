@@ -27,10 +27,14 @@ import json
 import os
 import time
 
-# bound the device-side scan-column LRU before the connector module loads
-# (SF100 streams far more than any cache could hold; a big cache only
-# crowds out join state)
-os.environ.setdefault("TRINO_TPU_SCAN_CACHE_BYTES", str(1 << 30))
+# total wall budget: SF100 rungs are skipped once exceeded so the JSON
+# line ALWAYS prints (a single runaway rung must not eat the whole bench)
+BUDGET_S = int(os.environ.get("TRINO_TPU_BENCH_BUDGET_S", 5400))
+_T0 = time.monotonic()
+
+
+def _over_budget() -> bool:
+    return time.monotonic() - _T0 > BUDGET_S
 
 Q6 = """
 SELECT sum(l_extendedprice * l_discount) AS revenue
@@ -202,10 +206,18 @@ def main():
     extra["hash_join_vs_baseline"] = round(
         (probe_rows / jm) / BASE_JOIN_ROWS_PER_S, 3)
 
-    if os.environ.get("TRINO_TPU_BENCH_SF100", "1") != "0":
+    if os.environ.get("TRINO_TPU_BENCH_SF100", "1") != "0" \
+            and _over_budget():
+        extra["sf100_rungs"] = \
+            f"skipped: bench wall budget ({BUDGET_S}s) exhausted"
+    if os.environ.get("TRINO_TPU_BENCH_SF100", "1") != "0" \
+            and not _over_budget():
+        # SF100: shrink the scan cache so join state owns the HBM, and
+        # stream probes in smaller buffers (wide-buffer probe sorts
+        # exhaust per-op scratch — round-4 measurement)
+        from trino_tpu.connector import tpch as tpch_conn
+        tpch_conn.set_device_cache_budget(1 << 30)
         sf100 = LocalQueryRunner.tpch("sf100")
-        # SF100 probes stream in smaller buffers: wide-buffer probe sorts
-        # exhaust per-op scratch (round-4 measurement)
         sf100.execute("SET SESSION probe_coalesce_rows = 4194304")
 
         def run_q9():
@@ -225,8 +237,13 @@ def main():
                 ds100.execute(sql)
                 return time.perf_counter() - t0
             return go
-        _try_rung(extra, "tpcds_q64_sf100", BASE_Q64_SF100_S, run_ds(Q64))
-        _try_rung(extra, "tpcds_q72_sf100", BASE_Q72_SF100_S, run_ds(Q72))
+        for tag, base, q in (("tpcds_q64_sf100", BASE_Q64_SF100_S, Q64),
+                             ("tpcds_q72_sf100", BASE_Q72_SF100_S, Q72)):
+            if _over_budget():
+                extra[f"{tag}_error"] = \
+                    f"skipped: bench wall budget ({BUDGET_S}s) exhausted"
+                continue
+            _try_rung(extra, tag, base, run_ds(q))
 
     print(json.dumps({
         "metric": "tpch_q6_sf1_wall_s",

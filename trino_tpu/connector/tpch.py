@@ -218,6 +218,17 @@ _DEVICE_COL_CACHE_BYTES = int(os.environ.get(
 _DEVICE_COL_CACHE_USED = 0
 
 
+def set_device_cache_budget(nbytes: int) -> None:
+    """Adjust the staged-column LRU budget at runtime (bench shrinks it
+    before SF100 rungs so join state owns the HBM, evicting as needed)."""
+    global _DEVICE_COL_CACHE_BYTES, _DEVICE_COL_CACHE_USED
+    _DEVICE_COL_CACHE_BYTES = int(nbytes)
+    while _DEVICE_COL_CACHE_USED > _DEVICE_COL_CACHE_BYTES \
+            and _DEVICE_COL_CACHE:
+        _, evicted = _DEVICE_COL_CACHE.popitem(last=False)
+        _DEVICE_COL_CACHE_USED -= evicted.nbytes
+
+
 def _staged_column(table: str, sf: float, name: str, typ: T.Type,
                    off: int, hi: int, page_capacity: int) -> Column:
     """Generate + pad + stage one column slice to device, once per
