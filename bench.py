@@ -9,6 +9,13 @@ a dedicated SF10 lineitem-orders join. Every query runs through the full
 engine (parse -> plan -> optimize -> execute). Prints ONE JSON line; the
 headline metric stays q6 SF1 wall-clock with the other rungs in "extra".
 
+SF100 rungs run in FRESH SUBPROCESSES (one per rung): the reference's
+benchmark discipline separates prewarm from measurement per run
+(trino-benchto-benchmarks), and an in-process run after the warm SF1/SF10
+runners carries device-state residue (scan caches, kernel workspaces,
+fragment intermediates) that made the rungs irreproducible in round 4.
+A child prints one JSON line on stdout; the parent merges it.
+
 vs_baseline: the reference repo publishes no numbers (BASELINE.md); the
 denominators are ballpark single-node Trino wall-clocks from its
 LocalQueryRunner-style benchmarks on server CPUs — q6 SF1 ~1.0s, q1 SF1
@@ -25,6 +32,8 @@ comparison is same-shape wall-clock, not row-identical output.
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 # total wall budget: SF100 rungs are skipped once exceeded so the JSON
@@ -33,8 +42,8 @@ BUDGET_S = int(os.environ.get("TRINO_TPU_BENCH_BUDGET_S", 5400))
 _T0 = time.monotonic()
 
 
-def _over_budget() -> bool:
-    return time.monotonic() - _T0 > BUDGET_S
+def _remaining() -> float:
+    return BUDGET_S - (time.monotonic() - _T0)
 
 Q6 = """
 SELECT sum(l_extendedprice * l_discount) AS revenue
@@ -158,6 +167,85 @@ BASE_Q64_SF100_S = 120.0
 BASE_Q72_SF100_S = 200.0
 BASE_JOIN_ROWS_PER_S = 50e6     # ballpark single-node probe throughput
 
+SF100_RUNGS = {
+    "tpch_q9_sf100": (BASE_Q9_SF100_S, "tpch", Q9),
+    "tpcds_q64_sf100": (BASE_Q64_SF100_S, "tpcds", Q64),
+    "tpcds_q72_sf100": (BASE_Q72_SF100_S, "tpcds", Q72),
+}
+
+
+def _sf100_runner(catalog: str):
+    import trino_tpu
+    trino_tpu.enable_persistent_cache()
+    from trino_tpu.connector import tpch as tpch_conn
+    from trino_tpu.exec import LocalQueryRunner
+    # shrink the scan cache so join state owns the HBM, and stream probes
+    # in smaller buffers (wide-buffer probe sorts exhaust per-op scratch —
+    # round-4 measurement)
+    tpch_conn.set_device_cache_budget(1 << 30)
+    runner = LocalQueryRunner.tpch("sf100")
+    if catalog == "tpcds":
+        runner.execute("USE tpcds.sf100")
+    runner.execute("SET SESSION probe_coalesce_rows = 4194304")
+    return runner
+
+
+def run_rung(tag: str) -> None:
+    """Child mode: execute ONE SF100 rung in this (fresh) process and
+    print a single JSON line {"wall_s": ...} or {"error": ...}."""
+    base, catalog, sql = SF100_RUNGS[tag]
+    try:
+        runner = _sf100_runner(catalog)
+        t0 = time.perf_counter()
+        rows = runner.execute(sql).rows
+        wall = time.perf_counter() - t0
+        if tag == "tpch_q9_sf100":
+            assert rows, "q9 returned no rows"
+        print(json.dumps({"wall_s": round(wall, 2)}), flush=True)
+    except Exception as e:  # noqa: BLE001 — the rung must report, not die
+        print(json.dumps(
+            {"error": f"{type(e).__name__}: {str(e)[:160]}"}), flush=True)
+
+
+def _run_rung_subprocess(extra: dict, tag: str, base: float) -> None:
+    """Launch `python bench.py --rung TAG` and merge its JSON line."""
+    timeout = _remaining()
+    if timeout < 60:
+        extra[f"{tag}_error"] = \
+            f"skipped: bench wall budget ({BUDGET_S}s) exhausted"
+        return
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--rung", tag],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        extra[f"{tag}_error"] = \
+            f"timeout: exceeded bench wall budget ({BUDGET_S}s)"
+        return
+    # one malformed child line must cost ONE rung, not the whole bench
+    try:
+        line = None
+        for ln in reversed(proc.stdout.strip().splitlines()):
+            ln = ln.strip()
+            if ln.startswith("{"):
+                line = ln
+                break
+        if line is None:
+            tail = (proc.stderr or proc.stdout or "").strip()[-200:]
+            extra[f"{tag}_error"] = \
+                f"rung subprocess rc={proc.returncode}: {tail}"
+            return
+        got = json.loads(line)
+        if "error" in got:
+            extra[f"{tag}_error"] = got["error"]
+        else:
+            wall = float(got["wall_s"])
+            extra[f"{tag}_wall_s"] = wall
+            extra[f"{tag}_vs_baseline"] = round(base / wall, 3)
+    except Exception as e:  # noqa: BLE001
+        extra[f"{tag}_error"] = f"rung result parse: {type(e).__name__}: {e}"
+
 
 def _time_query(runner, sql, iters=3):
     rows = runner.execute(sql).rows  # warm-up (compile) run, untimed
@@ -170,20 +258,12 @@ def _time_query(runner, sql, iters=3):
     return sorted(times)[len(times) // 2]  # median
 
 
-def _try_rung(extra, tag, base, fn):
-    try:
-        wall = fn()
-        extra[f"{tag}_wall_s"] = round(wall, 2)
-        extra[f"{tag}_vs_baseline"] = round(base / wall, 3)
-    except Exception as e:
-        extra[f"{tag}_error"] = f"{type(e).__name__}: {str(e)[:160]}"
-
-
 def main():
     import trino_tpu
     # persistent compile cache: repeat driver rounds skip XLA recompiles
     trino_tpu.enable_persistent_cache()
 
+    from trino_tpu.connector.tpch import table_row_count
     from trino_tpu.exec import LocalQueryRunner
 
     extra = {}
@@ -200,50 +280,15 @@ def main():
 
     # BASELINE metric: hash-join probe rows/sec/chip (60M-row lineitem
     # probe into a unique 15M-row orders build)
-    probe_rows = 59_993_741
+    probe_rows = table_row_count("lineitem", 10.0)
     jm = _time_query(sf10, JOIN_MICRO, iters=2)
     extra["hash_join_probe_rows_per_s_per_chip"] = round(probe_rows / jm)
     extra["hash_join_vs_baseline"] = round(
         (probe_rows / jm) / BASE_JOIN_ROWS_PER_S, 3)
 
-    if os.environ.get("TRINO_TPU_BENCH_SF100", "1") != "0" \
-            and _over_budget():
-        extra["sf100_rungs"] = \
-            f"skipped: bench wall budget ({BUDGET_S}s) exhausted"
-    if os.environ.get("TRINO_TPU_BENCH_SF100", "1") != "0" \
-            and not _over_budget():
-        # SF100: shrink the scan cache so join state owns the HBM, and
-        # stream probes in smaller buffers (wide-buffer probe sorts
-        # exhaust per-op scratch — round-4 measurement)
-        from trino_tpu.connector import tpch as tpch_conn
-        tpch_conn.set_device_cache_budget(1 << 30)
-        sf100 = LocalQueryRunner.tpch("sf100")
-        sf100.execute("SET SESSION probe_coalesce_rows = 4194304")
-
-        def run_q9():
-            t0 = time.perf_counter()
-            rows = sf100.execute(Q9).rows
-            assert rows, "q9 returned no rows"
-            return time.perf_counter() - t0
-        _try_rung(extra, "tpch_q9_sf100", BASE_Q9_SF100_S, run_q9)
-
-        ds100 = LocalQueryRunner.tpch("sf100")
-        ds100.execute("USE tpcds.sf100")
-        ds100.execute("SET SESSION probe_coalesce_rows = 4194304")
-
-        def run_ds(sql):
-            def go():
-                t0 = time.perf_counter()
-                ds100.execute(sql)
-                return time.perf_counter() - t0
-            return go
-        for tag, base, q in (("tpcds_q64_sf100", BASE_Q64_SF100_S, Q64),
-                             ("tpcds_q72_sf100", BASE_Q72_SF100_S, Q72)):
-            if _over_budget():
-                extra[f"{tag}_error"] = \
-                    f"skipped: bench wall budget ({BUDGET_S}s) exhausted"
-                continue
-            _try_rung(extra, tag, base, run_ds(q))
+    if os.environ.get("TRINO_TPU_BENCH_SF100", "1") != "0":
+        for tag, (base, _, _) in SF100_RUNGS.items():
+            _run_rung_subprocess(extra, tag, base)
 
     print(json.dumps({
         "metric": "tpch_q6_sf1_wall_s",
@@ -255,4 +300,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--rung":
+        run_rung(sys.argv[2])
+    else:
+        main()

@@ -153,3 +153,38 @@ def test_try_cast_over_rows(r):
         "SELECT try_cast(substr(n_name, 1, 1) AS bigint) FROM nation "
         "LIMIT 2").rows
     assert all(v[0] is None for v in rows)
+
+
+def test_try_cast_numeric_out_of_range(r):
+    # Trino: out-of-range numeric TRY_CAST yields NULL, not saturation
+    assert one(r, "try_cast(1e300 AS bigint)") is None
+    assert one(r, "try_cast(-1e300 AS bigint)") is None
+    assert one(r, "try_cast(1e10 AS integer)") is None
+    assert one(r, "try_cast(300 AS tinyint)") is None
+    assert one(r, "try_cast(100 AS tinyint)") == 100
+    assert one(r, "try_cast(12345678901234 AS decimal(5,2))") is None
+    assert one(r, "try_cast(1.5e0 AS decimal(5,2))") is not None
+    assert one(r, "try_cast(0e0 / 0e0 AS bigint)") is None   # NaN
+    # decimal source -> int target: bound exceeds int64, must not crash
+    assert one(r, "try_cast(l_extendedprice AS bigint) FROM lineitem "
+                  "LIMIT 1") is not None
+    assert one(r, "try_cast(cast(123.45 AS decimal(12,2)) AS tinyint)") \
+        == 123
+    assert one(r, "try_cast(cast(1234.5 AS decimal(12,2)) AS tinyint)") \
+        is None
+    # int64 near the float64 rounding boundary stays exact
+    assert one(r, "try_cast(999999999999999999 AS decimal(18,0))") \
+        is not None
+    # float64 == 2^63 exactly: out of bigint range -> NULL, not saturation
+    assert one(r, "try_cast(9223372036854775808e0 AS bigint)") is None
+
+
+def test_concat_ws_null_args(r):
+    # Trino: NULL value args are skipped; only a NULL separator nulls out
+    assert one(r, "concat_ws('-', 'a', cast(NULL AS varchar), 'c')") \
+        == "a-c"
+    assert one(r, "concat_ws(cast(NULL AS varchar), 'a', 'b')") is None
+    rows = r.execute(
+        "SELECT concat_ws(',', 'x', try_cast(substr(n_name, 1, 1) "
+        "AS varchar), 'y') FROM nation LIMIT 1").rows
+    assert rows[0][0] in ("x,A,y", "x,y") or rows[0][0].count(",") >= 1

@@ -648,7 +648,15 @@ class LocalExecutionPlanner:
         probe_keys = [probe_lay[c.left.name] for c in node.criteria]
         build_keys = [build_lay[c.right.name] for c in node.criteria]
         build_page = self._collect(build_stream)
-        out_symbols = node.left.outputs + node.right.outputs
+        # PruneJoinColumns: node.outputs may be a subset of left+right
+        # (optimizer sets output_symbols) — emit only those channels, so
+        # probe/build gathers skip dropped columns entirely
+        out_symbols = node.outputs
+        out_names = {s.name for s in out_symbols}
+        probe_keep = tuple(i for i, s in enumerate(probe_stream.symbols)
+                           if s.name in out_names)
+        build_keep = tuple(i for i, s in enumerate(build_stream.symbols)
+                           if s.name in out_names)
         join_kind = JoinType.INNER if node.kind == JoinKind.INNER \
             else JoinType.LEFT
 
@@ -663,10 +671,12 @@ class LocalExecutionPlanner:
             lay, typ = _layout(out_symbols)
             post_pred = lower_expr(node.filter, lay, typ)
 
-        def join_op(cap: int):
+        def join_op(cap: int, dense: bool = False):
             def build():
                 op = hash_join(probe_keys, build_keys, join_kind,
-                               output_capacity=cap, prepared=True)
+                               output_capacity=cap, prepared=True,
+                               dense=dense, probe_out=probe_keep,
+                               build_out=build_keep)
                 if post_pred is None:
                     return lambda p, b: op(p, b)
                 post_filter = compile_filter(post_pred)
@@ -677,18 +687,21 @@ class LocalExecutionPlanner:
                 return run
             return cached_kernel(
                 ("join", tuple(probe_keys), tuple(build_keys), join_kind,
-                 cap, post_pred), build)
+                 cap, post_pred, dense, probe_keep, build_keep), build)
 
-        n_probe_cols = len(node.left.outputs)
+        n_probe_cols = len(probe_keep)
 
-        def unique_ops():
+        def unique_ops(dense: bool):
             probe_op = cached_kernel(
-                ("uprobe", tuple(probe_keys), tuple(build_keys)),
-                lambda: unique_inner_probe(probe_keys, build_keys))
+                ("uprobe", tuple(probe_keys), tuple(build_keys), dense,
+                 probe_keep),
+                lambda: unique_inner_probe(probe_keys, build_keys,
+                                           dense=dense,
+                                           probe_out=probe_keep))
 
             def build_attach():
                 from trino_tpu.ops.join import attach_build
-                at = attach_build(n_probe_cols)
+                at = attach_build(n_probe_cols, build_out=build_keep)
                 fn = None if post_pred is None else compile_filter(post_pred)
 
                 def run(pre, prepared):
@@ -698,7 +711,8 @@ class LocalExecutionPlanner:
                     return out
                 return run
             attach_op = cached_kernel(
-                ("uattach", n_probe_cols, post_pred), build_attach)
+                ("uattach", n_probe_cols, post_pred, build_keep),
+                build_attach)
             return probe_op, attach_op
 
         def gen():
@@ -716,10 +730,11 @@ class LocalExecutionPlanner:
                         "join_spill_threshold_bytes")):
                 yield from self._run_spilled_inner(
                     probe_stream, build_page, probe_keys, build_keys,
-                    post_pred, n_probe_cols, join_op)
+                    post_pred, probe_keep, build_keep, join_op)
                 return
             try:
-                prepared = self._prepare_build(build_keys, bp)
+                prepared, max_run, dense = self._prepare_with_dense(
+                    build_keys, bp)
                 prefilter = None
                 if join_kind == JoinType.INNER and \
                         self.session.get("enable_dynamic_filtering") and \
@@ -738,23 +753,25 @@ class LocalExecutionPlanner:
                     prefilter = (pf_op, bounds_op(bp))
                 coalesced = self._coalesce_stream(probe_stream,
                                                   prefilter=prefilter)
-                if join_kind == JoinType.INNER and \
-                        int(jax.device_get(prepared[7])) <= 1:
+                if join_kind == JoinType.INNER and max_run <= 1:
                     # unique build side (primary/dimension key): the
                     # no-expansion probe + live-size build attach
-                    probe_op, attach_op = unique_ops()
+                    probe_op, attach_op = unique_ops(dense)
                     yield from self._run_unique_inner(
                         coalesced, prepared, probe_op, attach_op)
                 else:
                     yield from _run_with_overflow(
-                        coalesced, prepared, join_op, self.page_capacity)
+                        coalesced, prepared,
+                        lambda cap: join_op(cap, dense),
+                        self.page_capacity)
             finally:
                 self._free_collected(collected)
         return PageStream(gen(), out_symbols)
 
     def _run_spilled_inner(self, probe_stream, build_page,
                            probe_keys, build_keys, post_pred,
-                           n_probe_cols, fallback_join_op) -> Iterator[Page]:
+                           probe_keep, build_keep,
+                           fallback_join_op) -> Iterator[Page]:
         """Spill-mode INNER join (HashBuilderOperator spill states +
         SpillingJoinProcessor analog): sort the build keys on device, move
         the build's payload columns to HOST RAM, keep only (sorted keys,
@@ -764,7 +781,9 @@ class LocalExecutionPlanner:
         >threshold case: big builds are fact/dimension primary keys)."""
         from trino_tpu.exec.memory import page_bytes
         from trino_tpu.ops.join import (attach_build_host,
+                                        build_dense_table_rows,
                                         prepare_build_spilled,
+                                        spilled_dense_probe,
                                         spilled_unique_probe)
         # varchar join keys compare by per-dictionary code — the spilled
         # probe never sees the build dictionaries, so it cannot apply the
@@ -778,28 +797,53 @@ class LocalExecutionPlanner:
                 prep = cached_kernel(
                     ("spill-prep", tuple(build_keys)),
                     lambda: prepare_build_spilled(build_keys))
-                bkey_s, bperm, n_live, n_rows_d, has_null, is_unique_d = \
-                    prep(build_page)
-                is_unique = bool(jax.device_get(is_unique_d))
-                n_rows = int(jax.device_get(n_rows_d))
+                (bkey_s, bperm, n_live, n_rows_d, has_null, is_unique_d,
+                 kmin_d, kmax_d) = prep(build_page)
+                # ONE batched round trip for all four scalars (~95ms each
+                # through the tunnel)
+                uq, nr, km, kx = jax.device_get(
+                    [is_unique_d, n_rows_d, kmin_d, kmax_d])
+                is_unique, n_rows, kmin, kmax = \
+                    bool(uq), int(nr), int(km), int(kx)
             except Exception:
                 self._free_collected(build_page)
                 raise
         if string_keyed or not is_unique:
             # duplicate keys need the expansion kernel; run in-memory
             try:
-                prepared = self._prepare_build(build_keys, build_page)
+                prepared, _max_run, dense = self._prepare_with_dense(
+                    build_keys, build_page)
                 yield from _run_with_overflow(
                     self._coalesce_stream(probe_stream), prepared,
-                    fallback_join_op, self.page_capacity)
+                    lambda cap: fallback_join_op(cap, dense),
+                    self.page_capacity)
             finally:
                 self._free_collected(build_page)
             return
+        # pruned layouts: the pre page carries kept probe cols (plus
+        # verify-only key cols for composite keys, dropped after attach);
+        # only kept build cols move to host for emission, key cols ride
+        # along host-side when composite verification needs them
+        composite = len(probe_keys) > 1
+        probe_out = list(probe_keep)
+        extra_p = [k for k in probe_keys if k not in probe_out] \
+            if composite else []
+        probe_out_full = tuple(probe_out + extra_p)
+        n_pre_cols = len(probe_out_full)
+        host_idx = list(build_keep) + \
+            ([k for k in build_keys if k not in build_keep]
+             if composite else [])
+        emit = tuple(range(len(build_keep)))
+        verify = None
+        if composite:
+            verify = [(probe_out_full.index(pk), host_idx.index(bk))
+                      for pk, bk in zip(probe_keys, build_keys)]
         # move payload columns to host, free the device page
         try:
             host_cols = []
             fetch = []
-            for c in build_page.columns:
+            for ci in host_idx:
+                c = build_page.columns[ci]
                 fetch.append(c.values[:max(n_rows, 1)])
                 fetch.append(None if c.valid is None
                              else c.valid[:max(n_rows, 1)])
@@ -808,57 +852,100 @@ class LocalExecutionPlanner:
             self._free_collected(build_page)
             raise
         it = iter(got)
-        for c in build_page.columns:
+        for ci in host_idx:
+            c = build_page.columns[ci]
             vals = np.asarray(next(it))
             valid = None if c.valid is None else np.asarray(next(it))
             host_cols.append((vals, valid, c.type, c.dictionary))
         self._free_collected(build_page)
-        self.memory.reserve(
-            int(bkey_s.nbytes + bperm.nbytes), "join-spill-keys")
-        probe_op = cached_kernel(
-            ("spill-probe", tuple(probe_keys)),
-            lambda: spilled_unique_probe(probe_keys))
-        verify = list(zip(probe_keys, build_keys)) \
-            if len(probe_keys) > 1 else None
+        # dense spilled builds (surrogate keys, the common >threshold
+        # case): ONE int32 row table on device — ~4B/slot instead of
+        # 12B/row, and probes are one gather instead of anchored search
+        span = kmax - kmin + 1 if kmax >= kmin else 0
+        spill_dense = 0 < span <= (1 << 28)
+        if spill_dense:
+            size = _next_pow2(span)
+            tab_op = cached_kernel(("dense-table-rows", size),
+                                   lambda: build_dense_table_rows(size))
+            table = tab_op(bkey_s, bperm, n_live, kmin)
+            kmin_dev = jnp.uint64(kmin)
+            bkey_s = bperm = None   # free sorted keys + permutation
+            held_bytes = int(table.nbytes)
+            probe_op = cached_kernel(
+                ("spill-probe-dense", tuple(probe_keys), probe_out_full),
+                lambda: spilled_dense_probe(probe_keys,
+                                            probe_out=probe_out_full))
+        else:
+            held_bytes = int(bkey_s.nbytes + bperm.nbytes)
+            probe_op = cached_kernel(
+                ("spill-probe", tuple(probe_keys), probe_out_full),
+                lambda: spilled_unique_probe(probe_keys,
+                                             probe_out=probe_out_full))
+        self.memory.reserve(held_bytes, "join-spill-keys")
         post_filter = None if post_pred is None else \
             compile_filter(post_pred)
+        drop_extra = None
+        if extra_p:
+            drop_extra = tuple(range(len(probe_keep))) + tuple(
+                range(n_pre_cols, n_pre_cols + len(build_keep)))
         try:
             it2 = probe_stream if isinstance(probe_stream, Iterator) \
                 else self._coalesce_stream(probe_stream).iter_pages()
             for batch in _byte_bounded_batches(it2, 1 << 29):
-                results = [probe_op(p, bkey_s, bperm, n_live)
-                           for p in batch]
-                totals = jax.device_get([t for _, t in results])
-                for (pre, _), total in zip(results, totals):
-                    total = int(total)
+                if spill_dense:
+                    results = [probe_op(p, table, kmin_dev) for p in batch]
+                else:
+                    results = [probe_op(p, bkey_s, bperm, n_live)
+                               for p in batch]
+                fetched = jax.device_get(
+                    [(t, pre.num_rows) for pre, _, t in results])
+                for (pre, found, _), (total, live) in zip(results, fetched):
+                    total, live = int(total), int(live)
                     if total == 0:
                         continue
+                    pre = self._compact_probe(pre, found, total, live)
                     pre = self._tight(pre, total)
-                    out = attach_build_host(pre, n_probe_cols, host_cols,
-                                            verify=verify)
+                    out = attach_build_host(pre, n_pre_cols, host_cols,
+                                            verify=verify, emit=emit)
+                    if drop_extra is not None:
+                        out = out.select_columns(drop_extra)
                     if post_filter is not None:
                         out = out.filter(post_filter(out))
                     yield out
         finally:
-            self.memory.free(int(bkey_s.nbytes + bperm.nbytes),
-                             "join-spill-keys")
+            self.memory.free(held_bytes, "join-spill-keys")
+
+    def _compact_probe(self, pre: Page, found, total: int,
+                       live: int) -> Page:
+        """Compact a probe result to its matched rows — SKIPPED when every
+        live row matched (fact-to-dim joins after dynamic filtering often
+        match ~100%; the compaction stable-sort is the single biggest
+        per-buffer cost once the lookup itself is a dense gather)."""
+        if total == live:
+            return pre
+        op = cached_kernel(("probe-compact",),
+                           lambda: lambda p, f: p.filter(f))
+        return op(pre, found)
 
     def _run_unique_inner(self, probe_stream, prepared, probe_op,
                           attach_op) -> Iterator[Page]:
-        """Drive the unique-build INNER fast path: probe+filter kernel per
-        page, batched count fetch, shrink to live size, THEN gather build
-        columns — so the attach gathers run at match count, not probe
-        capacity. No overflow loop: output rows <= probe rows always."""
+        """Drive the unique-build INNER fast path: gather-probe kernel per
+        page, batched count fetch, compact ONLY partially-matching buffers,
+        shrink to live size, THEN gather build columns — so the attach
+        gathers run at match count, not probe capacity. No overflow loop:
+        output rows <= probe rows always."""
         it = probe_stream if isinstance(probe_stream, Iterator) \
             else probe_stream.iter_pages()
         for batch in _byte_bounded_batches(it, 1 << 29):
             results = [probe_op(page, prepared) for page in batch]
-            totals = jax.device_get([t for _, t in results])
-            for (pre, _), total in zip(results, totals):
-                total = int(total)
+            fetched = jax.device_get(
+                [(t, pre.num_rows) for pre, _, t in results])
+            for (pre, found, _), (total, live) in zip(results, fetched):
+                total, live = int(total), int(live)
                 if total == 0:
                     continue
-                yield attach_op(self._tight(pre, total), prepared)
+                out = self._compact_probe(pre, found, total, live)
+                yield attach_op(self._tight(out, total), prepared)
 
     def _prepare_build(self, build_keys, build_page):
         """Sort the build side ONCE per join (LookupSourceFactory analog) —
@@ -866,6 +953,35 @@ class LocalExecutionPlanner:
         prep = cached_kernel(("join-prep", tuple(build_keys)),
                              lambda: prepare_build(build_keys))
         return prep(build_page)
+
+    # direct-address tables: pow2 sizes bound compile-shape diversity; the
+    # slot cap bounds HBM (64M slots = 256MB int32 for in-memory builds)
+    _DENSE_MAX_SLOTS = 1 << 26
+
+    def _prepare_with_dense(self, build_keys, build_page):
+        """prepare_build + the dense-key decision: fetch (max_run, kmin,
+        kmax) in ONE round trip; when the live-key span is small (dense
+        surrogate keys — every TPC-H/DS join), append a direct-address
+        lookup table so probe kernels cost one gather instead of a
+        sort-engine searchsorted pass per buffer.
+
+        Returns (prepared [+ table], max_run, dense)."""
+        from trino_tpu.ops.join import build_dense_table
+        prepared = self._prepare_build(build_keys, build_page)
+        max_run, kmin, kmax = (int(x) for x in jax.device_get(
+            [prepared[7], prepared[8], prepared[9]]))
+        span = kmax - kmin + 1 if kmax >= kmin else 0
+        limit = min(max(4 * build_page.capacity, 1 << 20),
+                    self._DENSE_MAX_SLOTS)
+        dense = 0 < span <= limit
+        if dense:
+            size = _next_pow2(span)
+            table_op = cached_kernel(
+                ("dense-table", size),
+                lambda: build_dense_table(size))
+            table = table_op(prepared[1], prepared[3], prepared[8])
+            prepared = prepared + (table,)
+        return prepared, max_run, dense
 
     def _exec_right_join(self, node: JoinNode) -> PageStream:
         flipped = JoinNode(

@@ -100,7 +100,10 @@ class LocalQueryRunner:
         try:
             stmt = parse_statement(sql)
             result = self._execute_statement(stmt)
-        except Exception as e:
+        except BaseException as e:
+            # BaseException too: a KeyboardInterrupt/SystemExit escaping
+            # mid-query must not leave a forever-RUNNING phantom row in
+            # system.runtime.queries
             TRACKER.fail(info, f"{type(e).__name__}: {e}")
             raise
         TRACKER.finish(info, len(result.rows))

@@ -201,6 +201,8 @@ def _string_transform(expr: Call, page: Page) -> Column:
     gather). NULL-producing transforms (split_part past the last field,
     regexp_extract without a match) carry a per-pool-value ok-table."""
     name = expr.name
+    if name == "concat_ws":
+        return _concat_ws(expr, page)
     col, call, akey = _column_and_literals(expr, page)
     if col.dictionary is None:
         raise NotImplementedError(f"{name} requires dictionary-encoded input")
@@ -217,6 +219,57 @@ def _string_transform(expr: Call, page: Page) -> Column:
                                        lambda s: call(py, s))
     codes = jnp.take(remap, col.values, mode="clip")
     return Column(codes, col.valid, expr.type, nd)
+
+
+def _concat_ws(expr: Call, page: Page) -> Column:
+    """concat_ws(sep, v1, v2, ...): Trino skips NULL value arguments and
+    returns NULL only for a NULL separator (StringFunctions.java concatWs)
+    — unlike the generic AND-of-valid-masks path."""
+    sep_e = expr.args[0]
+    if not isinstance(sep_e, Literal):
+        raise NotImplementedError("concat_ws separator must be a literal")
+    if sep_e.value is None:
+        return Column(jnp.zeros((), dtype=jnp.int32),
+                      jnp.zeros((), dtype=jnp.bool_), expr.type,
+                      Dictionary(np.asarray([""], dtype=object)))
+    sep = str(sep_e.value)
+    col_i = None
+    for i, a in enumerate(expr.args[1:], start=1):
+        if not isinstance(a, Literal):
+            if col_i is not None:
+                raise NotImplementedError(
+                    "concat_ws over two non-literal string args")
+            col_i = i
+    lits = {i: a.value for i, a in enumerate(expr.args) if i != col_i
+            and i > 0}
+    if col_i is None:
+        joined = sep.join(str(v) for v in lits.values() if v is not None)
+        d = Dictionary(np.asarray([joined], dtype=object))
+        return Column(jnp.zeros((), dtype=jnp.int32), None, expr.type, d)
+    col = _eval(expr.args[col_i], page)
+    if col.dictionary is None:
+        raise NotImplementedError("concat_ws requires dictionary input")
+
+    def join_with(s):
+        # s = None models a NULL column value: dropped from the join
+        parts = [lits[i] if i != col_i else s
+                 for i in range(1, len(expr.args))]
+        return sep.join(str(p) for p in parts if p is not None)
+
+    cache = F._dict_cache(col.dictionary)
+    ck = ("concat_ws", sep, tuple(sorted(lits.items())), col_i, "xform")
+    if ck not in cache:
+        table = [join_with(s) for s in col.dictionary.values] \
+            + [join_with(None)]
+        new_vals, codes = np.unique(np.asarray(table, dtype=object),
+                                    return_inverse=True)
+        cache[ck] = (Dictionary(new_vals), codes[:-1].astype(np.int32),
+                     int(codes[-1]))
+    nd, remap, null_code = cache[ck]
+    out = jnp.take(jnp.asarray(remap), col.values, mode="clip")
+    if col.valid is not None:
+        out = jnp.where(col.valid, out, null_code)
+    return Column(out, None, expr.type, nd)
 
 
 _STRING_SCALAR_FNS = {
@@ -314,7 +367,9 @@ def _try_cast(expr: Call, page: Page) -> Column:
     col = _eval(expr.args[0], page)
     if not T.is_string(src_t):
         values = F.lookup("cast")(target, [src_t], col.values)
-        return Column(values, col.valid, target,
+        ok = _numeric_cast_ok(col.values, src_t, target)
+        valid = col.valid if ok is None else _vand(col.valid, ok)
+        return Column(values, valid, target,
                       col.dictionary if T.is_string(target) else None)
     if col.dictionary is None:
         raise NotImplementedError("try_cast requires dictionary input")
@@ -332,6 +387,64 @@ def _try_cast(expr: Call, page: Page) -> Column:
     okv = jnp.take(jnp.asarray(ok_np), col.values, mode="clip")
     valid = okv if col.valid is None else (okv & col.valid)
     return Column(vals, valid, target, None)
+
+
+_INT_TYPES = (T.BigintType, T.IntegerType, T.SmallintType, T.TinyintType)
+
+
+_I64 = (-(1 << 63), (1 << 63) - 1)
+
+
+def _int_range_ok(v: jnp.ndarray, lo: int, hi: int
+                  ) -> Optional[jnp.ndarray]:
+    """v (int64) within [lo, hi], with bounds that may exceed int64 —
+    a bound outside int64 can never be violated, so that side is skipped
+    (jnp would raise OverflowError promoting an out-of-range Python int)."""
+    ok = None
+    if lo > _I64[0]:
+        ok = v >= lo
+    if hi < _I64[1]:
+        c = v <= hi
+        ok = c if ok is None else (ok & c)
+    return ok
+
+
+def _numeric_cast_ok(values: jnp.ndarray, src_t, target
+                     ) -> Optional[jnp.ndarray]:
+    """Out-of-range mask for TRY_CAST on numeric sources: Trino returns
+    NULL where the plain CAST would fail, while the shared cast kernel
+    saturates (it cannot raise per-row). None = always representable.
+    Integer comparisons stay in exact int64 arithmetic (float64 rounding
+    misclassifies values near 2^53..2^63 boundaries)."""
+    if isinstance(target, _INT_TYPES):
+        info = jnp.iinfo(target.dtype)
+        if jnp.issubdtype(values.dtype, jnp.floating):
+            v = values
+            if int(info.max) == _I64[1]:
+                # float64(int64.max) rounds UP to exactly 2^63: exclusive
+                return jnp.isfinite(v) & (v >= float(info.min)) \
+                    & (v < 9223372036854775808.0)
+            return jnp.isfinite(v) & (v >= float(info.min)) \
+                & (v <= float(info.max))
+        v = values.astype(jnp.int64)
+        if isinstance(src_t, T.DecimalType):
+            # scaled-int source: target range in source-scaled units
+            scale = 10 ** src_t.scale
+            return _int_range_ok(v, int(info.min) * scale,
+                                 int(info.max) * scale)
+        return _int_range_ok(v, int(info.min), int(info.max))
+    if isinstance(target, T.DecimalType):
+        # cast multiplies by 10^scale; NULL when |v| >= 10^(p-s)
+        if jnp.issubdtype(values.dtype, jnp.floating):
+            bound = float(10 ** (target.precision - target.scale))
+            v = values
+            return jnp.isfinite(v) & (v > -bound) & (v < bound)
+        # integer/decimal source: exact integer bound in SOURCE units
+        src_scale = src_t.scale if isinstance(src_t, T.DecimalType) else 0
+        bound = 10 ** (target.precision - target.scale + src_scale)
+        v = values.astype(jnp.int64)
+        return _int_range_ok(v, -(bound - 1), bound - 1)
+    return None   # float/bool/date targets: saturation matches Trino
 
 
 def _py_parser_for(target):

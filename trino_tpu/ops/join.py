@@ -142,9 +142,59 @@ def prepare_build(build_keys: Sequence[int]):
         # fetches this once per join
         max_run_live = jnp.max(jnp.where(jnp.arange(n, dtype=jnp.int32)
                                          < n_live_build, run_len, 0))
+        # live-key min/max (u64 space): the executor fetches these with
+        # max_run and, when the span is small (dense surrogate keys — every
+        # TPC-H/DS key), builds a direct-address lookup table so probes
+        # cost ONE gather instead of a sort-engine searchsorted pass
+        live_key = ~b_dead
+        kmin = jnp.min(jnp.where(live_key, bkey, u64max))
+        kmax = jnp.max(jnp.where(live_key, bkey, jnp.uint64(0)))
         return (build, bkey_s, bperm, n_live_build, n_build_rows,
-                build_has_null, run_len, max_run_live)
+                build_has_null, run_len, max_run_live, kmin, kmax)
     return prep
+
+
+_DENSE_SENTINEL = jnp.int32(0x7FFFFFFF)
+
+
+def _dense_scatter(size: int, bkey_s, n_live, kmin, payload):
+    """Shared scatter for the direct-address builders: dead positions and
+    out-of-span keys route to the dropped slot `size`."""
+    n = bkey_s.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    raw = (bkey_s - kmin).astype(jnp.int64)
+    oob = (idx >= n_live) | (raw < 0) | (raw >= size)
+    slot = jnp.where(oob, size, raw)
+    return jnp.full(size, _DENSE_SENTINEL, jnp.int32) \
+        .at[slot].min(payload, mode="drop")
+
+
+def build_dense_table(size: int):
+    """Direct-address lookup table for a sorted build: table[key - kmin] =
+    position of that key's FIRST sorted occurrence (so run_len[pos] still
+    yields the duplicate count), sentinel INT32_MAX elsewhere.
+
+    The TPU analog of the reference's array-based lookup source for dense
+    bigint keys (operator/join/... ArrayBasedLookupSource idea): one
+    scatter at build time buys gather-only probes. Every TPC-H/DS join key
+    is a dense surrogate (orderkey/partkey/.._sk), so this path carries
+    the hot joins; sparse/hashed keys fall back to searchsorted."""
+
+    def op(bkey_s, n_live, kmin):
+        n = bkey_s.shape[0]
+        return _dense_scatter(size, bkey_s, n_live, kmin,
+                              jnp.arange(n, dtype=jnp.int32))
+    return op
+
+
+def _dense_lo(table: jnp.ndarray, kmin, pkey: jnp.ndarray) -> jnp.ndarray:
+    """lower-bound analog via the dense table: position of pkey's first
+    sorted occurrence, or a huge sentinel (>= any n_live) when absent."""
+    size = table.shape[0]
+    raw = (pkey - kmin).astype(jnp.int64)
+    inb = (raw >= 0) & (raw < size)
+    lo = jnp.take(table, jnp.clip(raw, 0, size - 1), mode="clip")
+    return jnp.where(inb, lo, _DENSE_SENTINEL)
 
 
 def hash_join(
@@ -155,6 +205,9 @@ def hash_join(
     verify_composite: bool = True,
     prepared: bool = False,
     null_aware: bool = True,
+    dense: bool = False,
+    probe_out: Optional[Sequence[int]] = None,
+    build_out: Optional[Sequence[int]] = None,
 ) -> Callable[[Page, Page], Tuple[Page, jnp.ndarray]]:
     """Build op(probe_page, build) -> (output_page, true_total_rows).
 
@@ -183,12 +236,15 @@ def hash_join(
     composite = len(probe_keys) > 1
 
     def op(probe: Page, build) -> Tuple[Page, jnp.ndarray]:
+        dense_table = None
         if prepared:
+            if dense:
+                dense_table = build[10]
             (build, bkey_s, bperm, n_live_build, n_build_rows,
-             build_has_null, run_len, _max_run) = build
+             build_has_null, run_len, _max_run, kmin, _kmax) = build[:10]
         else:
             (build, bkey_s, bperm, n_live_build, n_build_rows,
-             build_has_null, run_len, _max_run) = \
+             build_has_null, run_len, _max_run, kmin, _kmax) = \
                 prepare_build(build_keys)(build)
         n_build = build.capacity
         n_probe = probe.capacity
@@ -205,15 +261,23 @@ def hash_join(
         pkey, pnull = _key_u64(probe, probe_keys)
 
         p_dead = ~probe.row_mask() | pnull
-        # ONE searchsorted over the live prefix (method="sort" routes the
-        # lookup through the TPU sort engine — ~20x faster at millions of
-        # keys than the default per-level binary-search gathers); the upper
-        # bound comes from the build side's precomputed run lengths
         n_build_m1 = jnp.maximum(n_build - 1, 0)
-        lo = jnp.searchsorted(bkey_s, pkey, side="left", method="sort")
-        lo_c = jnp.minimum(lo, n_build_m1)
-        found = (jnp.take(bkey_s, lo_c, mode="clip") == pkey) & \
-            (lo < n_live_build)
+        if dense_table is not None:
+            # dense surrogate keys: ONE gather against the direct-address
+            # table (slot identity implies key equality — no verify gather)
+            lo = _dense_lo(dense_table, kmin, pkey)
+            lo_c = jnp.minimum(lo, n_build_m1)
+            found = lo < n_live_build
+        else:
+            # ONE searchsorted over the live prefix (method="sort" routes
+            # the lookup through the TPU sort engine — ~20x faster at
+            # millions of keys than the default per-level binary-search
+            # gathers); the upper bound comes from the build side's
+            # precomputed run lengths
+            lo = jnp.searchsorted(bkey_s, pkey, side="left", method="sort")
+            lo_c = jnp.minimum(lo, n_build_m1)
+            found = (jnp.take(bkey_s, lo_c, mode="clip") == pkey) & \
+                (lo < n_live_build)
         hi = lo + jnp.where(found, jnp.take(run_len, lo_c, mode="clip"), 0)
         lo = jnp.minimum(lo, n_live_build)
         hi = jnp.minimum(hi, n_live_build)
@@ -318,9 +382,14 @@ def hash_join(
             build_is_null = build_is_null | rescue
             keep = keep | rescue
 
-        pcols = tuple(c.gather(prow_c) for c in probe.columns)
+        # PruneJoinColumns: gather only emitted channels (the probe/build
+        # gathers at output capacity are the kernel's dominant cost)
+        p_idx = range(probe.num_columns) if probe_out is None else probe_out
+        b_idx = range(build.num_columns) if build_out is None else build_out
+        pcols = tuple(probe.columns[i].gather(prow_c) for i in p_idx)
         bcols = []
-        for c in build.columns:
+        for i in b_idx:
+            c = build.columns[i]
             g = c.gather(brow)
             valid = g.valid_mask() & ~build_is_null
             bcols.append(Column(g.values, valid, c.type, c.dictionary))
@@ -378,8 +447,46 @@ def prepare_build_spilled(build_keys: Sequence[int]):
         idx = jnp.arange(build.capacity, dtype=jnp.int32)
         dup = (bkey_s[1:] == bkey_s[:-1]) & (idx[1:] < n_live)
         is_unique = ~jnp.any(dup)
-        return bkey_s, bperm, n_live, n_build_rows, build_has_null, is_unique
+        live_key = ~b_dead
+        kmin = jnp.min(jnp.where(live_key, bkey, u64max))
+        kmax = jnp.max(jnp.where(live_key, bkey, jnp.uint64(0)))
+        return (bkey_s, bperm, n_live, n_build_rows, build_has_null,
+                is_unique, kmin, kmax)
     return prep
+
+
+def build_dense_table_rows(size: int):
+    """Spilled-dense build finisher: table[key - kmin] = ORIGINAL build row
+    of that (unique) key, sentinel elsewhere. The probe then needs ONLY
+    this table on device — no sorted keys, no permutation (4B/slot instead
+    of 12B/row of HBM for a >threshold build)."""
+
+    def op(bkey_s, bperm, n_live, kmin):
+        return _dense_scatter(size, bkey_s, n_live, kmin, bperm)
+    return op
+
+
+def spilled_dense_probe(probe_keys: Sequence[int],
+                        probe_out: Optional[Sequence[int]] = None):
+    """Probe a spilled build through its dense row table: one gather per
+    probe row. Returns (pre_page, found_mask, match_count) — compaction is
+    deferred to the executor, which skips it entirely when every live
+    probe row matched (the common fact-to-dimension case)."""
+    probe_keys = tuple(probe_keys)
+
+    def op(probe: Page, table, kmin):
+        pkey, pnull = _key_u64(probe, probe_keys)
+        p_dead = ~probe.row_mask() | pnull
+        brow = _dense_lo(table, kmin, pkey)
+        found = (brow != _DENSE_SENTINEL) & ~p_dead
+        brow_col = Column(jnp.where(found, brow, 0).astype(jnp.int64),
+                          None, T.BIGINT, None)
+        p_idx = range(probe.num_columns) if probe_out is None else probe_out
+        pre = Page(tuple(probe.columns[i] for i in p_idx) + (brow_col,),
+                   probe.num_rows)
+        return pre, found, jnp.sum(found).astype(jnp.int64)
+
+    return op
 
 
 _ANCHOR_LOG2 = 10
@@ -410,11 +517,13 @@ def _searchsorted_anchored(bkey_s: jnp.ndarray, pkey: jnp.ndarray
     return pos
 
 
-def spilled_unique_probe(probe_keys: Sequence[int]):
+def spilled_unique_probe(probe_keys: Sequence[int],
+                         probe_out: Optional[Sequence[int]] = None):
     """Probe phase against a spilled build: identical to unique_inner_probe
     but consuming only (bkey_s, bperm, n_live) — no build Page on device.
     Composite-key verification happens host-side in attach_build_host
-    (the build columns live there)."""
+    (the build columns live there). Returns (pre, found, count); the
+    executor compacts (or skips compaction when all rows matched)."""
     probe_keys = tuple(probe_keys)
 
     def op(probe: Page, bkey_s, bperm, n_live):
@@ -427,21 +536,24 @@ def spilled_unique_probe(probe_keys: Sequence[int]):
             (lo < n_live) & ~p_dead
         brow = jnp.take(bperm, lo_c, mode="clip").astype(jnp.int64)
         brow_col = Column(brow, None, T.BIGINT, None)
-        pre = Page(tuple(probe.columns) + (brow_col,), probe.num_rows)
-        out = pre.filter(found)
-        return out, out.num_rows.astype(jnp.int64)
+        p_idx = range(probe.num_columns) if probe_out is None else probe_out
+        pre = Page(tuple(probe.columns[i] for i in p_idx) + (brow_col,),
+                   probe.num_rows)
+        return pre, found, jnp.sum(found).astype(jnp.int64)
 
     return op
 
 
 def attach_build_host(pre: Page, n_probe_cols: int, host_cols,
-                      verify: Optional[Sequence[Tuple[int, int]]] = None
-                      ) -> Page:
+                      verify: Optional[Sequence[Tuple[int, int]]] = None,
+                      emit: Optional[Sequence[int]] = None) -> Page:
     """Host-side attach for the spilled path: gather build columns from
     host numpy arrays at the matched rows' original indices and stage only
     the match-count-sized result. `host_cols` is [(values_np, valid_np or
     None, type, dictionary)]. `verify` = [(probe_ch, build_col_idx)] pairs
-    re-checked for composite keys (hash collisions)."""
+    re-checked for composite keys (hash collisions). `emit` selects which
+    host_cols are emitted (default all) — verify-only key columns need not
+    be staged back to device."""
     import numpy as np
     n = int(pre.num_rows)
     brow = np.asarray(
@@ -462,7 +574,8 @@ def attach_build_host(pre: Page, n_probe_cols: int, host_cols,
         sel = None
     cap = pre.capacity
     bcols = []
-    for values, valid, typ, d in host_cols:
+    emit_cols = host_cols if emit is None else [host_cols[i] for i in emit]
+    for values, valid, typ, d in emit_cols:
         g = values[brow]
         v = valid[brow] if valid is not None else None
         bcols.append(Column.from_numpy(
@@ -493,29 +606,31 @@ def unique_inner_probe(
     probe_keys: Sequence[int],
     build_keys: Sequence[int],
     verify_composite: bool = True,
-) -> Callable[[Page, tuple], Tuple[Page, jnp.ndarray]]:
+    dense: bool = False,
+    probe_out: Optional[Sequence[int]] = None,
+) -> Callable[[Page, tuple], Tuple[Page, jnp.ndarray, jnp.ndarray]]:
     """INNER-join probe against a UNIQUE build side (max key run == 1) —
     the dimension/primary-key case covering every TPC-H/DS fact-to-dim
     join. No cumsum expansion, no output-slot searchsorted, no
     capacity-sized gathers (round-4 profiling: those cost ~0.7s per
-    MILLION probe rows in the general kernel):
+    MILLION probe rows in the general kernel). With dense=True the
+    searchsorted collapses to one gather against the direct-address table
+    (prepared[10]).
 
-      searchsorted (sort engine)  ->  found mask
-      ONE stable-sort filter compacting probe cols + matched build-row
-      index carried as a payload column
-
-    Returns (pre_page, match_count): pre_page is probe columns ++ a BIGINT
-    `brow` channel; the executor shrinks it to live size (one count fetch
-    it batches anyway) and then runs attach_build to gather build columns
-    at LIVE size instead of probe capacity. Output can never overflow
-    (<= probe rows), so no capacity re-run loop is needed."""
+    Returns (pre_page, found_mask, match_count): pre_page is probe columns
+    ++ a BIGINT `brow` channel at PROBE order. The executor compacts with
+    one filter kernel — or skips compaction when every live row matched
+    (count == num_rows; the common fact-to-dim case) — then runs
+    attach_build at live size. Output can never overflow (<= probe rows),
+    so no capacity re-run loop is needed."""
     probe_keys = tuple(probe_keys)
     build_keys = tuple(build_keys)
     composite = len(probe_keys) > 1
 
-    def op(probe: Page, prepared) -> Tuple[Page, jnp.ndarray]:
+    def op(probe: Page, prepared):
+        dense_table = prepared[10] if dense else None
         (build, bkey_s, bperm, n_live_build, n_build_rows,
-         build_has_null, run_len, _max_run) = prepared
+         build_has_null, run_len, _max_run, kmin, _kmax) = prepared[:10]
         n_build = build.capacity
         for pk, bk in zip(probe_keys, build_keys):
             pd = probe.column(pk).dictionary
@@ -527,20 +642,26 @@ def unique_inner_probe(
         pkey, pnull = _key_u64(probe, probe_keys)
         p_dead = ~probe.row_mask() | pnull
         n_build_m1 = jnp.maximum(n_build - 1, 0)
-        lo = jnp.searchsorted(bkey_s, pkey, side="left", method="sort")
-        lo_c = jnp.minimum(lo, n_build_m1)
-        found = (jnp.take(bkey_s, lo_c, mode="clip") == pkey) & \
-            (lo < n_live_build) & ~p_dead
+        if dense_table is not None:
+            lo = _dense_lo(dense_table, kmin, pkey)
+            lo_c = jnp.minimum(lo, n_build_m1)
+            found = (lo < n_live_build) & ~p_dead
+        else:
+            lo = jnp.searchsorted(bkey_s, pkey, side="left", method="sort")
+            lo_c = jnp.minimum(lo, n_build_m1)
+            found = (jnp.take(bkey_s, lo_c, mode="clip") == pkey) & \
+                (lo < n_live_build) & ~p_dead
         brow = jnp.take(bperm, lo_c, mode="clip").astype(jnp.int64)
         if composite and verify_composite:
             # unique build: at most one candidate — verify it directly
             for pk, bk in zip(probe_keys, build_keys):
                 bv = jnp.take(build.column(bk).values, brow, mode="clip")
                 found = found & (probe.column(pk).values == bv)
-        brow_col = Column(brow, None, T.BIGINT, None)
-        pre = Page(tuple(probe.columns) + (brow_col,), probe.num_rows)
-        out = pre.filter(found)
-        return out, out.num_rows.astype(jnp.int64)
+        brow_col = Column(jnp.where(found, brow, 0), None, T.BIGINT, None)
+        p_idx = range(probe.num_columns) if probe_out is None else probe_out
+        pre = Page(tuple(probe.columns[i] for i in p_idx) + (brow_col,),
+                   probe.num_rows)
+        return pre, found, jnp.sum(found).astype(jnp.int64)
 
     return op
 
@@ -589,17 +710,20 @@ def range_prefilter(probe_key: int):
     return op
 
 
-def attach_build(n_probe_cols: int) -> Callable[[Page, tuple], Page]:
-    """Second phase of the unique-build fast path: gather build columns at
-    the compacted (live-size) brow indices and restore the probe++build
-    output layout."""
+def attach_build(n_probe_cols: int,
+                 build_out: Optional[Sequence[int]] = None
+                 ) -> Callable[[Page, tuple], Page]:
+    """Second phase of the unique-build fast path: gather build columns
+    (only the emitted channels) at the compacted (live-size) brow indices
+    and restore the probe++build output layout."""
 
     def op(pre: Page, prepared) -> Page:
         build = prepared[0]
         brow = pre.columns[n_probe_cols].values.astype(jnp.int32)
         live = pre.row_mask()
         brow = jnp.where(live, brow, 0)
-        bcols = tuple(c.gather(brow) for c in build.columns)
+        b_idx = range(build.num_columns) if build_out is None else build_out
+        bcols = tuple(build.columns[i].gather(brow) for i in b_idx)
         return Page(tuple(pre.columns[:n_probe_cols]) + bcols, pre.num_rows)
 
     return op

@@ -267,6 +267,11 @@ class JoinNode(PlanNode):
     criteria: Tuple[JoinClause, ...]
     filter: Optional[RowExpression] = None   # non-equi residual
     distribution: str = JoinDistribution.AUTO
+    # PruneJoinColumns analog (iterative/rule/PruneJoinColumns.java): when
+    # set, only these symbols (a subset of left+right outputs, in that
+    # order) are emitted — the executor then skips the build-column gathers
+    # for dropped channels, the hot cost of wide fact-to-dim joins
+    output_symbols: Optional[Tuple[Symbol, ...]] = None
 
     @property
     def sources(self):
@@ -274,11 +279,13 @@ class JoinNode(PlanNode):
 
     @property
     def outputs(self):
+        if self.output_symbols is not None:
+            return self.output_symbols
         return self.left.outputs + self.right.outputs
 
     def with_sources(self, sources):
         return JoinNode(self.kind, sources[0], sources[1], self.criteria,
-                        self.filter, self.distribution)
+                        self.filter, self.distribution, self.output_symbols)
 
 
 @_node

@@ -570,8 +570,23 @@ def prune_unreferenced(root: OutputNode) -> OutputNode:
                 req |= symbols_in(node.filter)
             left = needed_of(node.left, req)
             right = needed_of(node.right, req)
+            out_syms = None
+            if node.kind in (JoinKind.INNER, JoinKind.LEFT):
+                # PruneJoinColumns: emit only downstream-needed symbols
+                # (plus residual-filter inputs, evaluated on the joined
+                # layout) — join keys themselves can drop, saving the
+                # probe-capacity build-column gathers
+                keep = set(required)
+                if node.filter is not None:
+                    keep |= symbols_in(node.filter)
+                full = left.outputs + right.outputs
+                kept = tuple(s for s in full if s.name in keep)
+                if not kept:
+                    kept = left.outputs[:1]   # count(*) carrier
+                if len(kept) != len(full):
+                    out_syms = kept
             return JoinNode(node.kind, left, right, node.criteria,
-                            node.filter, node.distribution)
+                            node.filter, node.distribution, out_syms)
         if isinstance(node, SemiJoinNode):
             req = set(required)
             req |= {s.name for s in node.source_keys}
