@@ -146,6 +146,15 @@ class ConnectorMetadata:
     def get_table_statistics(self, handle: ConnectorTableHandle) -> TableStatistics:
         return TableStatistics.unknown()
 
+    def estimate_like_selectivity(self, handle: ConnectorTableHandle,
+                                  column: str, pattern: str,
+                                  escape=None):
+        """Fraction of rows matching `column LIKE pattern`, or None when
+        unknown (FilterStatsCalculator hook: dictionary-encoded connectors
+        can answer exactly from their pools — a LIKE misestimate was the
+        round-4 q9 join-order regression)."""
+        return None
+
     # -- writes (spi/connector/ConnectorMetadata beginCreateTable/beginInsert)
 
     def create_table(self, metadata: TableMetadata, ignore_existing: bool = False):

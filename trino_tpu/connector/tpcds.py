@@ -865,6 +865,44 @@ def get_table(table: str, sf: float) -> Dict[str, np.ndarray]:
     return _TABLE_CACHE[key]
 
 
+# FK suffix -> referenced dimension (a fact's *_sk columns draw from the
+# dimension's key domain — claiming NDV = fact row count breaks join-order
+# costing exactly like tpch's l_partkey did in round 4)
+_SK_DOMAIN = {
+    "item_sk": "item", "date_sk": "date_dim", "time_sk": "time_dim",
+    "customer_sk": "customer", "cdemo_sk": "customer_demographics",
+    "hdemo_sk": "household_demographics", "addr_sk": "customer_address",
+    "store_sk": "store", "warehouse_sk": "warehouse",
+    "promo_sk": "promotion", "income_band_sk": "income_band",
+    "band_sk": "income_band", "call_center_sk": "call_center",
+    "web_page_sk": "web_page", "catalog_page_sk": "catalog_page",
+    "page_sk": "web_page",
+    "web_site_sk": "web_site", "ship_mode_sk": "ship_mode",
+    "reason_sk": "reason",
+}
+
+
+def _column_ndv(table: str, name: str, sf: float, rows: float) -> float:
+    if name.endswith("_sk"):
+        # own primary key -> row count; FK -> referenced dimension size
+        for suffix, dim in _SK_DOMAIN.items():
+            if name.endswith(suffix):
+                if dim == table:
+                    return rows
+                try:
+                    return float(table_row_count(dim, sf))
+                except KeyError:
+                    return rows
+        return rows
+    if name in ("d_year",):
+        return 201.0
+    if name in ("d_moy", "d_dom"):
+        return 31.0
+    if name == "d_week_seq":
+        return float(_DATE_ROWS) / 7
+    return float(min(rows, 1000.0))
+
+
 def table_row_count(table: str, sf: float) -> int:
     counts = _row_counts(sf)
     if table == "inventory":
@@ -918,9 +956,10 @@ class TpcdsMetadata(ConnectorMetadata):
         rows = float(table_row_count(handle.name.table, sf))
         cols: Dict[str, ColumnStatistics] = {}
         for name, typ in TABLES[handle.name.table][0]:
-            ndv = rows if name.endswith("_sk") else min(rows, 1000.0)
-            cols[name] = ColumnStatistics(null_fraction=0.0,
-                                          distinct_count=ndv)
+            cols[name] = ColumnStatistics(
+                null_fraction=0.0,
+                distinct_count=_column_ndv(handle.name.table, name, sf,
+                                           rows))
         return TableStatistics(rows, cols)
 
     def apply_filter(self, handle, constraint):

@@ -27,7 +27,7 @@ from typing import Dict, Iterator, List, Optional, Sequence
 import numpy as np
 
 from trino_tpu import types as T
-from trino_tpu.connector import tpch_gen as G
+from trino_tpu.connector import tpch_dev, tpch_gen as G
 from trino_tpu.connector.spi import (
     ColumnHandle, ColumnMetadata, Connector, ConnectorMetadata,
     ConnectorPageSource, ConnectorSplitManager, ConnectorTableHandle,
@@ -87,6 +87,36 @@ TABLES: Dict[str, tuple] = {
 
 def table_row_count(table: str, sf: float) -> int:
     return G.row_count(table, sf)
+
+
+def _column_ndv(table: str, name: str, sf: float, rows: float) -> float:
+    """Real distinct counts (cost/StatsCalculator parity): FK columns get
+    their DOMAIN size, not the table's row count — the round-4 q9
+    join-order regression traced to l_partkey claiming 600M NDV."""
+    fk_domain = {
+        "l_partkey": "part", "ps_partkey": "part",
+        "l_suppkey": "supplier", "ps_suppkey": "supplier",
+        "l_orderkey": "orders",
+    }
+    if name in fk_domain:
+        return float(G.row_count(fk_domain[name], sf))
+    if name == "o_custkey":
+        # spec: a third of customers place no orders
+        return float(G.row_count("customer", sf)) * 2 / 3
+    if name in ("c_nationkey", "s_nationkey", "n_nationkey"):
+        return 25.0
+    if name in ("n_regionkey", "r_regionkey"):
+        return 5.0
+    if G.string_kind(table, name) == "pooled":
+        return float(min(rows, len(G.pool_values(table, name, sf))))
+    if name.endswith("date"):
+        return float(min(rows, 2500.0))   # ~7 years of days
+    if name.endswith("key"):
+        return rows                        # primary keys
+    if name in ("l_quantity", "l_linenumber", "p_size", "l_discount",
+                "l_tax", "o_shippriority"):
+        return float(min(rows, 50.0))
+    return float(min(rows, max(rows / 4, 1000.0)))
 
 
 def _host_chunk(table: str, sf: float, column: str, start: int,
@@ -150,10 +180,33 @@ class TpchMetadata(ConnectorMetadata):
         rows = float(table_row_count(handle.name.table, sf))
         cols: Dict[str, ColumnStatistics] = {}
         for name, typ in TABLES[handle.name.table][0]:
-            ndv = rows if name.endswith("key") else min(rows, 1000.0)
-            cols[name] = ColumnStatistics(null_fraction=0.0,
-                                          distinct_count=ndv)
+            cols[name] = ColumnStatistics(
+                null_fraction=0.0,
+                distinct_count=_column_ndv(handle.name.table, name, sf,
+                                           rows))
         return TableStatistics(rows, cols)
+
+    # date-derived status columns are heavily skewed (e.g. ~2/3 of orders
+    # are fulfilled 'F'), so pool-uniform estimation would mislead
+    _SKEWED_POOLED = {"o_orderstatus", "l_returnflag", "l_linestatus"}
+
+    def estimate_like_selectivity(self, handle, column, pattern,
+                                  escape=None):
+        """Exact match fraction over the column's dictionary pool — valid
+        because every non-skewed pooled column draws codes UNIFORMLY from
+        its pool (tpch_gen `_ui` streams)."""
+        table = handle.name.table
+        if G.string_kind(table, column) != "pooled" \
+                or column in self._SKEWED_POOLED:
+            return None
+        import re as _re
+        from trino_tpu.expr.functions import like_pattern_to_regex
+        values = G.pool_values(table, column, SCHEMAS[handle.name.schema])
+        if len(values) == 0:
+            return None
+        rx = _re.compile(like_pattern_to_regex(pattern, escape), _re.DOTALL)
+        hits = sum(1 for v in values if rx.match(v))
+        return hits / len(values)
 
     def apply_filter(self, handle, constraint):
         # accept the whole domain for split pruning; engine re-applies row-wise
@@ -178,6 +231,10 @@ class TpchSplitManager(ConnectorSplitManager):
 
 import collections
 import os
+
+# device-side generation (tpch_dev): default ON; set =0 to force the host
+# numpy path (debugging / byte-equivalence comparisons)
+_DEVICE_GEN = os.environ.get("TRINO_TPU_DEVICE_GEN", "1") != "0"
 
 # host-side generated-chunk LRU: at SF100 the working set (~29GB for q9's
 # seven lineitem/orders columns) exceeds the DEVICE cache budget, and
@@ -245,7 +302,17 @@ def _staged_column(table: str, sf: float, name: str, typ: T.Type,
         _DEVICE_COL_CACHE.move_to_end(key)
         return col
     hkey = (table, round(sf * 1000), name, off, hi)
-    if T.is_string(typ):
+    if _DEVICE_GEN and tpch_dev.supported(table, name):
+        # generate ON the device: same hash-stream expressions jit'd via
+        # jnp (tpch_dev docstring) — no host hashing, no column transfer
+        import jax.numpy as jnp
+        values = tpch_dev.generate(table, sf, name, off, hi, page_capacity)
+        if T.is_string(typ):
+            col = Column(values, None, typ,
+                         table_dictionary(table, sf, name))
+        else:
+            col = Column(values.astype(T.to_numpy_dtype(typ)), None, typ)
+    elif T.is_string(typ):
         d = table_dictionary(table, sf, name)
         if G.string_kind(table, name) == "pooled":
             codes = _host_cached(

@@ -228,18 +228,55 @@ def row_count(table: str, sf: float) -> int:
 
 
 # ------------------------------------------------------- column streams
+#
+# The stream bodies below are ARRAY-MODULE AGNOSTIC: they receive an `idx`
+# array that is either numpy (host generation: oracle loading, fallback
+# path) or jax.numpy (device generation: the scan path evaluates the same
+# hash streams ON the TPU — no 1-core host hashing, no column transfer).
+# One shared code path is what makes the two bit-identical by construction.
+# numpy-only constructs (arange/repeat/cumsum/errstate) stay in the
+# chunk-level wrappers; inside streams only operators, astype, and the
+# _where/_maximum/_take dispatch helpers are allowed.
 
-def _retail_price(pk: np.ndarray) -> np.ndarray:
+
+def _is_np(x) -> bool:
+    return isinstance(x, np.ndarray)
+
+
+def _where(c, a, b):
+    if _is_np(c):
+        return np.where(c, a, b)
+    import jax.numpy as jnp
+    return jnp.where(c, a, b)
+
+
+def _maximum(a, b):
+    if _is_np(a):
+        return np.maximum(a, b)
+    import jax.numpy as jnp
+    return jnp.maximum(a, b)
+
+
+def _take(table_np: np.ndarray, idx):
+    """Gather a small host constant table by (device or host) index."""
+    if _is_np(idx):
+        return table_np[idx]
+    import jax.numpy as jnp
+    return jnp.take(jnp.asarray(table_np), idx.astype(jnp.int64),
+                    mode="clip")
+
+
+def _retail_price(pk):
     # spec 4.2.3: 90000 + ((pk/10) mod 20001) + 100*(pk mod 1000)
     return 90000 + (pk // 10) % 20001 + 100 * (pk % 1000)
 
 
-def _ps_suppkey(pk: np.ndarray, i: np.ndarray, nsupp: int) -> np.ndarray:
+def _ps_suppkey(pk, i, nsupp: int):
     # spec: supplier spread formula
     return (pk + i * (nsupp // 4 + (pk - 1) // nsupp)) % nsupp + 1
 
 
-def _order_cols(sf: float, oidx: np.ndarray, which: str) -> np.ndarray:
+def _order_cols(sf: float, oidx, which: str):
     """Order-level streams evaluated at arbitrary order indexes (0-based) —
     lineitem chunks call these with their covered order ids, which is what
     makes l_orderkey/l_shipdate consistent with the orders table without
@@ -251,8 +288,8 @@ def _order_cols(sf: float, oidx: np.ndarray, which: str) -> np.ndarray:
         ncust = _n("customer", sf)
         ck = _ui("orders", "o_custkey", sf, oidx, 1, max(ncust, 2))
         # spec: a third of customers place no orders
-        return np.where(ck % 3 == 0, np.maximum((ck + 1) % (ncust + 1), 1),
-                        ck)
+        return _where(ck % 3 == 0, _maximum((ck + 1) % (ncust + 1), 1),
+                      ck)
     raise KeyError(which)
 
 
@@ -271,35 +308,38 @@ def _lineitem_rowmap(sf: float, start: int, end: int
     return oidx, within + 1
 
 
-def numeric_chunk(table: str, sf: float, column: str,
-                  start: int, end: int) -> np.ndarray:
-    """Generate one numeric column for a row range. Dates are int32 days;
+def column_stream(table: str, sf: float, column: str, idx,
+                  oidx=None):
+    """One numeric column evaluated at arbitrary row indexes `idx` (uint64,
+    numpy OR jax array — shared path, see module note). `oidx` is the
+    0-based covering order index per row, required for lineitem's
+    order-correlated columns (l_orderkey/dates). Dates are int32 days;
     decimals are scaled int64 (decimal(12,2) -> cents)."""
-    idx = np.arange(start, end, dtype=np.uint64)
+    i64 = idx.astype(np.int64)
     if table == "region" and column == "r_regionkey":
-        return np.arange(start, end, dtype=np.int64)
+        return i64
     if table == "nation":
         if column == "n_nationkey":
-            return np.arange(start, end, dtype=np.int64)
+            return i64
         if column == "n_regionkey":
-            return np.array([x[1] for x in _NATIONS],
-                            dtype=np.int64)[start:end]
+            return _take(np.array([x[1] for x in _NATIONS],
+                                  dtype=np.int64), i64)
     if table == "supplier":
         if column == "s_suppkey":
-            return np.arange(start + 1, end + 1, dtype=np.int64)
+            return i64 + 1
         if column == "s_nationkey":
             return _ui(table, column, sf, idx, 0, 24)
         if column == "s_acctbal":
             return _ui(table, column, sf, idx, -99999, 999999)
     if table == "customer":
         if column == "c_custkey":
-            return np.arange(start + 1, end + 1, dtype=np.int64)
+            return i64 + 1
         if column == "c_nationkey":
             return _ui(table, column, sf, idx, 0, 24)
         if column == "c_acctbal":
             return _ui(table, column, sf, idx, -99999, 999999)
     if table == "part":
-        pk = np.arange(start + 1, end + 1, dtype=np.int64)
+        pk = i64 + 1
         if column == "p_partkey":
             return pk
         if column == "p_size":
@@ -307,8 +347,8 @@ def numeric_chunk(table: str, sf: float, column: str,
         if column == "p_retailprice":
             return _retail_price(pk)
     if table == "partsupp":
-        pk = idx.astype(np.int64) // 4 + 1
-        i4 = idx.astype(np.int64) % 4
+        pk = i64 // 4 + 1
+        i4 = i64 % 4
         if column == "ps_partkey":
             return pk
         if column == "ps_suppkey":
@@ -319,19 +359,16 @@ def numeric_chunk(table: str, sf: float, column: str,
             return _ui(table, column, sf, idx, 100, 100000)
     if table == "orders":
         if column == "o_orderkey":
-            return np.arange(start + 1, end + 1, dtype=np.int64)
+            return i64 + 1
         if column in ("o_custkey", "o_orderdate"):
             return _order_cols(sf, idx, column)
         if column == "o_totalprice":
             return _ui(table, column, sf, idx, 85000, 55558641)
         if column == "o_shippriority":
-            return np.zeros(end - start, dtype=np.int32)
+            return (i64 * 0).astype(np.int32)
     if table == "lineitem":
-        oidx, lineno = _lineitem_rowmap(sf, start, end)
         if column == "l_orderkey":
-            return oidx + 1
-        if column == "l_linenumber":
-            return lineno.astype(np.int32)
+            return oidx.astype(np.int64) + 1
         if column == "l_partkey":
             return _ui(table, column, sf, idx, 1,
                        max(1, int(200_000 * sf)))
@@ -360,10 +397,23 @@ def numeric_chunk(table: str, sf: float, column: str,
             return (odate + _ui(table, "l_cdays", sf, idx, 30, 90)
                     ).astype(np.int32)
         if column == "l_receiptdate":
-            sdate = numeric_chunk(table, sf, "l_shipdate", start, end)
+            sdate = column_stream(table, sf, "l_shipdate", idx, oidx)
             return (sdate + _ui(table, "l_rdays", sf, idx, 1, 30)
                     ).astype(np.int32)
     raise KeyError(f"{table}.{column} is not a numeric stream")
+
+
+def numeric_chunk(table: str, sf: float, column: str,
+                  start: int, end: int) -> np.ndarray:
+    """Host (numpy) evaluation of column_stream for a row range."""
+    idx = np.arange(start, end, dtype=np.uint64)
+    oidx = None
+    if table == "lineitem":
+        oidx, lineno = _lineitem_rowmap(sf, start, end)
+        if column == "l_linenumber":
+            return lineno.astype(np.int32)
+    with np.errstate(over="ignore"):
+        return column_stream(table, sf, column, idx, oidx)
 
 
 # string columns -> ("pooled", pool_fn) | ("formatted", None)
@@ -450,61 +500,67 @@ def pool_values(table: str, column: str, sf: float) -> np.ndarray:
     return _pool_for(table, column, sf).sorted_values
 
 
+def code_stream(table: str, sf: float, column: str, idx, oidx=None):
+    """RAW pool index for a pooled column at row indexes `idx` (shared
+    numpy/jax path; the caller maps raw -> sorted code via the pool LUT)."""
+    if column in _COMMENT_LEN:
+        return (_u64(table, column, sf, idx)
+                % np.uint64(_COMMENT_POOL_SIZE)).astype(np.int64)
+    if column in ("r_name", "n_name"):
+        return idx.astype(np.int64)
+    if column == "c_mktsegment":
+        return _ui(table, column, sf, idx, 0, 4)
+    if column == "p_name":
+        c1 = _ui(table, "p_name1", sf, idx, 0, len(_COLORS) - 1)
+        c2 = _ui(table, "p_name2", sf, idx, 0, len(_COLORS) - 1)
+        return c1 * len(_COLORS) + c2
+    if column == "p_mfgr":
+        return _ui(table, "p_mfgr", sf, idx, 0, 4)
+    if column == "p_brand":
+        m = _ui(table, "p_mfgr", sf, idx, 0, 4)      # consistent with mfgr
+        return m * 5 + _ui(table, "p_brandn", sf, idx, 0, 4)
+    if column == "p_type":
+        return _ui(table, column, sf, idx, 0,
+                   len(_TYPE_S1) * len(_TYPE_S2) * len(_TYPE_S3) - 1)
+    if column == "p_container":
+        return _ui(table, column, sf, idx, 0, len(_CONTAINERS) - 1)
+    if column == "o_orderstatus":
+        odate = _order_cols(sf, idx, "o_orderdate").astype(np.int64)
+        fulfilled = odate + 151 < CURRENT_DATE
+        half = _coin(table, column, sf, idx)
+        return _where(fulfilled, 0, _where(half, 1, 2))
+    if column == "o_orderpriority":
+        return _ui(table, column, sf, idx, 0, 4)
+    if column == "o_clerk":
+        return _ui(table, column, sf, idx, 0, max(2, int(1000 * sf)) - 1)
+    if column in ("l_returnflag", "l_linestatus"):
+        if column == "l_linestatus":
+            sdate = column_stream(table, sf, "l_shipdate", idx, oidx) \
+                .astype(np.int64)
+            return _where(sdate > CURRENT_DATE, 1, 0)   # O / F
+        rdate = column_stream(table, sf, "l_receiptdate", idx, oidx) \
+            .astype(np.int64)
+        returned = rdate <= CURRENT_DATE
+        half = _coin(table, column, sf, idx)
+        # pool sorted A,N,R: returned -> R or A, else N
+        return _where(returned, _where(half, 2, 0), 1)
+    if column == "l_shipinstruct":
+        return _ui(table, column, sf, idx, 0, len(_INSTRUCTS) - 1)
+    if column == "l_shipmode":
+        return _ui(table, column, sf, idx, 0, len(_SHIPMODES) - 1)
+    raise KeyError(f"{table}.{column} is not pooled")
+
+
 def codes_chunk(table: str, sf: float, column: str,
                 start: int, end: int) -> np.ndarray:
     """int32 codes (into pool_values' SORTED order) for a pooled column."""
     p = _pool_for(table, column, sf)
     idx = np.arange(start, end, dtype=np.uint64)
-    if column in _COMMENT_LEN:
-        raw = (_u64(table, column, sf, idx)
-               % np.uint64(_COMMENT_POOL_SIZE)).astype(np.int64)
-    elif column == "r_name":
-        raw = np.arange(start, end, dtype=np.int64)
-    elif column == "n_name":
-        raw = np.arange(start, end, dtype=np.int64)
-    elif column == "c_mktsegment":
-        raw = _ui(table, column, sf, idx, 0, 4)
-    elif column == "p_name":
-        c1 = _ui(table, "p_name1", sf, idx, 0, len(_COLORS) - 1)
-        c2 = _ui(table, "p_name2", sf, idx, 0, len(_COLORS) - 1)
-        raw = c1 * len(_COLORS) + c2
-    elif column == "p_mfgr":
-        raw = _ui(table, "p_mfgr", sf, idx, 0, 4)
-    elif column == "p_brand":
-        m = _ui(table, "p_mfgr", sf, idx, 0, 4)      # consistent with mfgr
-        raw = m * 5 + _ui(table, "p_brandn", sf, idx, 0, 4)
-    elif column == "p_type":
-        raw = _ui(table, column, sf, idx, 0,
-                  len(_TYPE_S1) * len(_TYPE_S2) * len(_TYPE_S3) - 1)
-    elif column == "p_container":
-        raw = _ui(table, column, sf, idx, 0, len(_CONTAINERS) - 1)
-    elif column == "o_orderstatus":
-        odate = _order_cols(sf, idx, "o_orderdate").astype(np.int64)
-        fulfilled = odate + 151 < CURRENT_DATE
-        half = _coin(table, column, sf, idx)
-        raw = np.where(fulfilled, 0, np.where(half, 1, 2))
-    elif column == "o_orderpriority":
-        raw = _ui(table, column, sf, idx, 0, 4)
-    elif column == "o_clerk":
-        raw = _ui(table, column, sf, idx, 0, max(2, int(1000 * sf)) - 1)
-    elif column in ("l_returnflag", "l_linestatus"):
-        rdate = numeric_chunk(table, sf, "l_receiptdate", start, end) \
-            .astype(np.int64)
-        if column == "l_linestatus":
-            sdate = numeric_chunk(table, sf, "l_shipdate", start, end) \
-                .astype(np.int64)
-            raw = np.where(sdate > CURRENT_DATE, 1, 0)   # O / F
-        else:
-            returned = rdate <= CURRENT_DATE
-            half = _coin(table, column, sf, idx)
-            # pool sorted A,N,R: returned -> R or A, else N
-            raw = np.where(returned, np.where(half, 2, 0), 1)
-    elif column == "l_shipinstruct":
-        raw = _ui(table, column, sf, idx, 0, len(_INSTRUCTS) - 1)
-    elif column == "l_shipmode":
-        raw = _ui(table, column, sf, idx, 0, len(_SHIPMODES) - 1)
-    else:
-        raise KeyError(f"{table}.{column} is not pooled")
+    oidx = None
+    if table == "lineitem" and column in ("l_returnflag", "l_linestatus"):
+        oidx, _ = _lineitem_rowmap(sf, start, end)
+    with np.errstate(over="ignore"):
+        raw = code_stream(table, sf, column, idx, oidx)
     return p.lut[raw]
 
 

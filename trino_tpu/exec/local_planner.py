@@ -527,25 +527,95 @@ class LocalExecutionPlanner:
             lambda: hash_aggregate(final_keys, specs, Step.FINAL,
                                    state_channels))
 
+        intermediate_op = cached_kernel(
+            ("agg-intermediate", nkeys, specs_t),
+            lambda: hash_aggregate(final_keys, specs, Step.INTERMEDIATE,
+                                   state_channels))
+
         def gen():
             # no per-page num_rows sync: empty pages produce neutral partial
             # states that merge correctly (the sync was a tunnel round-trip
-            # per page on remote TPU)
-            partials = [partial_op(page) for page in src.pages]
-            if not partials:
-                # empty input: global agg still emits one row
-                if not key_channels:
-                    yield self._empty_global_agg(node, specs)
+            # per page on remote TPU). Over-budget partial buffers compact
+            # via Step.INTERMEDIATE; if groups aren't collapsing (q18-class
+            # high-cardinality GROUP BY) the compacted states spill to host
+            # hash partitions and finalize one bounded partition at a time
+            # (SpillableHashAggregationBuilder.java:47 re-thought — see
+            # exec/spill.py).
+            from trino_tpu.exec.memory import page_bytes
+            from trino_tpu.exec.spill import (HostPartitionStore,
+                                              partition_by_hash)
+            threshold = int(self.session.get("agg_spill_threshold_bytes"))
+            npart = int(self.session.get("spill_partition_count"))
+            spillable = bool(self.session.get("spill_enabled")) \
+                and bool(key_channels)
+            store = None
+            part_op = None
+            buf: List[Page] = []
+            buf_bytes = 0
+            any_pages = False
+
+            def compact_buffer():
+                nonlocal buf, buf_bytes
+                merged = self.merge_counted(buf)
+                buf, buf_bytes = [], 0
+                if merged is None:
+                    return None
+                out = intermediate_op(merged)
+                n = int(jax.device_get(out.num_rows))
+                if n == 0:
+                    return None
+                return self._tight(out, n)
+
+            def spill(combined):
+                nonlocal store, part_op
+                if store is None:
+                    store = HostPartitionStore(npart)
+                    part_op = cached_kernel(
+                        ("agg-spill-part", nkeys, npart),
+                        lambda: partition_by_hash(final_keys, npart))
+                sorted_pg, counts = part_op(combined)
+                store.spill_partitioned(sorted_pg, jax.device_get(counts))
+
+            for page in src.pages:
+                any_pages = True
+                pp = partial_op(page)
+                buf.append(pp)
+                buf_bytes += page_bytes(pp)
+                if spillable and buf_bytes >= threshold:
+                    combined = compact_buffer()
+                    if combined is None:
+                        continue
+                    cb = page_bytes(combined)
+                    if cb >= threshold // 2:
+                        spill(combined)        # groups aren't collapsing
+                    else:
+                        buf, buf_bytes = [combined], cb
+
+            if store is None:
+                if not any_pages:
+                    if not key_channels:
+                        yield self._empty_global_agg(node, specs)
+                    return
+                merged = self.merge_counted(buf)
+                if merged is None:
+                    # every input page was empty (grouped agg -> no output;
+                    # global agg partials always carry one state row, so
+                    # merge_counted returning None implies zero rows total)
+                    if not key_channels:
+                        yield self._empty_global_agg(node, specs)
+                    return
+                yield final_op(merged)
                 return
-            merged = concat_pages(partials) if len(partials) > 1 \
-                else partials[0]
-            if int(merged.num_rows) == 0:
-                # every input page was empty (grouped agg -> no output;
-                # global agg partials always carry one state row)
-                if not key_channels:
-                    yield self._empty_global_agg(node, specs)
-                return
-            yield final_op(merged)
+            combined = compact_buffer()
+            if combined is not None:
+                spill(combined)
+            for p in range(npart):
+                nrows = store.partition_rows(p)
+                if nrows == 0:
+                    continue
+                pg = store.restage(p, _next_pow2(max(nrows, 1)))
+                store.drop(p)
+                yield final_op(pg)
         return PageStream(gen(), node.outputs)
 
     def _empty_global_agg(self, node: AggregationNode, specs) -> Page:
@@ -596,13 +666,76 @@ class LocalExecutionPlanner:
                                 lambda: order_by(keys))
 
         def gen():
-            page = self._collect(src)
-            if page is None:
+            # sort spill (spiller/ + MergingSortedPages analog, re-thought):
+            # over-budget inputs flush to host RANGE partitions of the
+            # leading sort key (ties and NULLs can't straddle partitions —
+            # exec/spill.py leading_rank), then each partition re-stages,
+            # fully sorts, and emits in partition order == global order.
+            from trino_tpu.exec.memory import page_bytes
+            from trino_tpu.exec.spill import (HostPartitionStore,
+                                              partition_by_range,
+                                              rank_bounds, leading_rank)
+            threshold = int(self.session.get("sort_spill_threshold_bytes"))
+            npart = int(self.session.get("spill_partition_count"))
+            spillable = bool(self.session.get("spill_enabled")) and keys
+            k0 = keys[0]
+            store = None
+            bounds = None
+            part_op = None
+            buf: List[Page] = []
+            buf_bytes = 0
+
+            def flush():
+                nonlocal store, bounds, part_op, buf, buf_bytes
+                merged = self.merge_counted(buf)
+                buf, buf_bytes = [], 0
+                if merged is None:
+                    return
+                if bounds is None:
+                    store = HostPartitionStore(npart)
+                    nf = k0.resolved_nulls_first()
+                    rank_op = cached_kernel(
+                        ("sort-spill-rank", k0.channel, k0.ascending, nf),
+                        lambda: leading_rank(k0.channel, k0.ascending, nf))
+                    bounds_op = cached_kernel(
+                        ("sort-spill-bounds", npart),
+                        lambda: rank_bounds(npart))
+                    part_op = cached_kernel(
+                        ("sort-spill-part", k0.channel, k0.ascending, nf,
+                         npart),
+                        lambda: partition_by_range(k0.channel, k0.ascending,
+                                                   nf, npart))
+                    bounds = bounds_op(rank_op(merged), merged.row_mask(),
+                                       merged.num_rows)
+                sorted_pg, counts = part_op(merged, bounds)
+                store.spill_partitioned(sorted_pg, jax.device_get(counts))
+
+            for page in src.iter_pages():
+                buf.append(page)
+                buf_bytes += page_bytes(page)
+                if spillable and buf_bytes >= threshold:
+                    flush()
+
+            if store is None:
+                page = self.merge_counted(buf)
+                if page is None:
+                    return
+                from trino_tpu.exec.memory import page_bytes as _pb
+                self.memory.reserve(_pb(page), "collect")
+                try:
+                    yield sort_op(page)
+                finally:
+                    self._free_collected(page)
                 return
-            try:
-                yield sort_op(page)
-            finally:
-                self._free_collected(page)
+            if buf:
+                flush()
+            for p in range(npart):
+                nrows = store.partition_rows(p)
+                if nrows == 0:
+                    continue
+                pg = store.restage(p, _next_pow2(max(nrows, 1)))
+                store.drop(p)
+                yield sort_op(pg)
         return PageStream(gen(), src.symbols)
 
     def _exec_TopNNode(self, node: TopNNode) -> PageStream:

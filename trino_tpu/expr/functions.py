@@ -111,13 +111,22 @@ def _divide(out_type, arg_types, a, b):
         adj = jnp.where((num >= 0) == (den >= 0), num + half, num - half)
         return jax.lax.div(adj, den)
     if jnp.issubdtype(jnp.result_type(a), jnp.integer):
+        a, b = _promote_pair(a, b)
         return jax.lax.div(a, b)  # truncate toward zero (Java)
     return a / b
+
+
+def _promote_pair(a, b):
+    """lax.div/rem require identical dtypes; mixed-width integer operands
+    (bigint % integer literal) promote to the common type first."""
+    dt = jnp.result_type(a, b)
+    return jnp.asarray(a).astype(dt), jnp.asarray(b).astype(dt)
 
 
 @scalar("modulus")
 def _modulus(out_type, arg_types, a, b):
     if jnp.issubdtype(jnp.result_type(a), jnp.integer):
+        a, b = _promote_pair(a, b)
         return jax.lax.rem(a, b)  # sign of dividend (Java %)
     return jnp.fmod(a, b)
 

@@ -41,6 +41,14 @@ SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     # sorted key array on device and pays host gathers at match count)
     "spill_enabled": True,
     "join_spill_threshold_bytes": 1 << 30,
+    # aggregation spill: partial-state buffers over this compact via
+    # Step.INTERMEDIATE; non-collapsing groups spill to host hash
+    # partitions (exec/spill.py), finalized one partition at a time
+    "agg_spill_threshold_bytes": 2 << 30,
+    "spill_partition_count": 16,
+    # sort spill: buffered input over this flushes as host runs, finished
+    # by range partitions of the leading sort key
+    "sort_spill_threshold_bytes": 2 << 30,
 }
 
 

@@ -31,10 +31,17 @@ from trino_tpu.page import Column, Page
 
 
 class Step:
-    """Aggregation step (reference: operator/aggregation/AggregationNode.Step)."""
+    """Aggregation step (reference: operator/aggregation/AggregationNode.Step).
+
+    INTERMEDIATE merges partial states and re-emits the PARTIAL layout —
+    the spillable-aggregation compaction step
+    (MergingHashAggregationBuilder.java analog): the executor folds an
+    over-budget buffer of partial pages into one group-compacted partial
+    page before deciding whether to spill it."""
 
     SINGLE = "single"
     PARTIAL = "partial"
+    INTERMEDIATE = "intermediate"
     FINAL = "final"
 
 
@@ -704,7 +711,7 @@ def _direct_aggregate(page: Page, key_channels, aggs, resolved, step,
     for ai, (spec, fn) in enumerate(zip(aggs, resolved)):
         states = fn.state(spec.input_type)
         entry = {"states": states, "contribs": []}
-        if step == Step.FINAL:
+        if step in (Step.FINAL, Step.INTERMEDIATE):
             chans = partial_state_channels[ai]
             entry["dictionary"] = page.column(chans[0]).dictionary
             entry["contribs"] = _final_state_contribs(page, states, chans,
@@ -740,7 +747,7 @@ def _direct_aggregate(page: Page, key_channels, aggs, resolved, step,
         state_arrays = [reduced(c, r) for c, r in entry["contribs"]]
         states = entry["states"]
         dictionary = entry["dictionary"]
-        if step == Step.PARTIAL:
+        if step in (Step.PARTIAL, Step.INTERMEDIATE):
             for sc, arr in zip(states, state_arrays):
                 d = dictionary if T.is_string(sc.type) else None
                 v, _ = compact(arr.astype(sc.type.dtype))
@@ -830,7 +837,7 @@ def _accumulate(page, aggs, resolved, step, partial_state_channels,
         return dmask_cache[key]
 
     for ai, (spec, fn) in enumerate(zip(aggs, resolved)):
-        if step == Step.FINAL:
+        if step in (Step.FINAL, Step.INTERMEDIATE):
             # inputs are partial state columns; merge with each state's
             # reducer (dead rows contribute the reducer identity)
             chans = partial_state_channels[ai]
@@ -839,6 +846,13 @@ def _accumulate(page, aggs, resolved, step, partial_state_channels,
                 _segment_reduce(contrib, seg, n, reducer)
                 for contrib, reducer in _final_state_contribs(
                     page, states, chans, seg < n, gather=perm_sorted)]
+            if step == Step.INTERMEDIATE:
+                d = page.column(chans[0]).dictionary
+                for sc, arr in zip(states, merged):
+                    sd = d if T.is_string(sc.type) else None
+                    out.append(Column(arr.astype(sc.type.dtype), None,
+                                      sc.type, sd))
+                continue
             values, valid = fn.final(merged, None)
             out.append(_agg_out_column(fn, spec, values, valid,
                                        page.column(chans[0]).dictionary))

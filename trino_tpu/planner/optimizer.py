@@ -184,27 +184,113 @@ def run_rules(root: PlanNode, rules: Sequence[Rule], ctx: OptimizerContext,
 
 
 class StatsEstimator:
-    """Row-count estimation driving join distribution/ordering decisions.
+    """Row-count + NDV estimation driving join distribution/ordering.
 
-    cost/ in the reference derives full NDV/size stats
-    (FilterStatsCalculator.java, JoinStatsRule.java); here per-shape
-    selectivities with NDV for point lookups are enough for
-    broadcast-vs-partitioned, build-side, and greedy join-order choices.
+    cost/ parity (FilterStatsCalculator.java, JoinStatsRule.java,
+    StatsCalculator): per-column distinct counts propagate bottom-up
+    (scan stats -> filter scaling -> join/aggregate pass-through), join
+    cardinality uses the classic |L||R| / max(ndv_l, ndv_r) with
+    exponential damping across clauses, GROUP BY uses the NDV product,
+    and LIKE selectivity comes from the connector's dictionary pool —
+    the round-4 q9 join-order regression was exactly a missing
+    dictionary-LIKE estimate plus FK columns claiming table-sized NDVs.
     """
 
     FILTER_SELECTIVITY = 0.33
     RANGE_SELECTIVITY = 0.3
     SEMI_SELECTIVITY = 0.5
+    LIKE_SELECTIVITY = 0.25      # fallback when no dictionary answers
 
     def __init__(self, metadata: Metadata):
         self.metadata = metadata
         self._cache: Dict[int, float] = {}
+        self._ndv_cache: Dict[Tuple[int, str], Optional[float]] = {}
 
     def rows(self, node: PlanNode) -> float:
         key = node.id
         if key not in self._cache:
             self._cache[key] = self._estimate(node)
         return self._cache[key]
+
+    # ------------------------------------------------------------- NDV
+
+    def ndv(self, node: PlanNode, sym: str) -> Optional[float]:
+        """Distinct count of `sym` in node's output, None when unknown."""
+        key = (node.id, sym)
+        if key not in self._ndv_cache:
+            self._ndv_cache[key] = self._ndv(node, sym)
+        return self._ndv_cache[key]
+
+    def _ndv(self, node: PlanNode, sym: str) -> Optional[float]:
+        if isinstance(node, TableScanNode):
+            try:
+                stats = self.metadata.get_table_statistics(
+                    node.catalog, node.table)
+            except Exception:
+                return None
+            for s, col in node.assignments:
+                if s.name == sym:
+                    cs = (stats.columns or {}).get(col.name)
+                    if cs is not None and cs.distinct_count:
+                        return min(float(cs.distinct_count),
+                                   self.rows(node))
+                    return None
+            return None
+        if isinstance(node, ProjectNode):
+            for s, e in node.assignments:
+                if s.name == sym:
+                    if isinstance(e, SymbolRef):
+                        return self._capped(node.source, e.name,
+                                            self.rows(node))
+                    return None
+            return None
+        if isinstance(node, JoinNode):
+            cap = self.rows(node)
+            for side in (node.left, node.right):
+                if any(s.name == sym for s in side.outputs):
+                    return self._capped(side, sym, cap)
+            return None
+        if isinstance(node, AggregationNode):
+            if any(s.name == sym for s in node.group_by):
+                return self._capped(node.source, sym, self.rows(node))
+            return None
+        if isinstance(node, SemiJoinNode):
+            return self._capped(node.source, sym, self.rows(node))
+        if node.sources:
+            return self._capped(node.sources[0], sym, self.rows(node))
+        return None
+
+    def _capped(self, src: PlanNode, sym: str, cap: float
+                ) -> Optional[float]:
+        n = self.ndv(src, sym)
+        return None if n is None else min(n, max(cap, 1.0))
+
+    def _scan_of(self, node: PlanNode, sym: str
+                 ) -> Optional[Tuple[TableScanNode, str]]:
+        """Descend identity chains to the scan providing `sym` (for the
+        connector LIKE-selectivity hook)."""
+        while True:
+            if isinstance(node, TableScanNode):
+                for s, col in node.assignments:
+                    if s.name == sym:
+                        return node, col.name
+                return None
+            if isinstance(node, ProjectNode):
+                for s, e in node.assignments:
+                    if s.name == sym:
+                        if isinstance(e, SymbolRef):
+                            sym = e.name
+                            break
+                        return None
+                else:
+                    return None
+                node = node.source
+            elif isinstance(node, FilterNode):
+                node = node.source
+            else:
+                return None
+
+    # ------------------------------------------------------ selectivity
 
     def _scan_selectivity(self, node: TableScanNode, stats) -> float:
         """Domain-based selectivity per constrained column
@@ -226,20 +312,79 @@ class StatsEstimator:
                 sel *= self.RANGE_SELECTIVITY
         return max(sel, 1e-6)
 
-    def _filter_selectivity(self, pred: RowExpression) -> float:
+    def _conjunct_selectivity(self, p: RowExpression,
+                              source: Optional[PlanNode]) -> float:
+        def sym_lit(call):
+            if len(call.args) == 2 and isinstance(call.args[0], SymbolRef) \
+                    and isinstance(call.args[1], Literal):
+                return call.args[0].name
+            return None
+
+        if isinstance(p, Call) and p.name == "eq":
+            if source is not None:
+                s = sym_lit(p)
+                n = self.ndv(source, s) if s else None
+                if n:
+                    return 1.0 / n
+            return 0.1
+        if isinstance(p, Call) and p.name in ("lt", "le", "gt", "ge"):
+            return self.RANGE_SELECTIVITY
+        if isinstance(p, Call) and p.name == "like" and source is not None:
+            if isinstance(p.args[0], SymbolRef) and \
+                    isinstance(p.args[1], Literal):
+                hit = self._scan_of(source, p.args[0].name)
+                if hit is not None:
+                    scan, col = hit
+                    try:
+                        conn = self.metadata.connector(scan.catalog)
+                        est = conn.metadata.estimate_like_selectivity(
+                            scan.table, col, p.args[1].value)
+                        if est is not None:
+                            return max(est, 1e-6)
+                    except Exception:
+                        pass
+            return self.LIKE_SELECTIVITY
+        if isinstance(p, SpecialForm) and p.kind is SpecialKind.BETWEEN:
+            return self.RANGE_SELECTIVITY
+        if isinstance(p, SpecialForm) and p.kind is SpecialKind.IN:
+            k = len(p.args) - 1
+            if source is not None and isinstance(p.args[0], SymbolRef):
+                n = self.ndv(source, p.args[0].name)
+                if n:
+                    return min(1.0, k / n)
+            return min(1.0, 0.1 * k)
+        if isinstance(p, SpecialForm) and p.kind is SpecialKind.NOT:
+            return max(1e-6, 1.0 - self._conjunct_selectivity(
+                p.args[0], source))
+        return 0.9  # UNKNOWN_FILTER_COEFFICIENT
+
+    def _filter_selectivity(self, pred: RowExpression,
+                            source: Optional[PlanNode] = None) -> float:
         sel = 1.0
         for p in conjuncts(pred):
-            if isinstance(p, Call) and p.name == "eq":
-                sel *= 0.1
-            elif isinstance(p, Call) and p.name in ("lt", "le", "gt", "ge"):
-                sel *= self.RANGE_SELECTIVITY
-            elif isinstance(p, SpecialForm) and p.kind is SpecialKind.BETWEEN:
-                sel *= self.RANGE_SELECTIVITY
-            elif isinstance(p, SpecialForm) and p.kind is SpecialKind.IN:
-                sel *= min(1.0, 0.1 * (len(p.args) - 1))
-            else:
-                sel *= 0.9  # UNKNOWN_FILTER_COEFFICIENT
+            sel *= self._conjunct_selectivity(p, source)
         return max(sel, 1e-6)
+
+    # ------------------------------------------------------------ rows
+
+    @staticmethod
+    def join_cardinality(lr: float, rr: float,
+                         clause_ndvs) -> float:
+        """|L JOIN R| = |L||R| * prod of per-clause 1/max(ndv), clauses
+        sorted strongest-first with exponential damping (correlated
+        composite keys would otherwise be catastrophically under-
+        estimated — the SQL Server/Trino compromise)."""
+        sels = []
+        for nl, nr in clause_ndvs:
+            d = max(nl or 0.0, nr or 0.0)
+            if d > 0:
+                sels.append(1.0 / d)
+            else:
+                sels.append(1.0 / max(min(lr, rr), 1.0))  # PK-FK fallback
+        out = lr * rr
+        for i, s in enumerate(sorted(sels)):
+            out *= s ** (1.0 / (2 ** i))
+        return max(out, 1.0)
 
     def _estimate(self, node: PlanNode) -> float:
         if isinstance(node, TableScanNode):
@@ -255,24 +400,44 @@ class StatsEstimator:
             return float(len(node.rows))
         if isinstance(node, FilterNode):
             return max(1.0, self.rows(node.source)
-                       * self._filter_selectivity(node.predicate))
+                       * self._filter_selectivity(node.predicate,
+                                                  node.source))
         if isinstance(node, (LimitNode, TopNNode, DistinctLimitNode)):
             return min(self.rows(node.source), float(node.count))
         if isinstance(node, AggregationNode):
             src = self.rows(node.source)
             if not node.group_by:
                 return 1.0
-            return max(1.0, src ** 0.75)  # group count heuristic
+            # group count = NDV product, capped by input rows
+            prod = 1.0
+            known = True
+            for s in node.group_by:
+                n = self.ndv(node.source, s.name)
+                if n is None:
+                    known = False
+                    break
+                prod *= n
+            if known:
+                return max(1.0, min(src, prod))
+            return max(1.0, src ** 0.75)
         if isinstance(node, JoinNode):
             lr = self.rows(node.left)
             rr = self.rows(node.right)
             if node.kind == JoinKind.CROSS and not node.criteria:
                 return lr * rr
-            # PK-FK assumption: output ~ larger side
-            out = max(lr, rr)
+            clause_ndvs = [(self.ndv(node.left, c.left.name),
+                            self.ndv(node.right, c.right.name))
+                           for c in node.criteria]
+            out = self.join_cardinality(lr, rr, clause_ndvs)
+            if node.kind == JoinKind.LEFT:
+                out = max(out, lr)
+            elif node.kind == JoinKind.RIGHT:
+                out = max(out, rr)
+            elif node.kind == JoinKind.FULL:
+                out = max(out, lr, rr)
             if node.filter is not None:
                 out *= self.FILTER_SELECTIVITY
-            return out
+            return max(out, 1.0)
         if isinstance(node, SemiJoinNode):
             return self.rows(node.source)
         if isinstance(node, UnionNode):
@@ -850,6 +1015,9 @@ def reorder_joins(root: PlanNode, ctx: OptimizerContext) -> PlanNode:
     return walk(root)
 
 
+_DP_MAX_RELATIONS = 9    # ReorderJoins.java JoinEnumerator cap
+
+
 def _build_join_tree(sources: List[PlanNode], edges: List[JoinClause],
                      filters: List[RowExpression],
                      ctx: OptimizerContext) -> PlanNode:
@@ -871,10 +1039,122 @@ def _build_join_tree(sources: List[PlanNode], edges: List[JoinClause],
         else:
             located.append((a, b, c))
 
+    n = len(sources)
+    if n <= _DP_MAX_RELATIONS:
+        current = _dp_join_tree(sources, located, ctx)
+    else:
+        current = _greedy_join_tree(sources, syms_of, located, ctx)
+    if filters:
+        current = FilterNode(current, combine(filters))
+    return current
+
+
+def _dp_join_tree(sources: List[PlanNode], located,
+                  ctx: OptimizerContext) -> PlanNode:
+    """Selinger-style bitmask DP over connected subsets, minimizing the
+    sum of intermediate result sizes (ReorderJoins.JoinEnumerator:168 with
+    JoinStatsRule cardinalities). Cross joins only appear when the
+    equality graph is genuinely disconnected."""
+    n = len(sources)
+    rows = [ctx.stats.rows(s) for s in sources]
+    edge_info = []   # (mask_a, mask_b, per-clause selectivity)
+    for a, b, c in located:
+        na = ctx.stats.ndv(sources[a], c.left.name)
+        nb = ctx.stats.ndv(sources[b], c.right.name)
+        d = max(na or 0.0, nb or 0.0)
+        if d <= 0:
+            # unknown NDV: the same PK-FK fallback join_cardinality uses,
+            # anchored on the edge's smaller endpoint
+            d = max(min(rows[a], rows[b]), 1.0)
+        edge_info.append((1 << a, 1 << b, 1.0 / d))
+
+    from functools import lru_cache
+
+    @lru_cache(maxsize=None)
+    def mask_rows(mask: int) -> float:
+        out = 1.0
+        for i in range(n):
+            if mask & (1 << i):
+                out *= rows[i]
+        sels = [s for ma, mb, s in edge_info
+                if (mask & ma) and (mask & mb)]
+        for i, s in enumerate(sorted(sels)):
+            out *= s ** (1.0 / (2 ** i))
+        return max(out, 1.0)
+
+    def connects(ma: int, mb: int) -> bool:
+        return any(((ea & ma) and (eb & mb)) or ((eb & ma) and (ea & mb))
+                   for ea, eb, _ in edge_info)
+
+    best: Dict[int, Tuple[float, Optional[Tuple[int, int]]]] = {}
+    for i in range(n):
+        best[1 << i] = (0.0, None)
+
+    full = (1 << n) - 1
+    # iterate masks in popcount order so sub-results exist
+    masks = sorted(range(1, full + 1), key=lambda m: bin(m).count("1"))
+    for mask in masks:
+        if mask in best:
+            continue
+        size = mask_rows(mask)
+        # a cross join (disconnected partition) carries a huge penalty so
+        # it survives ONLY when the equality graph is genuinely
+        # disconnected — parents then avoid any split whose subtree needs
+        # one (EliminateCrossJoins' contract)
+        CROSS_PENALTY = 1e12
+        picked: Optional[Tuple[float, Tuple[int, int]]] = None
+        # enumerate proper submask partitions (canonical: sub contains
+        # lowest set bit, so each split is seen once)
+        low = mask & (-mask)
+        sub = (mask - 1) & mask
+        while sub:
+            other = mask ^ sub
+            if (sub & low) and sub in best and other in best:
+                cost = best[sub][0] + best[other][0] + size
+                if not connects(sub, other):
+                    cost += CROSS_PENALTY
+                if picked is None or cost < picked[0]:
+                    picked = (cost, (sub, other))
+            sub = (sub - 1) & mask
+        if picked is not None:
+            best[mask] = picked
+    if full not in best:
+        # degenerate (shouldn't happen): chain greedily
+        return _greedy_join_tree(sources,
+                                 [{s.name for s in src.outputs}
+                                  for src in sources], located, ctx)
+
+    def build(mask: int) -> Tuple[PlanNode, Set[str], Set[int]]:
+        _, split = best[mask]
+        if split is None:
+            i = mask.bit_length() - 1
+            return sources[i], {s.name for s in sources[i].outputs}, {i}
+        a, b = split
+        na, sa, ia = build(a)
+        nb, sb, ib = build(b)
+        # probe (left) = larger estimated side; build (right) = smaller
+        if mask_rows(a) < mask_rows(b):
+            na, sa, ia, nb, sb, ib = nb, sb, ib, na, sa, ia
+        criteria = []
+        for x, y, c in located:
+            if x in ia and y in ib:
+                criteria.append(c)
+            elif y in ia and x in ib:
+                criteria.append(JoinClause(c.right, c.left))
+        kind = JoinKind.INNER if criteria else JoinKind.CROSS
+        return (JoinNode(kind, na, nb, tuple(criteria)),
+                sa | sb, ia | ib)
+
+    node, _, _ = build(full)
+    return node
+
+
+def _greedy_join_tree(sources: List[PlanNode], syms_of, located,
+                      ctx: OptimizerContext) -> PlanNode:
+    """Greedy nearest-neighbor fallback for >_DP_MAX_RELATIONS trees."""
     rows = [ctx.stats.rows(s) for s in sources]
     n = len(sources)
 
-    # cheapest connected starting pair (fall back: two smallest sources)
     best: Optional[Tuple[float, int, int]] = None
     for a, b, _ in located:
         cost = max(rows[a], rows[b])
@@ -907,9 +1187,6 @@ def _build_join_tree(sources: List[PlanNode], edges: List[JoinClause],
         used.add(j)
         cur_rows = est
         cur_syms |= syms_of[j]
-
-    if filters:
-        current = FilterNode(current, combine(filters))
     return current
 
 
