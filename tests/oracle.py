@@ -30,10 +30,15 @@ def _sql_value(v, typ: T.Type):
     return v.item() if isinstance(v, np.generic) else v
 
 
-def _load_sqlite(connector_module, sf: float) -> sqlite3.Connection:
-    """Load one generator connector's tables into sqlite, decimals as scaled
-    ints (exact integer arithmetic; tests rescale in SQL)."""
+def _load_sqlite(connector_module, sf: float, value_fn=None,
+                 index_pred=None) -> sqlite3.Connection:
+    """Load one generator connector's tables into sqlite. Default value
+    mapping keeps decimals as scaled ints (exact integer arithmetic;
+    tests rescale in SQL); `value_fn` overrides per-value conversion and
+    `index_pred(column) -> bool` selects columns to index."""
+    value_fn = value_fn or _sql_value
     conn = sqlite3.connect(":memory:")
+    index_ddl = []
     for table, (cols, _) in connector_module.TABLES.items():
         data = connector_module.get_table(table, sf)
         names = [c for c, _ in cols]
@@ -41,11 +46,17 @@ def _load_sqlite(connector_module, sf: float) -> sqlite3.Connection:
         arrays = [data[c] for c in names]
         typs = [ty for _, ty in cols]
         rows = zip(*[
-            [_sql_value(v, ty) for v in arr]
+            [value_fn(v, ty) for v in arr]
             for arr, ty in zip(arrays, typs)])
         conn.executemany(
             f"INSERT INTO {table} VALUES ({', '.join('?' * len(names))})",
             rows)
+        if index_pred is not None:
+            index_ddl.extend(
+                f"CREATE INDEX idx_{table}_{c} ON {table}({c})"
+                for c in names if index_pred(c))
+    for ddl in index_ddl:
+        conn.execute(ddl)
     conn.commit()
     return conn
 
@@ -57,6 +68,51 @@ def load_tpch_sqlite(sf: float = 0.01) -> sqlite3.Connection:
 def load_tpcds_sqlite(sf: float = 0.01) -> sqlite3.Connection:
     from trino_tpu.connector import tpcds
     return _load_sqlite(tpcds, sf)
+
+
+def _sql_value_float(v, typ: T.Type):
+    if isinstance(typ, T.DecimalType):
+        # floats instead of scaled ints: lets UNMODIFIED benchmark SQL
+        # (decimal literals, arbitrary arithmetic) run on sqlite; the
+        # comparison tolerates the float grid (_row_eq dec-vs-float)
+        return int(v) / (10 ** typ.scale)
+    if isinstance(typ, (T.DateType,)):
+        return int(v)
+    return v.item() if isinstance(v, np.generic) else v
+
+
+class _StddevSamp:
+    def __init__(self):
+        self.vals = []
+
+    def step(self, v):
+        if v is not None:
+            self.vals.append(float(v))
+
+    def finalize(self):
+        n = len(self.vals)
+        if n < 2:
+            return None
+        m = sum(self.vals) / n
+        return math.sqrt(sum((x - m) ** 2 for x in self.vals) / (n - 1))
+
+
+def load_tpcds_sqlite_float(sf: float = 0.01) -> sqlite3.Connection:
+    """Float-decimal variant: lets UNMODIFIED benchmark SQL run on
+    sqlite, with surrogate-key indexes (sqlite plans nested-loop joins
+    and the benchmark queries join every fact to 3-8 dimensions)."""
+    from trino_tpu.connector import tpcds
+    conn = _load_sqlite(
+        tpcds, sf, value_fn=_sql_value_float,
+        index_pred=lambda c: c.endswith("_sk")
+        or c.endswith("_ticket_number") or c.endswith("_order_number"))
+    # benchmark-SQL helpers sqlite lacks
+    conn.create_function(
+        "concat", -1,
+        lambda *a: None if any(x is None for x in a)
+        else "".join(str(x) for x in a))
+    conn.create_aggregate("stddev_samp", 1, _StddevSamp)
+    return conn
 
 
 def normalize(rows: List[Tuple], sort: bool = False) -> List[Tuple]:
@@ -105,6 +161,10 @@ def _row_eq(a: Tuple, b: Tuple) -> bool:
     if len(a) != len(b):
         return False
     for x, y in zip(a, b):
+        if x is None or y is None:
+            if x is not y:     # NULL matches only NULL
+                return False
+            continue
         if isinstance(x, tuple) and x and x[0] == "f":
             if not (isinstance(y, tuple) and y and y[0] == "f"):
                 # oracle may return int where engine returns float
@@ -123,9 +183,11 @@ def _row_eq(a: Tuple, b: Tuple) -> bool:
             if isinstance(y, tuple) and y and y[0] == "f":
                 # engine decimal vs float oracle (e.g. decimal division —
                 # Trino types q8's mkt_share decimal(38,4)): equal when the
-                # float rounds onto the decimal's grid
+                # float rounds onto the decimal's grid. Inclusive half-step
+                # bound: an avg landing EXACTLY on .xx5 rounds HALF_UP on
+                # the engine while the float keeps it — still equal.
                 scale = x[2] if len(x) > 2 else 0
-                if abs(x[1] / (10 ** scale) - y[1]) > 0.5 * 10 ** -scale:
+                if abs(x[1] - y[1] * 10 ** scale) > 0.5 + 1e-6:
                     return False
             else:
                 yv = y[1] if isinstance(y, tuple) else y

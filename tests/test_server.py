@@ -127,3 +127,55 @@ def test_unknown_query_404(server):
         assert False, "expected 404"
     except urllib.error.HTTPError as e:
         assert e.code == 404
+
+
+def test_concurrent_paging_during_long_query(server):
+    """Dispatch queue (round 5): a running query must not block another
+    client paging an already-finished query's results
+    (DispatchManager.java:140 / resource-group max_running=1 shape)."""
+    import threading
+    import time
+
+    # finish a short query first; keep its page-0 URI
+    payload, _ = _post(server, "SELECT n_nationkey FROM nation")
+    first_uri = payload["nextUri"]
+    while "nextUri" in payload:
+        payload, _ = _get(payload["nextUri"])
+    # launch a LONG query in a side thread (self-join at tiny ~seconds)
+    long_sql = ("SELECT count(*) FROM lineitem l1, lineitem l2 "
+                "WHERE l1.l_orderkey = l2.l_orderkey "
+                "AND l1.l_partkey = l2.l_partkey")
+    done = {}
+
+    def run_long():
+        done["result"] = run_query(server, long_sql)
+    th = threading.Thread(target=run_long)
+    th.start()
+    # while it runs, page the finished query's buffered results: must be
+    # immediate (no engine lock on the paging path)
+    t0 = time.perf_counter()
+    page, _ = _get(first_uri)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 2.0, f"paging blocked for {elapsed:.1f}s"
+    assert page.get("data") or "nextUri" in page
+    th.join(timeout=120)
+    assert done["result"][2][0][0] > 0       # long query completed too
+
+
+def test_queue_full_admission(server):
+    """Admission control: an over-limit submit fails as
+    QUERY_QUEUE_FULL, not an HTTP error (InternalResourceGroup analog)."""
+    import queue as queue_mod
+    saved = server._queue
+
+    class _Stuffed:
+        def put_nowait(self, item):
+            raise queue_mod.Full()
+    server._queue = _Stuffed()
+    try:
+        payload, _, _, states, _ = run_query(
+            server, "SELECT 1")
+        assert payload["stats"]["state"] == "FAILED"
+        assert payload["error"]["errorName"] == "QUERY_QUEUE_FULL"
+    finally:
+        server._queue = saved

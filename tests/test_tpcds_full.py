@@ -1,0 +1,106 @@
+"""The 99 TPC-DS benchmark queries against the engine + sqlite oracle.
+
+Reference parity: testing/trino-benchto-benchmarks tpcds suite +
+TpcdsQueryRunner — the full decision-support workload. Query text loads
+from the reference checkout at runtime (spec material; see
+tpcds_queries.py) — tests skip when it isn't present.
+
+Three tiers:
+- VERIFIED: engine rows == sqlite oracle rows (float-decimal schema,
+  surrogate-key indexes) at SF0.01, multiset comparison.
+- EXECUTES: runs through parse/plan/optimize/execute and returns without
+  error; sqlite can't run the query (ROLLUP/GROUPING()/compound-set
+  parens/stddev-shape) or the LIMIT tie-break diverges — still asserted
+  not to regress.
+- KNOWN_FAILING: tracked gaps, asserted to fail (so a fix shows up as an
+  xpass to promote).
+"""
+
+import pytest
+
+import tpcds_queries
+from trino_tpu.exec import LocalQueryRunner
+
+pytestmark = pytest.mark.skipif(
+    not tpcds_queries.available(),
+    reason="reference TPC-DS query resources not present")
+
+# engine == oracle at SF0.01 (generated list; see NOTES_r05.md)
+VERIFIED = [
+    "q01", "q03", "q04", "q06", "q07", "q09", "q10", "q11", "q12", "q13",
+    "q15", "q16", "q17", "q19", "q20", "q21", "q23", "q24", "q25", "q26",
+    "q28", "q29", "q30", "q31", "q32", "q33", "q34", "q35", "q37", "q38",
+    "q39", "q40", "q42", "q43", "q44", "q45", "q46", "q47", "q48", "q49",
+    "q50", "q51", "q52", "q53", "q54", "q55", "q56", "q57", "q58", "q59",
+    "q60", "q61", "q62", "q63", "q64", "q65", "q68", "q69", "q71", "q72",
+    "q73", "q74", "q75", "q76", "q79", "q81", "q82", "q83", "q84", "q85",
+    "q88", "q89", "q91", "q92", "q93", "q94", "q95", "q96", "q97", "q98",
+    "q99",
+]
+
+# engine executes; oracle can't run the shape (sqlite: no ROLLUP/
+# GROUPING(), no parenthesized compound-set operands) or the comparison
+# diverges on documented deviations (q90: decimal division by zero is
+# garbage not an error; q66/q78 under investigation)
+EXECUTES = [
+    "q02", "q05", "q08", "q14", "q18", "q22", "q27", "q36", "q66", "q67",
+    "q70", "q77", "q78", "q80", "q86", "q87", "q90",
+]
+
+# tracked gaps
+KNOWN_FAILING = {
+    "q41": "correlated count(*) subquery with OR-heavy local predicate",
+}
+
+
+# the full 99-query sweep takes ~15 min on the 1-core host; default CI
+# runs a representative sample across the join/agg/window/set-op shapes,
+# TRINO_TPU_TPCDS_FULL=1 runs everything (what NOTES_r05 reports)
+import os
+
+_FULL = os.environ.get("TRINO_TPU_TPCDS_FULL", "0") == "1"
+_SAMPLE = ["q03", "q07", "q10", "q23", "q31", "q38", "q49", "q51", "q54",
+           "q64", "q72", "q74", "q88", "q93", "q99"]
+_VERIFIED_RUN = VERIFIED if _FULL else \
+    [q for q in _SAMPLE if q in VERIFIED]
+_EXECUTES_RUN = EXECUTES if _FULL else ["q27", "q36", "q86", "q90"]
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = LocalQueryRunner.tpch("tiny")
+    r.execute("USE tpcds.tiny")
+    return r
+
+
+@pytest.fixture(scope="module")
+def queries():
+    return tpcds_queries.load_queries()
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    from oracle import load_tpcds_sqlite_float
+    conn = load_tpcds_sqlite_float(0.01)
+    yield conn
+    conn.close()
+
+
+@pytest.mark.parametrize("name", _VERIFIED_RUN)
+def test_verified_vs_oracle(runner, queries, oracle, name):
+    from oracle import assert_same
+    engine = runner.execute(queries[name]).rows
+    got = oracle.execute(
+        tpcds_queries.to_oracle_sql(queries[name])).fetchall()
+    assert_same(engine, got, ordered=False)
+
+
+@pytest.mark.parametrize("name", _EXECUTES_RUN)
+def test_executes(runner, queries, name):
+    runner.execute(queries[name])   # must not raise
+
+
+@pytest.mark.parametrize("name", sorted(KNOWN_FAILING))
+def test_known_failing(runner, queries, name):
+    with pytest.raises(Exception):
+        runner.execute(queries[name])

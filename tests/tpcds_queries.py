@@ -1,0 +1,67 @@
+"""Loader for the 99 TPC-DS benchmark queries.
+
+The query TEXT is TPC-DS spec material (the reference ships it under
+testing/trino-benchto-benchmarks .../tpcds/q*.sql with a
+``${database}.${schema}.`` placeholder); we load it from the reference
+checkout at runtime — nothing is copied into this repo — and strip the
+placeholder. Tests skip when the reference tree isn't present.
+
+Oracle variant: sqlite has no DATE type or INTERVAL arithmetic, so date
+literals rewrite to epoch-day integers and ``(date +/- interval 'N' day)``
+to integer addition (the same adaptation tests/tpch_sql.py documents).
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import re
+from typing import Dict, Optional
+
+QUERY_DIR = ("/root/reference/testing/trino-benchto-benchmarks/src/main/"
+             "resources/sql/presto/tpcds")
+
+
+def available() -> bool:
+    return os.path.isdir(QUERY_DIR)
+
+
+def load_queries() -> Dict[str, str]:
+    out = {}
+    for fn in sorted(os.listdir(QUERY_DIR)):
+        m = re.match(r"q(\d+)\.sql$", fn)
+        if not m:
+            continue
+        sql = open(os.path.join(QUERY_DIR, fn)).read()
+        sql = sql.replace("${database}.${schema}.", "")
+        out[f"q{int(m.group(1)):02d}"] = sql.strip().rstrip(";")
+    return out
+
+
+def _days(s: str) -> int:
+    d = datetime.date.fromisoformat(s)
+    return (d - datetime.date(1970, 1, 1)).days
+
+
+def to_oracle_sql(sql: str) -> str:
+    """Adapt engine SQL to the int-typed sqlite schema."""
+    # (CAST('yyyy-mm-dd' AS DATE) +/- INTERVAL 'n' DAY) -> int arithmetic
+    def cast_interval(m):
+        base = _days(m.group(1))
+        sign = 1 if m.group(2) == "+" else -1
+        return str(base + sign * int(m.group(3)))
+    sql = re.sub(
+        r"\(?\s*CAST\s*\(\s*'(\d{4}-\d{2}-\d{2})'\s+AS\s+DATE\s*\)\s*"
+        r"([+-])\s*INTERVAL\s+'(\d+)'\s+DAY\s*\)?",
+        cast_interval, sql, flags=re.I)
+    sql = re.sub(
+        r"CAST\s*\(\s*'(\d{4}-\d{2}-\d{2})'\s+AS\s+DATE\s*\)",
+        lambda m: str(_days(m.group(1))), sql, flags=re.I)
+    sql = re.sub(r"DATE\s+'(\d{4}-\d{2}-\d{2})'",
+                 lambda m: str(_days(m.group(1))), sql, flags=re.I)
+    # leftover date +/- INTERVAL arithmetic on already-rewritten ints
+    sql = re.sub(r"([+-])\s*INTERVAL\s+'(\d+)'\s+DAY",
+                 lambda m: f"{m.group(1)} {m.group(2)}", sql, flags=re.I)
+    # typed decimal literals: sqlite takes the bare numeric
+    sql = re.sub(r"DECIMAL\s+'([0-9.+-]+)'", r"\1", sql, flags=re.I)
+    return sql

@@ -262,8 +262,11 @@ class LocalExecutionPlanner:
 
     def _exec_FilterNode(self, node: FilterNode) -> PageStream:
         # Filter(SemiJoin) fuses into semi/anti probe (LocalExecutionPlanner
-        # visitFilter's special-cased semi-join consumption)
-        if isinstance(node.source, SemiJoinNode):
+        # visitFilter's special-cased semi-join consumption); complex match
+        # usage (the flag inside OR/CASE — q10/q35-style stacked EXISTS)
+        # falls back to the generic mark-column path
+        if isinstance(node.source, SemiJoinNode) and \
+                self._semijoin_filter_mode(node) is not None:
             return self._exec_semijoin_filter(node)
         src = self.execute(node.source)
         lay, typ = _layout(src.symbols)
@@ -856,13 +859,22 @@ class LocalExecutionPlanner:
                     return
                 # LEFT join with empty build: emit null-extended probe rows
                 bp = self._null_build_page(node.right.outputs)
+            # INNER only: the sentinel codes for probe values absent from
+            # the build pool are filtered out by inner semantics before
+            # any decode; LEFT would emit them (out-of-pool codes in the
+            # output), so mismatched-dictionary LEFT keys stay fail-loud
+            # in the kernels
+            aligned = probe_stream
+            if join_kind == JoinType.INNER:
+                aligned = self._align_join_dictionaries(
+                    probe_stream, bp, probe_keys, build_keys)
             from trino_tpu.exec.memory import page_bytes
             if join_kind == JoinType.INNER and build_page is not None and \
                     self.session.get("spill_enabled") and \
                     page_bytes(build_page) > int(self.session.get(
                         "join_spill_threshold_bytes")):
                 yield from self._run_spilled_inner(
-                    probe_stream, build_page, probe_keys, build_keys,
+                    aligned, build_page, probe_keys, build_keys,
                     post_pred, probe_keep, build_keep, join_op)
                 return
             try:
@@ -884,7 +896,7 @@ class LocalExecutionPlanner:
                         ("dfrange", probe_keys[0]),
                         lambda: range_prefilter(probe_keys[0]))
                     prefilter = (pf_op, bounds_op(bp))
-                coalesced = self._coalesce_stream(probe_stream,
+                coalesced = self._coalesce_stream(aligned,
                                                   prefilter=prefilter)
                 if join_kind == JoinType.INNER and max_run <= 1:
                     # unique build side (primary/dimension key): the
@@ -1080,6 +1092,56 @@ class LocalExecutionPlanner:
                 out = self._compact_probe(pre, found, total, live)
                 yield attach_op(self._tight(out, total), prepared)
 
+    def _align_join_dictionaries(self, probe_stream: PageStream,
+                                 build_page: Page, probe_keys,
+                                 build_keys) -> PageStream:
+        """String join keys across DISTINCT dictionaries: remap probe key
+        codes onto the build side's pool (DictionaryBlock re-encode; the
+        kernels compare codes, so both sides must share one pool). Probe
+        values absent from the build pool map to unique sentinels past the
+        pool end — they can never match. Lazy: tables build on the first
+        page per (probe-dict, channel) pair."""
+        pairs = [(pk, bk) for pk, bk in zip(probe_keys, build_keys)
+                 if build_page.columns[bk].dictionary is not None]
+        if not pairs:
+            return probe_stream
+        maps: Dict[tuple, jnp.ndarray] = {}
+
+        def gen():
+            for page in probe_stream.iter_pages():
+                cols = list(page.columns)
+                changed = False
+                for pk, bk in pairs:
+                    pc = cols[pk]
+                    bd = build_page.columns[bk].dictionary
+                    if pc.dictionary is None or pc.dictionary is bd:
+                        continue
+                    key = (id(pc.dictionary), bk)
+                    tbl = maps.get(key)
+                    if tbl is None:
+                        pvals = pc.dictionary.values
+                        n_b = len(bd.values)
+                        if n_b:
+                            codes = np.minimum(
+                                np.searchsorted(bd.values, pvals),
+                                n_b - 1).astype(np.int64)
+                            present = bd.values[codes] == pvals
+                        else:
+                            codes = np.zeros(len(pvals), np.int64)
+                            present = np.zeros(len(pvals), bool)
+                        out = np.where(
+                            present, codes,
+                            n_b + np.arange(len(pvals), dtype=np.int64))
+                        tbl = maps[key] = jnp.asarray(
+                            out.astype(np.int32))
+                    cols[pk] = Column(
+                        jnp.take(tbl, jnp.clip(pc.values, 0),
+                                 mode="clip"),
+                        pc.valid, pc.type, bd)
+                    changed = True
+                yield Page(tuple(cols), page.num_rows) if changed else page
+        return PageStream(gen(), probe_stream.symbols)
+
     def _prepare_build(self, build_keys, build_page):
         """Sort the build side ONCE per join (LookupSourceFactory analog) —
         probe-page kernels consume the prepared tuple without re-sorting."""
@@ -1242,12 +1304,15 @@ class LocalExecutionPlanner:
                 yield Page(pcols + bcols, total)
         return PageStream(gen(), out_symbols)
 
-    def _exec_semijoin_filter(self, node: FilterNode) -> PageStream:
+    @staticmethod
+    def _semijoin_filter_mode(node: FilterNode):
+        """('semi'|'anti', rest_conjuncts) when the filter consumes the
+        match flag as a plain top-level conjunct; None -> generic path."""
         semi: SemiJoinNode = node.source
         match_name = semi.match_symbol.name
         mode: Optional[str] = None
         rest: List[RowExpression] = []
-        from trino_tpu.planner.optimizer import conjuncts, combine
+        from trino_tpu.planner.optimizer import conjuncts
         for c in conjuncts(node.predicate):
             if isinstance(c, SymbolRef) and c.name == match_name:
                 mode = "semi"
@@ -1256,12 +1321,17 @@ class LocalExecutionPlanner:
                     and c.args[0].name == match_name:
                 mode = "anti"
             elif match_name in _symbol_names(c):
-                raise ExecutionError(
-                    "complex semi-join match usage not supported")
+                return None
             else:
                 rest.append(c)
         if mode is None:
-            raise ExecutionError("semi-join match symbol unused in filter")
+            return None
+        return mode, rest
+
+    def _exec_semijoin_filter(self, node: FilterNode) -> PageStream:
+        semi: SemiJoinNode = node.source
+        from trino_tpu.planner.optimizer import combine
+        mode, rest = self._semijoin_filter_mode(node)
 
         probe_stream = self.execute(semi.source)
         build_stream = self.execute(semi.filtering_source)

@@ -216,7 +216,16 @@ def _round(out_type, arg_types, a):
 def _round_digits(out_type, arg_types, a, d):
     """round(x, d); the compiler folds literal d (the only supported form)."""
     if _is_decimal(arg_types[0]):
-        raise NotImplementedError("round(decimal, d)")
+        # HALF_UP at digit d within the scaled-int representation; d may
+        # arrive as a traced scalar (projected literal), so stay in jnp
+        scale = arg_types[0].scale
+        keep = jnp.asarray(d).astype(jnp.int64)
+        step = jnp.power(jnp.int64(10),
+                         jnp.clip(scale - keep, 0, 17)).astype(jnp.int64)
+        half = step // 2
+        mag = (jnp.abs(a) + half) // step * step
+        rounded = jnp.where(a >= 0, mag, -mag).astype(jnp.int64)
+        return jnp.where(keep >= scale, a, rounded)
     if jnp.issubdtype(jnp.result_type(a), jnp.integer):
         if d >= 0:
             return a

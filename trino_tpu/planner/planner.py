@@ -127,6 +127,8 @@ class LogicalPlanner:
 
     def _plan_set_operation(self, body: t.SetOperation, outer, ctes
                             ) -> RelationPlan:
+        if body.op in ("INTERSECT", "EXCEPT"):
+            return self._plan_intersect_except(body, outer, ctes)
         if body.op != "UNION":
             raise SemanticError(f"{body.op} not supported yet")
         left = self._plan_query_body(body.left, outer, ctes)
@@ -172,6 +174,51 @@ class LogicalPlanner:
             result = AggregationNode(union, tuple(out_syms), ())
         return RelationPlan(result, Scope(fields, outer))
 
+    def _plan_intersect_except(self, body: t.SetOperation, outer, ctes
+                               ) -> RelationPlan:
+        """INTERSECT/EXCEPT [DISTINCT] as distinct(left) SEMI/ANTI-joined
+        against the right on every column (sql/planner/QueryPlanner's
+        set-operation lowering via SemiJoin + MarkDistinct, condensed).
+        NULL rows never match (generated datasets are null-free here;
+        IS-NOT-DISTINCT matching is a known deviation for NULL keys)."""
+        if not body.distinct:
+            raise SemanticError(f"{body.op} ALL not supported yet")
+        left = self._plan_query_body(body.left, outer, ctes)
+        right = self._plan_query_body(body.right, outer, ctes)
+        lf, rf = left.scope.fields, right.scope.fields
+        if len(lf) != len(rf):
+            raise SemanticError(
+                f"{body.op} inputs have different column counts")
+        types = []
+        for a, b in zip(lf, rf):
+            ct = common_type(a.symbol.type, b.symbol.type)
+            if ct is None:
+                raise SemanticError(f"{body.op} column types incompatible")
+            types.append(ct)
+
+        def casted(side):
+            if all(f.symbol.type == ty
+                   for f, ty in zip(side.scope.fields, types)):
+                return side.node, [f.symbol for f in side.scope.fields]
+            assigns = []
+            for f, ty in zip(side.scope.fields, types):
+                sym = self.symbols.new(f.name or "col", ty)
+                assigns.append((sym, cast_to(f.symbol.ref(), ty)))
+            return ProjectNode(side.node, tuple(assigns)), \
+                [s for s, _ in assigns]
+        lnode, lsyms = casted(left)
+        rnode, rsyms = casted(right)
+        distinct = AggregationNode(lnode, tuple(lsyms), ())
+        match = self.symbols.new("setopmatch", T.BOOLEAN)
+        semi = SemiJoinNode(distinct, rnode, tuple(lsyms), tuple(rsyms),
+                            match, negate=False, null_aware=False)
+        keep = match.ref() if body.op == "INTERSECT" else SpecialForm(
+            SpecialKind.NOT, (match.ref(),), T.BOOLEAN)
+        filt = FilterNode(semi, keep)
+        proj = ProjectNode(filt, tuple((s, s.ref()) for s in lsyms))
+        fields = [Field(f.name, None, s) for f, s in zip(lf, lsyms)]
+        return RelationPlan(proj, Scope(fields, outer))
+
     # ----------------------------------------------------------- relations
 
     def _plan_relation(self, rel: t.Relation, outer: Optional[Scope],
@@ -201,7 +248,9 @@ class LogicalPlanner:
                 fields.append(Field(col, alias, f.symbol))
             return RelationPlan(sub.node, Scope(fields, outer))
         if isinstance(rel, t.TableSubquery):
-            sub = self._plan_query(rel.query, outer, {})
+            # CTEs stay visible inside derived tables (q33-style
+            # `FROM (SELECT ... FROM some_cte UNION ALL ...)`)
+            sub = self._plan_query(rel.query, outer, ctes)
             # subquery loses outer qualifiers
             fields = [Field(f.name, None, f.symbol)
                       for f in sub.scope.fields]
@@ -527,7 +576,17 @@ def _find_calls(exprs: Sequence[t.Expression]) -> List[t.FunctionCall]:
             if id(node) not in seen:
                 seen.add(id(node))
                 out.append(node)
-            return  # don't descend: nested aggs are illegal anyway
+            if node.window is not None:
+                # a window call may legally contain GROUP aggregates —
+                # sum(sum(x)) OVER (...), rank() OVER (ORDER BY sum(x)) —
+                # which must be collected for the aggregation phase
+                for a in node.args:
+                    visit(a)
+                for e in node.window.partition_by:
+                    visit(e)
+                for s in node.window.order_by:
+                    visit(s.key)
+            return  # below a plain aggregate: nested aggs are illegal
         if isinstance(node, (t.SubqueryExpression, t.ExistsPredicate)):
             return  # subquery aggregates belong to the subquery
         for child in _ast_children(node):
@@ -575,6 +634,7 @@ class _PlanBuilder:
         self._scope = relation.scope
         self.ctes = ctes
         self.substitutions: Dict[RowExpression, Symbol] = {}
+        self._grouping_info = None
 
     def scope(self) -> Scope:
         return self._scope
@@ -583,7 +643,33 @@ class _PlanBuilder:
         return ExpressionTranslator(
             self._scope, self.substitutions,
             subquery_handler=self._handle_subquery,
-            session=self.planner.session)
+            session=self.planner.session,
+            grouping_handler=(self._grouping_expr
+                              if self._grouping_info else None))
+
+    def _grouping_expr(self, tr, node):
+        """grouping(k1, ..., kn) -> SWITCH over the GroupId symbol: bit i
+        (MSB-first) set when key i is aggregated away in the row's
+        grouping set (GroupingOperationRewriter.java semantics)."""
+        gid, sets_names = self._grouping_info
+        arg_names = []
+        for a in node.args:
+            e = tr._translate(a)
+            if not isinstance(e, SymbolRef):
+                raise SemanticError(
+                    "grouping() arguments must be grouping keys")
+            arg_names.append(e.name)
+        switch: List[RowExpression] = []
+        for i, present in enumerate(sets_names):
+            mask = 0
+            for j, an in enumerate(arg_names):
+                if an not in present:
+                    mask |= 1 << (len(arg_names) - 1 - j)
+            switch.append(Call("eq", (gid.ref(), Literal(i, T.BIGINT)),
+                               T.BOOLEAN))
+            switch.append(Literal(mask, T.BIGINT))
+        switch.append(Literal(0, T.BIGINT))
+        return SpecialForm(SpecialKind.SWITCH, tuple(switch), T.BIGINT)
 
     # -------------------------------------------------------- WHERE/HAVING
 
@@ -719,6 +805,9 @@ class _PlanBuilder:
             self.node = GroupIdNode(self.node, sets_syms, gid, passthrough)
             self.node = AggregationNode(
                 self.node, group_symbols + (gid,), tuple(aggregations))
+            # grouping() in post-agg expressions decodes the set index
+            self._grouping_info = (
+                gid, [frozenset(s.name for s in gs) for gs in sets_syms])
         else:
             self.node = AggregationNode(self.node, group_symbols,
                                         tuple(aggregations))
@@ -1078,15 +1167,7 @@ class _PlanBuilder:
         names, quals = probe
         refs_inner = False
         refs_outer = False
-        for n in t.walk(e):
-            parts = None
-            if isinstance(n, t.Identifier):
-                parts = (n.value,)
-            elif isinstance(n, t.DereferenceExpression):
-                from trino_tpu.planner.translate import _dereference_parts
-                parts = _dereference_parts(n)
-            if parts is None:
-                continue
+        for parts in self._column_refs(e):
             if len(parts) >= 2:
                 (refs_inner, refs_outer) = (
                     (True, refs_outer) if parts[-2] in quals
@@ -1108,6 +1189,30 @@ class _PlanBuilder:
         if not refs_inner:
             return "outer_only"
         return "other"
+
+    @staticmethod
+    def _column_refs(e: t.Expression):
+        """Column references as qualified-name tuples; a dereference's
+        component identifiers are NOT yielded separately (t1.rk must not
+        read as a bare `rk` — that aliased inner fields named rk onto the
+        outer side and killed the q01-shape decorrelation)."""
+        stack = [e]
+        out = []
+        while stack:
+            n = stack.pop()
+            if isinstance(n, t.DereferenceExpression):
+                from trino_tpu.planner.translate import _dereference_parts
+                parts = _dereference_parts(n)
+                if parts is not None:
+                    out.append(parts)
+                    continue
+            if isinstance(n, t.Identifier):
+                out.append((n.value,))
+                continue
+            if isinstance(n, (t.SubqueryExpression, t.ExistsPredicate)):
+                continue
+            stack.extend(_ast_children(n))
+        return out
 
     def _exists_subquery(self, query: t.Query, negate: bool) -> RowExpression:
         spec = query.body

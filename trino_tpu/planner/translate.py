@@ -41,14 +41,19 @@ class Scope:
 
     def try_resolve(self, parts: Tuple[str, ...]
                     ) -> Optional[Tuple[int, Field]]:
-        """(scope_level, field); level 0 = this scope, 1+ = outer scopes."""
+        """(scope_level, field); level 0 = this scope, 1+ = outer scopes.
+        Identifier matching is case-INSENSITIVE (Trino semantics: a
+        quoted \"YEAR\" alias resolves for an unquoted `year` lookup)."""
+        def eq(a, b):
+            return a is not None and b is not None and \
+                a.casefold() == b.casefold()
         if len(parts) == 1:
             name = parts[0]
-            matches = [f for f in self.fields if f.name == name]
+            matches = [f for f in self.fields if eq(f.name, name)]
         else:
             qualifier, name = parts[-2], parts[-1]
             matches = [f for f in self.fields
-                       if f.name == name and f.qualifier == qualifier]
+                       if eq(f.name, name) and eq(f.qualifier, qualifier)]
         if len(matches) > 1:
             raise SemanticError(f"column '{'.'.join(parts)}' is ambiguous")
         if matches:
@@ -85,6 +90,29 @@ def cast_to(expr: RowExpression, target: T.Type) -> RowExpression:
         delta = target.scale - expr.type.scale
         if delta >= 0:
             return Literal(expr.value * 10 ** delta, target)
+    # fold literal string casts at plan time: CAST('1999-02-22' AS DATE)
+    # (+ numeric variants) is the TPC-DS date-arithmetic idiom
+    if isinstance(expr, Literal) and T.is_string(expr.type) and \
+            expr.value is not None:
+        s = str(expr.value).strip()
+        try:
+            if isinstance(target, T.DateType):
+                return Literal(_parse_date(s), target)
+            if isinstance(target, T.TimestampType):
+                return Literal(_parse_timestamp(s), target)
+            if T.is_integral(target):
+                return Literal(int(s), target)
+            if isinstance(target, (T.DoubleType, T.RealType)):
+                return Literal(float(s), target)
+            if isinstance(target, T.DecimalType):
+                import decimal as _dec
+                q = _dec.Decimal(s).scaleb(target.scale)
+                return Literal(
+                    int(q.to_integral_value(rounding=_dec.ROUND_HALF_UP)),
+                    target)
+        except (ValueError, ArithmeticError) as e:
+            raise SemanticError(f"cannot cast '{s}' to "
+                                f"{target.display()}: {e}")
     return Call("cast", (expr,), target)
 
 
@@ -145,12 +173,13 @@ class ExpressionTranslator:
                  substitutions: Optional[Dict[RowExpression, Symbol]] = None,
                  subquery_handler: Optional[Callable] = None,
                  on_outer_reference: Optional[Callable] = None,
-                 session=None):
+                 session=None, grouping_handler: Optional[Callable] = None):
         self.scope = scope
         self.substitutions = substitutions or {}
         self.subquery_handler = subquery_handler
         self.on_outer_reference = on_outer_reference
         self.session = session
+        self.grouping_handler = grouping_handler
 
     def _sub(self, expr: RowExpression) -> RowExpression:
         sym = self.substitutions.get(expr)
@@ -356,6 +385,13 @@ class ExpressionTranslator:
 
     def _function_call(self, node: t.FunctionCall) -> RowExpression:
         name = node.name.suffix.lower()
+        if name == "grouping":
+            # decoded from the GroupId set index (GroupingOperationRewriter
+            # analog); only meaningful above ROLLUP/CUBE/GROUPING SETS
+            if self.grouping_handler is None:
+                raise SemanticError(
+                    "grouping() outside a grouping-sets aggregation")
+            return self.grouping_handler(self, node)
         if is_aggregate(name) or is_window(name):
             # aggregates/windows must have been planned already; look up the
             # translated form in substitutions

@@ -1,34 +1,36 @@
 """HTTP /v1/statement server over a query runner.
 
 Reference parity: server/protocol/ExecutingStatementResource.java +
-dispatcher/QueuedStatementResource.java:95 — POST /v1/statement submits SQL,
-the client then follows `nextUri` (GET) until the response carries no
-`nextUri`; DELETE on the page URI cancels. Session state travels in
-X-Trino-* headers both ways (Set-Session / Clear-Session on SET/RESET),
-keeping the server stateless across requests the way the reference's
-dispatcher is.
+dispatcher/QueuedStatementResource.java:95 + DispatchManager.java:140 —
+POST /v1/statement submits SQL, the client then follows `nextUri` (GET)
+until the response carries no `nextUri`; DELETE on the page URI cancels.
+Session state travels in X-Trino-* headers both ways (Set-Session /
+Clear-Session on SET/RESET), keeping the server stateless across requests
+the way the reference's dispatcher is.
 
-TPU-first simplification: the engine executes synchronously on one device
-(or mesh), so the POST runs the query to completion and `nextUri` pages the
-buffered result in fixed-size chunks — the protocol surface (what the stock
-CLI sees) is identical, while the scheduler/dispatcher queue machinery the
-reference needs for its async fan-out is collapsed into the runner call.
-
-Serving is stdlib ThreadingHTTPServer; engine calls serialize on a lock
-(single-controller JAX process — concurrency comes from the mesh, not
-threads).
+Dispatch model (round 5): queries QUEUE (FIFO) and ONE dedicated executor
+thread drains them — the single-controller JAX process can only run one
+device program at a time, so max_running=1 is the honest resource-group
+shape — while HTTP threads page any FINISHED query's buffered results
+concurrently. A long-running query therefore never blocks another
+client's result paging, and a GET on a still-queued/running query returns
+its state with the same nextUri (the polling contract the stock CLI
+implements). Admission control: the queue is bounded
+(`max_queued_queries`) and an over-limit submit fails with
+QUERY_QUEUE_FULL, the InternalResourceGroup.canQueueMore analog.
 """
 
 from __future__ import annotations
 
 import itertools
 import json
+import queue as queue_mod
 import re
 import threading
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 from trino_tpu.exec.runner import MaterializedResult
 from trino_tpu.server import protocol
@@ -42,9 +44,12 @@ _RESET_SESSION = re.compile(r"^\s*reset\s+session\s+(\w+)\s*$",
 
 
 class _Query:
-    def __init__(self, query_id: str, slug: str):
+    def __init__(self, query_id: str, slug: str, sql: str, headers: dict):
         self.query_id = query_id
         self.slug = slug
+        self.sql = sql
+        self.headers = headers
+        self.state = "QUEUED"
         self.result: Optional[MaterializedResult] = None
         self.error: Optional[dict] = None
         self.update_type: Optional[str] = None
@@ -61,14 +66,17 @@ class _Query:
 class TrinoServer:
     """Wire-compatible statement server wrapping a query runner."""
 
-    def __init__(self, runner, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, runner, host: str = "127.0.0.1", port: int = 0,
+                 max_queued: int = 200):
         self.runner = runner
-        self._lock = threading.Lock()
         self._queries: Dict[str, _Query] = {}
         self._seq = itertools.count(1)
+        self._queue: "queue_mod.Queue[Optional[_Query]]" = \
+            queue_mod.Queue(maxsize=max_queued)
         handler = self._make_handler()
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None
+        self._executor: Optional[threading.Thread] = None
 
     # ---------------------------------------------------------- lifecycle
 
@@ -82,6 +90,8 @@ class TrinoServer:
         return f"http://{host}:{port}"
 
     def start(self) -> "TrinoServer":
+        self._executor = threading.Thread(target=self._drain, daemon=True)
+        self._executor.start()
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -90,68 +100,104 @@ class TrinoServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        self._queue.put(None)          # executor shutdown sentinel
+        if self._executor:
+            self._executor.join(timeout=10)
         if self._thread:
             self._thread.join(timeout=5)
 
     # ---------------------------------------------------------- execution
 
     def _submit(self, sql: str, headers) -> _Query:
+        """Admit + enqueue (DispatchManager.createQuery analog): returns
+        immediately with the QUEUED query; the executor thread runs it."""
         day = time.strftime("%Y%m%d")
         qid = f"{day}_{next(self._seq):06d}_{uuid.uuid4().hex[:5]}"
-        q = _Query(qid, uuid.uuid4().hex[:12])
+        # lower-cased snapshot: header lookup must stay case-insensitive
+        # after leaving the email.Message (HTTP header names are)
+        q = _Query(qid, uuid.uuid4().hex[:12], sql,
+                   {k.lower(): v for k, v in headers.items()})
         self._queries[qid] = q
-        session = self.runner.session
-        with self._lock:
-            saved = (session.catalog, session.schema)
-            # snapshot ALL properties: restoring only header-derived keys
-            # would leak one client's SET SESSION into every other client
-            # (the protocol is stateless — the X-Trino-Set-Session response
-            # header hands the state back to THIS client, which re-sends it
-            # via X-Trino-Session on its next request)
-            saved_props = dict(session.properties)
+        try:
+            self._queue.put_nowait(q)
+        except queue_mod.Full:
+            q.state = "FAILED"
+            q.error = protocol.error_json(
+                "Too many queued queries", error_name="QUERY_QUEUE_FULL")
+        return q
+
+    def _drain(self) -> None:
+        """Executor loop: one query at a time against the single-controller
+        runner; paging of finished queries proceeds on HTTP threads."""
+        while True:
+            q = self._queue.get()
+            if q is None:
+                return
+            if q.cancelled:
+                q.state = "CANCELED"
+                continue
+            q.state = "RUNNING"
             try:
-                catalog = headers.get("X-Trino-Catalog")
-                schema = headers.get("X-Trino-Schema")
-                if catalog:
-                    session.catalog = catalog
-                if schema:
-                    session.schema = schema
-                overrides = {}
-                props_header = headers.get("X-Trino-Session", "")
-                # reference wire format (ProtocolHeaders/StatementClientV1):
-                # comma-separated key=value pairs, values URL-encoded (so
-                # raw commas never appear inside a value)
-                from urllib.parse import unquote
-                for part in props_header.split(","):
-                    if "=" in part:
-                        k, _, v = part.partition("=")
-                        overrides[k.strip()] = unquote(v.strip())
-                for k, v in overrides.items():
-                    try:
-                        session.set(k, v)
-                    except Exception:
-                        pass
-                try:
-                    q.result = self.runner.execute(sql)
-                finally:
-                    session.properties.clear()
-                    session.properties.update(saved_props)
-                m = _SET_SESSION.match(sql)
-                if m:
-                    q.update_type = "SET SESSION"
-                    q.set_session = (m.group(1),
-                                     m.group(2).strip().strip("'"))
-                m = _RESET_SESSION.match(sql)
-                if m:
-                    q.update_type = "RESET SESSION"
-                    q.clear_session = m.group(1)
-            except Exception as e:  # surface as QueryError, not HTTP 500
+                self._execute(q)
+                q.state = "FAILED" if q.error is not None else "FINISHED"
+            except BaseException as e:  # noqa: BLE001 — keep draining
                 q.error = protocol.error_json(
                     f"{type(e).__name__}: {e}",
                     error_name=type(e).__name__.upper())
+                q.state = "FAILED"
+
+    def _execute(self, q: _Query) -> None:
+        headers = q.headers
+        session = self.runner.session
+        saved = (session.catalog, session.schema)
+        # snapshot ALL properties: restoring only header-derived keys
+        # would leak one client's SET SESSION into every other client
+        # (the protocol is stateless — the X-Trino-Set-Session response
+        # header hands the state back to THIS client, which re-sends it
+        # via X-Trino-Session on its next request)
+        saved_props = dict(session.properties)
+        try:
+            catalog = headers.get("x-trino-catalog")
+            schema = headers.get("x-trino-schema")
+            if catalog:
+                session.catalog = catalog
+            if schema:
+                session.schema = schema
+            overrides = {}
+            props_header = headers.get("x-trino-session", "")
+            # reference wire format (ProtocolHeaders/StatementClientV1):
+            # comma-separated key=value pairs, values URL-encoded (so
+            # raw commas never appear inside a value)
+            from urllib.parse import unquote
+            for part in props_header.split(","):
+                if "=" in part:
+                    k, _, v = part.partition("=")
+                    overrides[k.strip()] = unquote(v.strip())
+            for k, v in overrides.items():
+                try:
+                    session.set(k, v)
+                except Exception:
+                    pass
+            try:
+                q.result = self.runner.execute(q.sql)
             finally:
-                session.catalog, session.schema = saved
-        return q
+                session.properties.clear()
+                session.properties.update(saved_props)
+            m = _SET_SESSION.match(q.sql)
+            if m:
+                q.update_type = "SET SESSION"
+                q.set_session = (m.group(1),
+                                 m.group(2).strip().strip("'"))
+            m = _RESET_SESSION.match(q.sql)
+            if m:
+                q.update_type = "RESET SESSION"
+                q.clear_session = m.group(1)
+        except Exception as e:  # surface as QueryError, not HTTP 500
+            q.error = protocol.error_json(
+                f"{type(e).__name__}: {e}",
+                error_name=type(e).__name__.upper())
+        finally:
+            session.catalog, session.schema = saved
 
     # ------------------------------------------------------------ paging
 
@@ -170,8 +216,13 @@ class TrinoServer:
                 error=protocol.error_json("Query was canceled",
                                           "USER_CANCELED"),
                 elapsed_ms=q.elapsed_ms)
+        if q.result is None:
+            # still queued/running: same token again (client poll loop)
+            return protocol.query_results(
+                q.query_id, self.base_uri,
+                next_uri=self._page_uri(q, token), state=q.state,
+                elapsed_ms=q.elapsed_ms)
         res = q.result
-        assert res is not None
         cols = protocol.columns_json(res.column_names, res.column_types)
         lo, hi = token * PAGE_ROWS, (token + 1) * PAGE_ROWS
         chunk = res.rows[lo:hi]

@@ -613,10 +613,21 @@ class _Parser:
 
     def relation_primary(self) -> t.Relation:
         if self.accept_op("("):
-            if self.at_keyword("SELECT", "WITH", "VALUES") or self.at_op("("):
+            if self.at_keyword("SELECT", "WITH", "VALUES"):
                 query = self.query()
                 self.expect_op(")")
                 return t.TableSubquery(query)
+            if self.at_op("("):
+                # ambiguous: "((" opens either a nested subquery or a
+                # parenthesized join tree (TPC-DS q72-style
+                # "((((t JOIN ...) JOIN ...)"); try query, backtrack
+                save = self.pos
+                try:
+                    query = self.query()
+                    self.expect_op(")")
+                    return t.TableSubquery(query)
+                except ParsingError:
+                    self.pos = save
             rel = self.relation()
             self.expect_op(")")
             return rel
@@ -866,6 +877,9 @@ class _Parser:
         if self.at_keyword("DATE") and self.peek(1).kind == "STRING":
             self.next()
             return t.DateLiteral(self.next().text)
+        if self.at_keyword("DECIMAL") and self.peek(1).kind == "STRING":
+            self.next()
+            return t.DecimalLiteral(self.next().text)
         if self.at_keyword("TIMESTAMP") and self.peek(1).kind == "STRING":
             self.next()
             return t.TimestampLiteral(self.next().text)

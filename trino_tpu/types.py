@@ -307,9 +307,10 @@ def common_super_type(a: Type, b: Type) -> Optional[Type]:
         if isinstance(a, DecimalType) and isinstance(b, DecimalType):
             scale = max(a.scale, b.scale)
             intd = max(a.precision - a.scale, b.precision - b.scale)
-            # precision>18 would need long decimals; DecimalType raises there,
-            # which is more honest than silently narrowing
-            return DecimalType(precision=intd + scale, scale=scale)
+            # clamp at the short-decimal limit (same Java-long-overflow
+            # acceptance as sql/analyzer.arithmetic_type; a long-decimal
+            # two-limb path would lift this)
+            return DecimalType(precision=min(intd + scale, 18), scale=scale)
         if isinstance(a, DecimalType) or isinstance(b, DecimalType):
             dec = a if isinstance(a, DecimalType) else b
             other = b if isinstance(a, DecimalType) else a
@@ -358,7 +359,11 @@ def parse_type(text: str) -> Type:
         parts = [p.strip() for p in inner.split(",")]
         prec = int(parts[0])
         scale = int(parts[1]) if len(parts) > 1 else 0
-        return DecimalType(precision=prec, scale=scale)
+        # declared long decimals (p>18, e.g. TPC-DS CAST(.. AS
+        # DECIMAL(38,3))) clamp to the short-decimal limit — the same
+        # Java-long-overflow acceptance as arithmetic_type/common_type
+        prec = min(prec, 18)
+        return DecimalType(precision=prec, scale=min(scale, prec))
     if s == "char":
         return CharType(length=1)
     if s.startswith("varchar("):
