@@ -1029,8 +1029,9 @@ def _ss_col(sf, col, idx, c):
     if col == "ss_ext_tax":
         return sp * qty * _hui(t, "ss_tax", sf, idx, 0, 11) // 100
     if col == "ss_net_paid_inc_tax":
-        return _ss_col(sf, "ss_net_paid", idx, c) \
-            + _ss_col(sf, "ss_ext_tax", idx, c)
+        # locals qty/sp are already computed once per call — no recursion
+        return sp * qty + sp * qty * _hui(t, "ss_tax", sf, idx,
+                                          0, 11) // 100
     if col == "ss_net_profit":
         return (sp - wholesale) * qty
     raise KeyError(col)
@@ -1154,17 +1155,25 @@ def _sr_col(sf, col, idx, c):
         return _hui(t, col, sf, idx, 1, c["reason"])
     if col == "sr_return_quantity":
         return _hui(t, col, sf, idx, 1, 49)
-    if col == "sr_return_amt":
+
+    def amount():
+        # shared intermediate computed ONCE per (col, chunk) — see
+        # _returnish_col's note on avoiding recursive re-derivation
         qty = _ss_col(sf, "ss_quantity", r, c)
         mult = 1 + (_hu64(t, "sr_amt", sf, idx)
                     % qty.astype(np.uint64)).astype(np.int64)
         return _ss_col(sf, "ss_sales_price", r, c) * mult
+
+    def tax_of(amt):
+        return amt * _hui(t, "sr_taxpct", sf, idx, 0, 11) // 100
+
+    if col == "sr_return_amt":
+        return amount()
     if col == "sr_return_tax":
-        return _sr_col(sf, "sr_return_amt", idx, c) \
-            * _hui(t, "sr_taxpct", sf, idx, 0, 11) // 100
+        return tax_of(amount())
     if col == "sr_return_amt_inc_tax":
-        return _sr_col(sf, "sr_return_amt", idx, c) \
-            + _sr_col(sf, "sr_return_tax", idx, c)
+        amt = amount()
+        return amt + tax_of(amt)
     if col == "sr_fee":
         return _hui(t, col, sf, idx, 50, 10000)
     if col == "sr_return_ship_cost":
@@ -1172,7 +1181,7 @@ def _sr_col(sf, col, idx, c):
     if col in ("sr_refunded_cash", "sr_reversed_charge",
                "sr_store_credit"):
         # three-way split of the returned amount
-        amt = _sr_col(sf, "sr_return_amt", idx, c)
+        amt = amount()
         cash = amt * _hui(t, "sr_cashpct", sf, idx, 0, 100) // 100
         rest = amt - cash
         charge = rest * _hui(t, "sr_chargepct", sf, idx, 0, 100) // 100
@@ -1182,8 +1191,7 @@ def _sr_col(sf, col, idx, c):
             return charge
         return rest - charge
     if col == "sr_net_loss":
-        return _sr_col(sf, "sr_return_amt", idx, c) // 2 \
-            + _sr_col(sf, "sr_fee", idx, c)
+        return amount() // 2 + _hui(t, "sr_fee", sf, idx, 50, 10000)
     mapping = {"sr_item_sk": "ss_item_sk", "sr_customer_sk":
                "ss_customer_sk", "sr_cdemo_sk": "ss_cdemo_sk",
                "sr_hdemo_sk": "ss_hdemo_sk", "sr_addr_sk": "ss_addr_sk",
@@ -1209,26 +1217,31 @@ def _returnish_col(t, p, sale_col, sp, sf, col, idx, c, extra):
     if col == f"{p}_return_quantity":
         return _hui(t, col, sf, idx, 1, 49)
     amount_col = f"{p}_return_amount" if p == "cr" else f"{p}_return_amt"
-    if col == amount_col:
+
+    def amount():
+        # shared intermediate computed ONCE per (col, chunk) — recursing
+        # through _returnish_col re-derived the whole sale-price hash
+        # chain per reference (2-3x waste on SF100 chunk scans)
         return sale_col(sf, f"{sp}_sales_price", r, c) \
             * _hui(t, f"{p}_amt", sf, idx, 1, 19)
+
+    def tax_of(amt):
+        return amt * _hui(t, f"{p}_taxpct", sf, idx, 0, 11) // 100
+
+    if col == amount_col:
+        return amount()
     if col == f"{p}_return_tax":
-        return _returnish_col(t, p, sale_col, sp, sf, amount_col, idx, c,
-                              extra) * _hui(t, f"{p}_taxpct", sf, idx,
-                                            0, 11) // 100
+        return tax_of(amount())
     if col == f"{p}_return_amt_inc_tax":
-        return _returnish_col(t, p, sale_col, sp, sf, amount_col, idx, c,
-                              extra) \
-            + _returnish_col(t, p, sale_col, sp, sf, f"{p}_return_tax",
-                             idx, c, extra)
+        amt = amount()
+        return amt + tax_of(amt)
     if col == f"{p}_fee":
         return _hui(t, col, sf, idx, 50, 10000)
     if col == f"{p}_return_ship_cost":
         return _hui(t, col, sf, idx, 0, 10000)
     credit_col = f"{p}_store_credit" if p == "cr" else f"{p}_account_credit"
     if col in (f"{p}_refunded_cash", f"{p}_reversed_charge", credit_col):
-        amt = _returnish_col(t, p, sale_col, sp, sf, amount_col, idx, c,
-                             extra)
+        amt = amount()
         cash = amt * _hui(t, f"{p}_cashpct", sf, idx, 0, 100) // 100
         rest = amt - cash
         charge = rest * _hui(t, f"{p}_chargepct", sf, idx, 0, 100) // 100
@@ -1238,10 +1251,7 @@ def _returnish_col(t, p, sale_col, sp, sf, col, idx, c, extra):
             return charge
         return rest - charge
     if col == f"{p}_net_loss":
-        return _returnish_col(t, p, sale_col, sp, sf, amount_col, idx, c,
-                              extra) // 2 \
-            + _returnish_col(t, p, sale_col, sp, sf, f"{p}_fee", idx, c,
-                             extra)
+        return amount() // 2 + _hui(t, f"{p}_fee", sf, idx, 50, 10000)
     refunded = {
         f"{p}_refunded_customer_sk": f"{sp}_bill_customer_sk",
         f"{p}_refunded_cdemo_sk": f"{sp}_bill_cdemo_sk",

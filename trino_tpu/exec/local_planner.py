@@ -483,17 +483,19 @@ class LocalExecutionPlanner:
 
         key_channels_t = tuple(key_channels)
         specs_t = tuple(specs)
-        from trino_tpu.ops.aggregate import SINGLE_STEP_AGGREGATES
+        from trino_tpu.ops.aggregate import (COLLECT_AGGREGATES,
+                                             SINGLE_STEP_AGGREGATES,
+                                             group_max_size)
         if any(s.distinct or s.name in SINGLE_STEP_AGGREGATES
                for s in specs):
             # DISTINCT needs every row of a group in one kernel call
             # (distinctness is a property of the whole group, not a page),
             # so collect and run one SINGLE-step aggregation — the
             # MarkDistinct + filtered-agg plan collapsed into the sort-based
-            # kernel (ops/aggregate.py:_distinct_first_mask).
-            single_op = cached_kernel(
-                ("agg-single", key_channels_t, specs_t),
-                lambda: hash_aggregate(key_channels, specs, Step.SINGLE))
+            # kernel (ops/aggregate.py:_distinct_first_mask). Collect
+            # aggregates (array_agg/histogram/map_agg) additionally size
+            # their list layout with a max-group-size pre-pass.
+            needs_len = any(s.name in COLLECT_AGGREGATES for s in specs)
 
             def gen_distinct():
                 page = self._collect(src)
@@ -501,6 +503,19 @@ class LocalExecutionPlanner:
                     if not key_channels:
                         yield self._empty_global_agg(node, specs)
                     return
+                L = None
+                if needs_len:
+                    szop = cached_kernel(
+                        ("agg-groupmax", key_channels_t),
+                        lambda: group_max_size(key_channels))
+                    got = max(int(jax.device_get(szop(page))), 1)
+                    # small pow2 (not the 1024-floor page helper): the
+                    # element plane is [capacity, L]
+                    L = 1 << (got - 1).bit_length() if got > 1 else 1
+                single_op = cached_kernel(
+                    ("agg-single", key_channels_t, specs_t, L),
+                    lambda: hash_aggregate(key_channels, specs,
+                                           Step.SINGLE, list_len=L))
                 try:
                     yield single_op(page)
                 finally:
@@ -1427,6 +1442,78 @@ class LocalExecutionPlanner:
             finally:
                 self._free_collected(build_page)
         return PageStream(gen(), out_symbols)
+
+    def _exec_UnnestNode(self, node) -> PageStream:
+        """UNNEST expansion (operator/unnest/UnnestOperator.java, static-
+        shape cut): per page, element counts -> cumsum offsets -> one
+        searchsorted maps output slots to source rows; elements gather
+        from the [capacity, L] plane, replicated columns gather at the
+        source row. Output capacity sizes from a per-page count fetch."""
+        src = self.execute(node.source)
+        lay, _ = _layout(src.symbols)
+        arr_ch = lay[node.arrays[0].name]
+        is_map = len(node.elements[0]) == 2
+        with_ord = node.ordinality is not None
+
+        def count_op_build():
+            def run(page: Page):
+                c = page.column(arr_ch)
+                live = page.row_mask() & c.valid_mask()
+                lens = jnp.where(live, c.lengths, 0)
+                return jnp.sum(lens).astype(jnp.int64)
+            return run
+        count_op = cached_kernel(("unnest-count", arr_ch), count_op_build)
+
+        def expand_op(cap: int):
+            def build():
+                def run(page: Page):
+                    c = page.column(arr_ch)
+                    n = page.capacity
+                    L = c.values.shape[1]
+                    live = page.row_mask() & c.valid_mask()
+                    lens = jnp.where(live, c.lengths, 0).astype(jnp.int64)
+                    offsets = jnp.cumsum(lens)
+                    starts = offsets - lens
+                    total = offsets[-1]
+                    out_idx = jnp.arange(cap, dtype=jnp.int64)
+                    prow = jnp.searchsorted(
+                        offsets, out_idx, side="right").astype(jnp.int32)
+                    prow_c = jnp.minimum(prow, n - 1)
+                    within = (out_idx - jnp.take(starts, prow_c,
+                                                 mode="clip")
+                              ).astype(jnp.int32)
+                    within_c = jnp.clip(within, 0, max(L - 1, 0))
+                    cols = [col.gather(prow_c) for col in page.columns]
+                    plane = jnp.take(c.values, prow_c, axis=0,
+                                     mode="clip")
+                    elem = jnp.take_along_axis(
+                        plane, within_c[:, None], axis=1)[:, 0]
+                    el_types = node.elements[0]
+                    cols.append(Column(elem, None, el_types[0].type,
+                                       c.dictionary))
+                    if is_map:
+                        aplane = jnp.take(c.aux, prow_c, axis=0,
+                                          mode="clip")
+                        aval = jnp.take_along_axis(
+                            aplane, within_c[:, None], axis=1)[:, 0]
+                        cols.append(Column(aval, None, el_types[1].type,
+                                           c.aux_dictionary))
+                    if with_ord:
+                        cols.append(Column(within.astype(jnp.int64) + 1,
+                                           None, T.BIGINT, None))
+                    rows = jnp.minimum(total, cap).astype(jnp.int32)
+                    return Page(tuple(cols), rows)
+                return run
+            return cached_kernel(
+                ("unnest", arr_ch, cap, is_map, with_ord), build)
+
+        def gen():
+            for page in src.iter_pages():
+                total = int(jax.device_get(count_op(page)))
+                if total == 0:
+                    continue
+                yield expand_op(_next_pow2(total))(page)
+        return PageStream(gen(), node.outputs)
 
     def _exec_AssignUniqueIdNode(self, node) -> PageStream:
         """AssignUniqueIdOperator: tag rows with a stable unique id.

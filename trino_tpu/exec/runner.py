@@ -50,6 +50,11 @@ class MaterializedResult:
 def _to_python(value, typ: T.Type):
     if value is None:
         return None
+    if isinstance(typ, T.ArrayType):
+        return [_to_python(v, typ.element) for v in value]
+    if isinstance(typ, T.MapType):
+        return {_to_python(k, typ.key): _to_python(v, typ.value)
+                for k, v in value.items()}
     if isinstance(typ, T.DecimalType):
         return decimal.Decimal(int(value)).scaleb(-typ.scale)
     if isinstance(typ, T.DateType):

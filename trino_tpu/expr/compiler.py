@@ -97,6 +97,9 @@ def _eval_call(expr: Call, page: Page) -> Column:
         return _date_unit_call(expr, page)
     if name == "try_cast":
         return _try_cast(expr, page)
+    if name in ("array_ctor", "cardinality", "element_at",
+                "map_element_at", "contains"):
+        return _array_call(expr, page)
     # --- generic null-propagating scalar ----------------------------------
     impl = F.lookup(name)
     args = [_eval(a, page) for a in expr.args]
@@ -445,6 +448,83 @@ def _numeric_cast_ok(values: jnp.ndarray, src_t, target
         v = values.astype(jnp.int64)
         return _int_range_ok(v, -(bound - 1), bound - 1)
     return None   # float/bool/date targets: saturation matches Trino
+
+
+def _array_call(expr: Call, page: Page) -> Column:
+    """ARRAY scalar surface over the list layout (values [cap, L] +
+    lengths; spi/block/ArrayBlock re-cut for static shapes). Element
+    NULLs are not represented (documented deviation)."""
+    name = expr.name
+    cap = page.capacity
+    if name == "array_ctor":
+        args = [_broadcast(_eval(a, page), cap) for a in expr.args]
+        dicts = [a.dictionary for a in args if a.dictionary is not None]
+        dictionary = None
+        if dicts:
+            uniq = {id(d): d for d in dicts}
+            if len(uniq) == 1:
+                dictionary = dicts[0]
+            else:
+                from trino_tpu.page import union_dictionaries
+                dictionary, tables = union_dictionaries(
+                    list(uniq.values()))
+                remap = dict(zip(uniq, tables))
+                args = [
+                    Column(jnp.take(remap[id(a.dictionary)],
+                                    jnp.clip(a.values, 0), mode="clip"),
+                           a.valid, a.type, dictionary)
+                    if a.dictionary is not None else a
+                    for a in args]
+        elem_dt = expr.type.element.dtype
+        values = jnp.stack(
+            [a.values.astype(elem_dt) for a in args], axis=1)
+        lengths = jnp.full(cap, len(args), dtype=jnp.int32)
+        valid = None
+        for a in args:
+            valid = _vand(valid, a.valid)
+        return Column(values, valid, expr.type, dictionary,
+                      lengths=lengths)
+    arr = _eval(expr.args[0], page)
+    if arr.lengths is None:
+        raise NotImplementedError(f"{name} over a non-list column")
+    L = arr.values.shape[1]
+    iota = jnp.arange(L, dtype=jnp.int32)[None, :]
+    in_len = iota < arr.lengths[:, None]
+    if name == "cardinality":
+        return Column(arr.lengths.astype(jnp.int64), arr.valid,
+                      expr.type, None)
+    if name == "element_at":
+        i = _broadcast(_eval(expr.args[1], page), cap)
+        iv = i.values.astype(jnp.int32)
+        idx = jnp.where(iv < 0, arr.lengths + iv, iv - 1)
+        inb = (iv != 0) & (idx >= 0) & (idx < arr.lengths)
+        vals = jnp.take_along_axis(
+            arr.values, jnp.clip(idx, 0, max(L - 1, 0))[:, None],
+            axis=1)[:, 0]
+        valid = _vand(_vand(arr.valid, i.valid), inb)
+        return Column(vals, valid, expr.type, arr.dictionary)
+    if name in ("contains", "map_element_at"):
+        x = _broadcast(_eval(expr.args[1], page), cap)
+        xv = x.values
+        if arr.dictionary is not None:
+            if x.dictionary is arr.dictionary:
+                pass
+            elif isinstance(expr.args[1], Literal):
+                code = arr.dictionary.code_of(expr.args[1].value)
+                xv = jnp.full(cap, code, dtype=arr.values.dtype)
+            else:
+                raise NotImplementedError(
+                    "array membership across distinct dictionaries")
+        match = (arr.values == xv[:, None]) & in_len
+        if name == "contains":
+            return Column(jnp.any(match, axis=1),
+                          _vand(arr.valid, x.valid), expr.type, None)
+        found = jnp.any(match, axis=1)
+        idx = jnp.argmax(match, axis=1)
+        vals = jnp.take_along_axis(arr.aux, idx[:, None], axis=1)[:, 0]
+        valid = _vand(_vand(arr.valid, x.valid), found)
+        return Column(vals, valid, expr.type, arr.aux_dictionary)
+    raise TypeError(name)
 
 
 def _py_parser_for(target):

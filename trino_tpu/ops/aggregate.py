@@ -391,9 +391,14 @@ CENTERED_AGGREGATES = frozenset({
 # rows must be colocated in one kernel call
 SKETCH_AGGREGATES = frozenset({"approx_distinct", "approx_percentile"})
 
+# collectors packing group elements into the list layout (ArrayBlock /
+# MapBlock output) — single-step, and the executor pre-computes the
+# static element capacity (list_len) from the collected page
+COLLECT_AGGREGATES = frozenset({"array_agg", "histogram", "map_agg"})
+
 # aggregates that must see every row of a group in ONE kernel invocation
 SINGLE_STEP_AGGREGATES = (POSITIONAL_AGGREGATES | CENTERED_AGGREGATES
-                          | SKETCH_AGGREGATES)
+                          | SKETCH_AGGREGATES | COLLECT_AGGREGATES)
 
 
 def get_aggregate(name: str, in_type: Optional[T.Type]) -> AggregateFunction:
@@ -425,6 +430,16 @@ def get_aggregate(name: str, in_type: Optional[T.Type]) -> AggregateFunction:
         return AggregateFunction(n, lambda t: (), None, lambda t: tx)
     if n == "approx_distinct":
         return AggregateFunction(n, lambda t: (), None, lambda t: T.BIGINT)
+    if n == "array_agg":
+        return AggregateFunction(n, lambda t: (), None,
+                                 lambda t: T.ArrayType(element=tx))
+    if n == "histogram":
+        return AggregateFunction(
+            n, lambda t: (), None,
+            lambda t: T.MapType(key=tx, value=T.BIGINT))
+    if n == "map_agg":
+        return AggregateFunction(
+            n, lambda t: (), None, lambda t: T.MapType(key=tx, value=ty))
     if n == "approx_percentile":
         return AggregateFunction(n, lambda t: (), None, lambda t: tx)
     if n == "checksum":
@@ -507,6 +522,7 @@ def hash_aggregate(
     aggs: Sequence[AggSpec],
     step: str = Step.SINGLE,
     partial_state_channels: Optional[Sequence[Sequence[int]]] = None,
+    list_len: Optional[int] = None,
 ) -> Callable[[Page], Page]:
     """Build a group-by aggregation operator.
 
@@ -541,12 +557,18 @@ def hash_aggregate(
                               else (a.input_type, a.input2_type))
                 for a in aggs]
 
+    has_collect = any(a.name in COLLECT_AGGREGATES for a in aggs)
+
     def op(page: Page) -> Page:
         n = page.capacity
         if not key_channels:
+            if has_collect:
+                raise NotImplementedError(
+                    "global array_agg/histogram/map_agg (no GROUP BY)")
             return _global_aggregate(page, aggs, resolved, step,
                                      partial_state_channels)
-        sizes = _direct_key_sizes(page, key_channels, aggs)
+        sizes = None if has_collect else \
+            _direct_key_sizes(page, key_channels, aggs)
         if sizes is not None:
             return _direct_aggregate(page, key_channels, aggs, resolved,
                                      step, partial_state_channels, sizes)
@@ -574,7 +596,7 @@ def hash_aggregate(
 
         agg_cols = _accumulate(page, aggs, resolved, step,
                                partial_state_channels, perm_sorted, seg, n,
-                               key_channels)
+                               key_channels, list_len)
         out_cols.extend(agg_cols)
         return Page(tuple(out_cols), num_groups)
 
@@ -822,7 +844,8 @@ def _distinct_first_mask(page: Page, key_channels: Sequence[int],
 
 
 def _accumulate(page, aggs, resolved, step, partial_state_channels,
-                perm_sorted, seg, n, key_channels=()) -> List[Column]:
+                perm_sorted, seg, n, key_channels=(),
+                list_len=None) -> List[Column]:
     """Per-agg state accumulation + (for FINAL/SINGLE) final projection."""
     out: List[Column] = []
     dmask_cache: dict = {}
@@ -856,6 +879,9 @@ def _accumulate(page, aggs, resolved, step, partial_state_channels,
             values, valid = fn.final(merged, None)
             out.append(_agg_out_column(fn, spec, values, valid,
                                        page.column(chans[0]).dictionary))
+        elif spec.name in COLLECT_AGGREGATES:
+            out.append(_collect_grouped(page, spec, fn, perm_sorted, seg,
+                                        n, list_len))
         elif spec.name == "approx_distinct":
             out.append(_hll_grouped(page, spec, key_channels))
         elif spec.name == "approx_percentile":
@@ -885,6 +911,103 @@ def _accumulate(page, aggs, resolved, step, partial_state_channels,
                 values, valid = fn.final(state_arrays, None)
                 out.append(_agg_out_column(fn, spec, values, valid, dictionary))
     return out
+
+
+def group_max_size(key_channels: Sequence[int]):
+    """Max live group size — the executor's sizing pre-pass for collect
+    aggregates (one scalar fetch buys the static element capacity)."""
+    key_channels = tuple(key_channels)
+
+    def op(page: Page):
+        n = page.capacity
+        operands = _sort_key_arrays(page, key_channels)
+        sorted_ops = jax.lax.sort(operands, num_keys=len(operands))
+        live = ~sorted_ops[0]
+        boundary = _boundary_scan(sorted_ops[1:], n) & live
+        seg = jnp.where(live,
+                        jnp.cumsum(boundary.astype(jnp.int32)) - 1, n)
+        counts = jax.ops.segment_sum(live.astype(jnp.int32), seg,
+                                     num_segments=n + 1)[:n]
+        return jnp.max(counts)
+    return op
+
+
+def _collect_grouped(page: Page, spec: "AggSpec", fn, perm_sorted, seg,
+                     n, list_len) -> Column:
+    """array_agg / histogram / map_agg over sorted segments, packing each
+    group's elements into the list layout (values [groups_cap, L] +
+    lengths). L (`list_len`) is the executor-provided static element
+    capacity (max group size fetched from the collected page — the
+    data-dependent-shape escape hatch every blocking collector needs).
+    NULL inputs are skipped (documented deviation from Trino's
+    array_agg, which keeps them)."""
+    if list_len is None:
+        raise ValueError("collect aggregates need list_len")
+    L = int(list_len)
+    out_type = fn.output_type(None)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    vals, mask, dictionary = _agg_inputs(page, spec, fn, seg < n,
+                                         gather=perm_sorted)
+    if spec.input2 is not None:
+        vals, vals2 = vals
+    else:
+        vals2 = None
+    if spec.name == "array_agg":
+        elig = mask
+        excl = jnp.cumsum(elig.astype(jnp.int32)) - elig.astype(jnp.int32)
+        boundary = jnp.concatenate(
+            [jnp.ones(1, jnp.bool_), seg[1:] != seg[:-1]])
+        run_start = jax.lax.cummax(jnp.where(boundary, idx, 0))
+        within = excl - jnp.take(excl, run_start, mode="clip")
+        ok = elig & (within < L)
+        srow = jnp.where(ok, seg, n)
+        plane = jnp.zeros((n, L), dtype=vals.dtype).at[
+            srow, jnp.clip(within, 0, L - 1)].set(vals, mode="drop")
+        lengths = jnp.minimum(
+            jax.ops.segment_sum(elig.astype(jnp.int32), seg,
+                                num_segments=n + 1)[:n], L)
+        return Column(plane, None, out_type, dictionary,
+                      lengths=lengths.astype(jnp.int32))
+    # histogram / map_agg: re-sort by (group segment, key value) so each
+    # distinct key forms a run; pack one entry per run
+    kv = _nan_as_largest(vals)
+    segk = jnp.where(mask, seg, n)
+    seg_s, kv_s, rows_s = jax.lax.sort([segk, kv, idx], num_keys=2)
+    live = seg_s < n
+    gb = jnp.concatenate([jnp.ones(1, jnp.bool_), seg_s[1:] != seg_s[:-1]])
+    pb = (gb | jnp.concatenate(
+        [jnp.ones(1, jnp.bool_), kv_s[1:] != kv_s[:-1]])) & live
+    pair_id = jnp.cumsum(pb.astype(jnp.int32)) - 1
+    pair_of_row = jnp.where(live, pair_id, n)
+    g_start = jax.lax.cummax(jnp.where(gb, idx, 0))
+    ordinal = pair_id - jnp.take(pair_id, g_start, mode="clip")
+    first = pb & (ordinal < L)
+    srow = jnp.where(first, seg_s, n)
+    scol = jnp.clip(ordinal, 0, L - 1)
+    keys_plane = jnp.zeros((n, L), dtype=kv_s.dtype).at[
+        srow, scol].set(kv_s, mode="drop")
+    aux_dict = None
+    if spec.name == "histogram":
+        counts = jax.ops.segment_sum(live.astype(jnp.int64), pair_of_row,
+                                     num_segments=n + 1)[:n]
+        aux_vals = jnp.take(counts, jnp.clip(pair_id, 0, n - 1),
+                            mode="clip")
+        aux_dtype = jnp.int64
+    else:  # map_agg: first value seen for each key wins
+        # vals2 is in the group-sort row order; re-order through the
+        # secondary (group, key) sort's permutation
+        aux_vals = jnp.take(vals2, rows_s, mode="clip")
+        aux_dtype = vals2.dtype
+        if spec.input2 is not None:
+            aux_dict = page.column(spec.input2).dictionary
+    aux_plane = jnp.zeros((n, L), dtype=aux_dtype).at[
+        srow, scol].set(aux_vals.astype(aux_dtype), mode="drop")
+    lengths = jnp.minimum(
+        jax.ops.segment_sum(pb.astype(jnp.int32), seg_s,
+                            num_segments=n + 1)[:n], L)
+    return Column(keys_plane, None, out_type, dictionary,
+                  lengths=lengths.astype(jnp.int32), aux=aux_plane,
+                  aux_dictionary=aux_dict)
 
 
 def _positional_grouped(page: Page, spec: "AggSpec", perm_sorted, seg,

@@ -110,30 +110,51 @@ class Dictionary:
 class Column:
     """One columnar vector. Reference: spi/block/Block.java:25.
 
-    values : device array [capacity] of type.dtype
+    values : device array [capacity] of type.dtype — or, for ARRAY/MAP
+             list layouts, [capacity, max_len] element planes
     valid  : optional bool device array [capacity]; None = no nulls
     type   : SQL Type (static)
     dictionary : for string types, the host string pool (static)
+    lengths: for list layouts, int32 [capacity] live element counts
+    aux    : for MAP, the per-element value plane [capacity, max_len]
+             (keys live in `values` so map lookups search sorted keys)
     """
 
     values: jnp.ndarray
     valid: Optional[jnp.ndarray]
     type: T.Type
     dictionary: Optional[Dictionary] = None
+    lengths: Optional[jnp.ndarray] = None
+    aux: Optional[jnp.ndarray] = None
+    aux_dictionary: Optional[Dictionary] = None
 
     def tree_flatten(self):
-        if self.valid is None:
-            return (self.values,), (False, self.type, self.dictionary)
-        return (self.values, self.valid), (True, self.type, self.dictionary)
+        children = [self.values]
+        flags = [False, False]
+        if self.valid is not None:
+            children.append(self.valid)
+            flags[0] = True
+        extra = 0
+        if self.lengths is not None:
+            children.append(self.lengths)
+            extra = 1
+            if self.aux is not None:
+                children.append(self.aux)
+                extra = 2
+        flags[1] = extra
+        return tuple(children), (flags[0], flags[1], self.type,
+                                 self.dictionary, self.aux_dictionary)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        has_valid, typ, dictionary = aux
-        if has_valid:
-            values, valid = children
-        else:
-            (values,), valid = children, None
-        return cls(values, valid, typ, dictionary)
+        has_valid, extra, typ, dictionary, aux_dict = aux
+        it = iter(children)
+        values = next(it)
+        valid = next(it) if has_valid else None
+        lengths = next(it) if extra >= 1 else None
+        aux_arr = next(it) if extra >= 2 else None
+        return cls(values, valid, typ, dictionary, lengths, aux_arr,
+                   aux_dict)
 
     @property
     def capacity(self) -> int:
@@ -152,22 +173,29 @@ class Column:
         page are garbage copies of a live row. INVARIANT: consumers must mask
         with Page.row_mask() — num_rows, not validity, delimits live rows.
         """
-        values = jnp.take(self.values, indices, mode="clip")
+        values = jnp.take(self.values, indices, axis=0, mode="clip")
         valid = None
         if self.valid is not None:
             valid = jnp.take(self.valid, indices, mode="clip")
-        return Column(values, valid, self.type, self.dictionary)
+        lengths = None if self.lengths is None else \
+            jnp.take(self.lengths, indices, mode="clip")
+        aux = None if self.aux is None else \
+            jnp.take(self.aux, indices, axis=0, mode="clip")
+        return Column(values, valid, self.type, self.dictionary, lengths,
+                      aux, self.aux_dictionary)
 
     def with_valid(self, valid: Optional[jnp.ndarray]) -> "Column":
-        return Column(self.values, valid, self.type, self.dictionary)
+        return Column(self.values, valid, self.type, self.dictionary,
+                      self.lengths, self.aux, self.aux_dictionary)
 
     @property
     def nbytes(self) -> int:
         """Device bytes (values + validity) — the unit of memory accounting
         shared by the HBM pool (exec/memory.py) and scan caches."""
         n = int(getattr(self.values, "nbytes", 0) or 0)
-        if self.valid is not None:
-            n += int(getattr(self.valid, "nbytes", 0) or 0)
+        for a in (self.valid, self.lengths, self.aux):
+            if a is not None:
+                n += int(getattr(a, "nbytes", 0) or 0)
         return n
 
     @classmethod
@@ -257,17 +285,34 @@ class Page:
         if not self.columns:
             return Page((), count)
         payload = []
+        has_list = any(c.lengths is not None for c in self.columns)
         for c in self.columns:
-            payload.append(c.values)
+            if c.lengths is None:
+                payload.append(c.values)
             if c.valid is not None:
                 payload.append(c.valid)
+        # list columns (2-D element planes) can't ride the multi-operand
+        # sort; carry a permutation instead and gather them after
+        perm = None
+        if has_list:
+            payload.append(jnp.arange(self.capacity, dtype=jnp.int32))
         out = jax.lax.sort([~mask] + payload, num_keys=1, is_stable=True)
         it = iter(out[1:])
         cols = []
+        scalar_parts = []
         for c in self.columns:
-            values = next(it)
+            values = next(it) if c.lengths is None else None
             valid = next(it) if c.valid is not None else None
-            cols.append(Column(values, valid, c.type, c.dictionary))
+            scalar_parts.append((values, valid))
+        if has_list:
+            perm = out[-1]
+        for c, (values, valid) in zip(self.columns, scalar_parts):
+            if c.lengths is None:
+                cols.append(Column(values, valid, c.type, c.dictionary))
+            else:
+                g = c.gather(perm)
+                cols.append(Column(g.values, valid, c.type, c.dictionary,
+                                   g.lengths, g.aux, g.aux_dictionary))
         return Page(tuple(cols), count)
 
     def gather(self, indices: jnp.ndarray, count) -> "Page":
@@ -287,7 +332,10 @@ class Page:
         cols = tuple(
             Column(c.values[:capacity],
                    None if c.valid is None else c.valid[:capacity],
-                   c.type, c.dictionary)
+                   c.type, c.dictionary,
+                   None if c.lengths is None else c.lengths[:capacity],
+                   None if c.aux is None else c.aux[:capacity],
+                   c.aux_dictionary)
             for c in self.columns)
         return Page(cols, self.num_rows)
 
@@ -323,21 +371,40 @@ class Page:
         return cls(cols, jnp.asarray(n, dtype=jnp.int32))
 
     def to_host(self, num_rows: Optional[int] = None) -> list:
-        """All columns as decoded host arrays in ONE batched transfer."""
+        """All columns as decoded host arrays in ONE batched transfer.
+        List (ARRAY/MAP) columns decode to python lists / dicts per row."""
         n = int(self.num_rows) if num_rows is None else num_rows
         fetch = []
         for c in self.columns:
-            fetch.append(c.values[:n])
-            fetch.append(c.valid[:n] if c.valid is not None else None)
+            fetch.append((c.values[:n],
+                          c.valid[:n] if c.valid is not None else None,
+                          c.lengths[:n] if c.lengths is not None else None,
+                          c.aux[:n] if c.aux is not None else None))
         host = jax.device_get(fetch)
         out = []
-        for ci, c in enumerate(self.columns):
-            vals = host[2 * ci]
-            if c.dictionary is not None:
+        for c, (vals, valid, lengths, aux) in zip(self.columns, host):
+            if lengths is not None:
+                rows = np.empty(n, dtype=object)
+                for i in range(n):
+                    ln = int(lengths[i])
+                    elems = vals[i, :ln]
+                    if c.dictionary is not None:
+                        elems = c.dictionary.decode(elems)
+                    if aux is not None:
+                        avals = aux[i, :ln]
+                        if c.aux_dictionary is not None:
+                            avals = c.aux_dictionary.decode(avals)
+                            avals = avals.tolist()
+                        else:
+                            avals = avals.tolist()
+                        rows[i] = dict(zip(elems.tolist(), avals))
+                    else:
+                        rows[i] = list(elems.tolist())
+                decoded = rows
+            elif c.dictionary is not None:
                 decoded = c.dictionary.decode(vals)
             else:
                 decoded = vals.astype(object)
-            valid = host[2 * ci + 1]
             if valid is not None:
                 decoded = decoded.copy()
                 decoded[~valid] = None
@@ -454,6 +521,33 @@ def device_concat(pages: Sequence[Page]) -> Page:
     cols = []
     for ci in range(ncols):
         ref = pages[0].column(ci)
+        if ref.lengths is not None:
+            # list columns: pad element planes to the widest page's L
+            lmax = max(p.column(ci).values.shape[1] for p in pages)
+
+            def plane(get):
+                out2 = jnp.zeros((out_cap, lmax), dtype=get(ref).dtype)
+                for p, o in zip(pages, offs):
+                    a = get(p.column(ci))
+                    if a.shape[1] < lmax:
+                        a = jnp.pad(a, ((0, 0), (0, lmax - a.shape[1])))
+                    out2 = jax.lax.dynamic_update_slice(out2, a, (o, 0))
+                return out2
+            values2 = plane(lambda c: c.values)
+            aux2 = plane(lambda c: c.aux) if ref.aux is not None else None
+            lens = jnp.zeros(out_cap, dtype=jnp.int32)
+            for p, o in zip(pages, offs):
+                lens = jax.lax.dynamic_update_slice(
+                    lens, p.column(ci).lengths, (o,))
+            valid = None
+            if needs_valid[ci]:
+                valid = jnp.zeros(out_cap, dtype=jnp.bool_)
+                for p, o in zip(pages, offs):
+                    valid = jax.lax.dynamic_update_slice(
+                        valid, p.column(ci).valid_mask(), (o,))
+            cols.append(Column(values2, valid, ref.type, ref.dictionary,
+                               lens, aux2, ref.aux_dictionary))
+            continue
         out = jnp.zeros(out_cap, dtype=ref.values.dtype)
         for p, o in zip(pages, offs):
             out = jax.lax.dynamic_update_slice(out, p.column(ci).values,

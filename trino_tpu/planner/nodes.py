@@ -289,6 +289,35 @@ class JoinNode(PlanNode):
 
 
 @_node
+class UnnestNode(PlanNode):
+    """UNNEST over list-layout columns (plan/UnnestNode.java +
+    operator/unnest/UnnestOperator.java, re-cut for static shapes: the
+    executor expands via the same counts->cumsum->searchsorted machinery
+    as join expansion). `elements` has one output symbol per ARRAY input
+    and (key, value) for a MAP input; replicated columns are the
+    source's outputs."""
+
+    source: PlanNode
+    arrays: Tuple[Symbol, ...]
+    elements: Tuple[Tuple[Symbol, ...], ...]
+    ordinality: "Optional[Symbol]" = None
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    @property
+    def outputs(self):
+        flat = tuple(s for group in self.elements for s in group)
+        ordi = (self.ordinality,) if self.ordinality is not None else ()
+        return self.source.outputs + flat + ordi
+
+    def with_sources(self, sources):
+        return UnnestNode(sources[0], self.arrays, self.elements,
+                          self.ordinality)
+
+
+@_node
 class SemiJoinNode(PlanNode):
     """plan/SemiJoinNode.java — emits source rows + match flag symbol.
 

@@ -32,7 +32,8 @@ from trino_tpu.planner.nodes import (
     FilterNode, GroupIdNode, JoinClause, JoinDistribution, JoinKind, JoinNode,
     LimitNode, OffsetNode, Ordering, OutputNode, PlanNode, ProjectNode,
     SemiJoinNode, SortNode, Symbol, TableScanNode, TopNNode, UnionNode,
-    ValuesNode, WindowNode, TableWriterNode, AssignUniqueIdNode)
+    UnnestNode, ValuesNode, WindowNode, TableWriterNode,
+    AssignUniqueIdNode)
 from trino_tpu.predicate import Domain, Range, TupleDomain
 
 
@@ -781,6 +782,9 @@ def prune_unreferenced(root: OutputNode) -> OutputNode:
             return GroupIdNode(needed_of(node.source, req),
                                node.grouping_sets, node.group_id_symbol,
                                node.passthrough)
+        if isinstance(node, UnnestNode):
+            req = set(required) | {s.name for s in node.arrays}
+            return node.with_sources([needed_of(node.source, req)])
         if isinstance(node, (SortNode, TopNNode)):
             req = set(required) | {o.symbol.name for o in node.order_by}
             src = needed_of(node.source, req)
@@ -1224,7 +1228,7 @@ def add_exchanges(root: OutputNode, ctx: OptimizerContext) -> OutputNode:
             return node, "source"
         if isinstance(node, ValuesNode):
             return node, "single"
-        if isinstance(node, (FilterNode, ProjectNode)):
+        if isinstance(node, (FilterNode, ProjectNode, UnnestNode)):
             src, part = visit(node.source)
             return node.with_sources([src]), part
 

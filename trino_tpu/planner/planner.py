@@ -32,8 +32,8 @@ from trino_tpu.planner.nodes import (
     EnforceSingleRowNode, FilterNode, GroupIdNode, JoinClause, JoinKind,
     JoinNode, LimitNode, OffsetNode, Ordering, OutputNode, PlanNode,
     ProjectNode, SemiJoinNode, SortNode, Symbol, SymbolAllocator,
-    TableScanNode, TopNNode, UnionNode, ValuesNode, WindowFunction,
-    WindowNode)
+    TableScanNode, TopNNode, UnionNode, UnnestNode, ValuesNode,
+    WindowFunction, WindowNode)
 from trino_tpu.planner.translate import (
     ExpressionTranslator, Field, Scope, cast_to, make_comparison)
 from trino_tpu.sql import tree as t
@@ -259,7 +259,72 @@ class LogicalPlanner:
             return self._plan_join(rel, outer, ctes)
         if isinstance(rel, t.Values):
             return self._plan_values(rel, outer)
+        if isinstance(rel, t.Unnest):
+            # standalone FROM UNNEST(...): expand against one dummy row
+            dummy = self.symbols.new("unnest_src", T.BIGINT)
+            src = RelationPlan(
+                ValuesNode((dummy,), ((Literal(0, T.BIGINT),),)),
+                Scope([], outer))
+            return self._plan_unnest(src, rel, None, outer)
         raise SemanticError(f"unsupported relation: {type(rel).__name__}")
+
+    def _plan_unnest(self, left: RelationPlan, un: t.Unnest,
+                     alias_rel: Optional[t.AliasedRelation],
+                     outer) -> RelationPlan:
+        """CROSS JOIN UNNEST(arr [, ...]) [WITH ORDINALITY] [AS a(c...)].
+        One ARRAY argument yields one element column; a MAP argument
+        yields (key, value)."""
+        tr = ExpressionTranslator(left.scope)
+        exprs = [tr.translate(e) for e in un.expressions]
+        if len(exprs) > 1:
+            raise SemanticError(
+                "UNNEST of multiple arrays (zip) not supported yet")
+        node = left.node
+        array_syms = []
+        pre = [(s, s.ref()) for s in node.outputs]
+        for e in exprs:
+            if not isinstance(e.type, (T.ArrayType, T.MapType)):
+                raise SemanticError(
+                    f"UNNEST argument must be ARRAY or MAP, got "
+                    f"{e.type.display()}")
+            if isinstance(e, SymbolRef):
+                array_syms.append(Symbol(e.name, e.type))
+            else:
+                sym = self.symbols.new("unnest_arr", e.type)
+                pre.append((sym, e))
+                array_syms.append(sym)
+        if len(pre) > len(node.outputs):
+            node = ProjectNode(node, tuple(pre))
+        names = [c.value for c in alias_rel.column_names] \
+            if alias_rel is not None else []
+        alias = alias_rel.alias.value if alias_rel is not None else None
+        elements = []
+        fields = list(left.scope.fields)
+        ni = 0
+
+        def next_name(default):
+            nonlocal ni
+            name = names[ni] if ni < len(names) else default
+            ni += 1
+            return name
+
+        for s in array_syms:
+            if isinstance(s.type, T.MapType):
+                k = self.symbols.new("unnest_key", s.type.key)
+                v = self.symbols.new("unnest_val", s.type.value)
+                elements.append((k, v))
+                fields.append(Field(next_name("key"), alias, k))
+                fields.append(Field(next_name("value"), alias, v))
+            else:
+                el = self.symbols.new("unnest_el", s.type.element)
+                elements.append((el,))
+                fields.append(Field(next_name("col"), alias, el))
+        ordi = None
+        if un.with_ordinality:
+            ordi = self.symbols.new("ordinality", T.BIGINT)
+            fields.append(Field(next_name("ordinality"), alias, ordi))
+        out = UnnestNode(node, tuple(array_syms), tuple(elements), ordi)
+        return RelationPlan(out, Scope(fields, outer))
 
     def _plan_table(self, rel: t.Table, outer: Optional[Scope]) -> RelationPlan:
         qname = self.metadata.resolve_table_name(rel.name.parts, self.session)
@@ -306,6 +371,21 @@ class LogicalPlanner:
                             Scope(fields, outer))
 
     def _plan_join(self, rel: t.Join, outer, ctes) -> RelationPlan:
+        # UNNEST on the right side is LATERAL-correlated: its expressions
+        # see the LEFT relation (RelationPlanner.planJoinUnnest analog)
+        inner_right = rel.right
+        unnest_alias = None
+        if isinstance(inner_right, t.AliasedRelation) and \
+                isinstance(inner_right.relation, t.Unnest):
+            unnest_alias = inner_right
+            inner_right = inner_right.relation
+        if isinstance(inner_right, t.Unnest):
+            if rel.join_type not in ("IMPLICIT", "CROSS", "INNER"):
+                raise SemanticError(
+                    f"{rel.join_type} JOIN UNNEST not supported")
+            left = self._plan_relation(rel.left, outer, ctes)
+            return self._plan_unnest(left, inner_right, unnest_alias,
+                                     outer)
         left = self._plan_relation(rel.left, outer, ctes)
         right = self._plan_relation(rel.right, outer, ctes)
         join_scope = Scope(left.scope.fields + right.scope.fields, outer)

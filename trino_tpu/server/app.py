@@ -179,7 +179,7 @@ class TrinoServer:
                 except Exception:
                     pass
             try:
-                q.result = self.runner.execute(q.sql)
+                result = self.runner.execute(q.sql)
             finally:
                 session.properties.clear()
                 session.properties.update(saved_props)
@@ -192,6 +192,10 @@ class TrinoServer:
             if m:
                 q.update_type = "RESET SESSION"
                 q.clear_session = m.group(1)
+            # publish LAST: a concurrently-polling client that sees
+            # q.result must also see update_type/set_session (else the
+            # X-Trino-Set-Session header is lost)
+            q.result = result
         except Exception as e:  # surface as QueryError, not HTTP 500
             q.error = protocol.error_json(
                 f"{type(e).__name__}: {e}",

@@ -39,7 +39,8 @@ AGGREGATE_NAMES = frozenset({
     "every", "arbitrary", "any_value", "stddev", "stddev_pop", "stddev_samp",
     "variance", "var_pop", "var_samp", "approx_distinct", "corr", "covar_pop",
     "covar_samp", "regr_slope", "regr_intercept", "checksum", "geometric_mean",
-    "min_by", "max_by", "approx_percentile",
+    "min_by", "max_by", "approx_percentile", "array_agg", "histogram",
+    "map_agg",
 })
 
 WINDOW_NAMES = frozenset({
@@ -285,6 +286,37 @@ def resolve_scalar(name: str, arg_types: Sequence[T.Type]) -> ResolvedFunction:
         # synthesized by the translator for TRY_CAST; target type is
         # pre-resolved there
         return sig(args[0])
+    # ------------------------------------------------- array/map functions
+    if n == "array_ctor":
+        if not args:
+            raise SemanticError("ARRAY[] needs an element type; "
+                                "cast to a typed empty array")
+        ct = args[0]
+        for a in args[1:]:
+            nt = common_type(ct, a)
+            if nt is None:
+                raise SemanticError("ARRAY elements have mixed types")
+            ct = nt
+        return ResolvedFunction("array_ctor", (ct,) * len(args),
+                                T.ArrayType(element=ct))
+    if n == "cardinality":
+        if not isinstance(args[0], (T.ArrayType, T.MapType)):
+            raise SemanticError("cardinality() needs ARRAY or MAP")
+        return ResolvedFunction("cardinality", args, T.BIGINT)
+    if n == "element_at":
+        if isinstance(args[0], T.ArrayType):
+            return ResolvedFunction("element_at", (args[0], T.BIGINT),
+                                    args[0].element)
+        if isinstance(args[0], T.MapType):
+            return ResolvedFunction("map_element_at",
+                                    (args[0], args[0].key),
+                                    args[0].value)
+        raise SemanticError("element_at() needs ARRAY or MAP")
+    if n == "contains":
+        if not isinstance(args[0], T.ArrayType):
+            raise SemanticError("contains() needs an ARRAY")
+        return ResolvedFunction(
+            "contains", (args[0], args[0].element), T.BOOLEAN)
     raise SemanticError(f"unknown function: {name}()")
 
 
@@ -343,4 +375,15 @@ def resolve_aggregate(name: str, arg_types: Sequence[T.Type]
         if len(args) != 2:
             raise SemanticError(f"{n}() takes exactly two arguments")
         return ResolvedFunction(n, args, args[0])
+    if n == "array_agg":
+        return ResolvedFunction("array_agg", args,
+                                T.ArrayType(element=a))
+    if n == "histogram":
+        return ResolvedFunction("histogram", args,
+                                T.MapType(key=a, value=T.BIGINT))
+    if n == "map_agg":
+        if len(args) != 2:
+            raise SemanticError("map_agg(key, value) takes two arguments")
+        return ResolvedFunction("map_agg", args,
+                                T.MapType(key=args[0], value=args[1]))
     raise SemanticError(f"unknown aggregate: {name}()")

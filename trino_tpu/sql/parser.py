@@ -916,6 +916,13 @@ class _Parser:
         if self.at_keyword("CURRENT_TIMESTAMP", "LOCALTIMESTAMP"):
             self.next()
             return t.CurrentTime("TIMESTAMP")
+        if self.at_keyword("ARRAY") and self.peek(1).text == "[":
+            self.next()
+            self.expect_op("[")
+            items = [] if self.at_op("]") else self.expression_list()
+            self.expect_op("]")
+            return t.FunctionCall(t.QualifiedName(("array_ctor",)),
+                                  tuple(items))
         if self.at_keyword("ROW") and self.peek(1).text == "(":
             self.next()
             self.expect_op("(")

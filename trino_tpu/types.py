@@ -338,6 +338,60 @@ def common_super_type(a: Type, b: Type) -> Optional[Type]:
     return None
 
 
+@dataclasses.dataclass(frozen=True)
+class ArrayType(Type):
+    """ARRAY(element) with a TPU-first list layout: a Column carries
+    values [capacity, max_len] + per-row lengths (the reference's
+    ArrayBlock offsets+flattened-values, re-cut for static shapes —
+    spi/block/ArrayBlock.java, spi/type/ArrayType.java). Element NULLs
+    are not represented (documented deviation; aggregation skips NULL
+    inputs, constructors take non-null elements)."""
+
+    name: ClassVar[str] = "array"
+    element: Type = None  # type: ignore[assignment]
+
+    @property
+    def dtype(self):
+        return self.element.dtype
+
+    @property
+    def comparable(self) -> bool:
+        return False
+
+    @property
+    def orderable(self) -> bool:
+        return False
+
+    def display(self) -> str:
+        return f"array({self.element.display()})"
+
+
+@dataclasses.dataclass(frozen=True)
+class MapType(Type):
+    """MAP(key, value): list layout of keys in Column.values plus a
+    companion per-element value plane (Column.aux). Reference:
+    spi/type/MapType.java / MapBlock.java."""
+
+    name: ClassVar[str] = "map"
+    key: Type = None    # type: ignore[assignment]
+    value: Type = None  # type: ignore[assignment]
+
+    @property
+    def dtype(self):
+        return self.key.dtype
+
+    @property
+    def comparable(self) -> bool:
+        return False
+
+    @property
+    def orderable(self) -> bool:
+        return False
+
+    def display(self) -> str:
+        return f"map({self.key.display()}, {self.value.display()})"
+
+
 def parse_type(text: str) -> Type:
     """Parse a SQL type name (analog of spi/type/TypeSignature parsing)."""
     s = text.strip().lower()
@@ -364,6 +418,19 @@ def parse_type(text: str) -> Type:
         # Java-long-overflow acceptance as arithmetic_type/common_type
         prec = min(prec, 18)
         return DecimalType(precision=prec, scale=min(scale, prec))
+    if s.startswith("array(") and s.endswith(")"):
+        return ArrayType(element=parse_type(s[6:-1]))
+    if s.startswith("map(") and s.endswith(")"):
+        inner = s[4:-1]
+        depth = 0
+        for i, ch in enumerate(inner):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            elif ch == "," and depth == 0:
+                return MapType(key=parse_type(inner[:i]),
+                               value=parse_type(inner[i + 1:]))
     if s == "char":
         return CharType(length=1)
     if s.startswith("varchar("):
