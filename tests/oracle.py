@@ -141,8 +141,35 @@ def normalize(rows: List[Tuple], sort: bool = False) -> List[Tuple]:
                 canon.append(v)
         out.append(tuple(canon))
     if sort:
-        out.sort(key=repr)
+        out.sort(key=_row_sort_key)
     return out
+
+
+def _row_sort_key(row: Tuple):
+    """Representation-independent multiset ordering: a decimal and the
+    float it equals must sort IDENTICALLY on both sides, or engine/oracle
+    row pairing drifts and assert_same compares the wrong rows."""
+    key = []
+    for v in row:
+        if isinstance(v, tuple) and v:
+            if v[0] == "dec":
+                scale = v[2] if len(v) > 2 else 0
+                key.append(("n", round(v[1] / (10 ** scale), 4)))
+                continue
+            if v[0] == "f":
+                key.append(("n", float("inf") if v[1] == "nan"
+                            else round(float(v[1]), 4)))
+                continue
+            if v[0] == "d":
+                key.append(("n", float(v[1])))
+                continue
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            key.append(("n", round(float(v), 4)))
+        elif v is None:
+            key.append(("~",))
+        else:
+            key.append(("s", str(v)))
+    return key
 
 
 def assert_same(engine_rows: List[Tuple], oracle_rows: List[Tuple],

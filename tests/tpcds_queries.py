@@ -64,4 +64,9 @@ def to_oracle_sql(sql: str) -> str:
                  lambda m: f"{m.group(1)} {m.group(2)}", sql, flags=re.I)
     # typed decimal literals: sqlite takes the bare numeric
     sql = re.sub(r"DECIMAL\s+'([0-9.+-]+)'", r"\1", sql, flags=re.I)
+    # CAST(x AS DECIMAL(p,s)) -> REAL: sqlite's NUMERIC affinity keeps
+    # integers integral and then divides integrally — the benchmark casts
+    # exist precisely to force fractional division
+    sql = re.sub(r"AS\s+DECIMAL\s*\(\s*\d+\s*,\s*\d+\s*\)", "AS REAL",
+                 sql, flags=re.I)
     return sql

@@ -1211,24 +1211,77 @@ class _PlanBuilder:
         inner_scope_probe = self._inner_name_probe(spec)
         corr: List[Tuple[t.Expression, t.Expression]] = []
         local: List[t.Expression] = []
+        def orient(eq):
+            a, b = eq.left, eq.right
+            if self._classify(a, inner_scope_probe) == "local":
+                corr.append((b, a))   # (outer side, inner side)
+            else:
+                corr.append((a, b))
+
         for conj in _conjuncts(spec.where):
             side = self._classify(conj, inner_scope_probe)
             if side == "local":
                 local.append(conj)
             elif side == "corr_eq":
-                a, b = conj.left, conj.right
-                if self._classify(a, inner_scope_probe) == "local":
-                    corr.append((b, a))   # (outer side, inner side)
-                else:
-                    corr.append((a, b))
+                orient(conj)
             else:
-                return None
+                # (E AND L1) OR (E AND L2) with one shared correlation
+                # equality E factors to E AND (L1 OR L2) — the TPC-DS q41
+                # shape (TransformCorrelated* handles this via general
+                # subquery planning in the reference)
+                factored = self._factor_or_correlation(
+                    conj, inner_scope_probe)
+                if factored is None:
+                    return None
+                eqs, local_or = factored
+                for eq in eqs:
+                    orient(eq)
+                local.append(local_or)
         where = None
         if local:
             where = local[0]
             for c in local[1:]:
                 where = t.LogicalBinary("AND", where, c)
         return corr, where
+
+    def _factor_or_correlation(self, conj, probe):
+        """(E... AND L1) OR (E... AND L2) -> ([E...], L1 OR L2) when every
+        disjunct carries the structurally-identical correlation
+        equalities; None otherwise."""
+        if not (isinstance(conj, t.LogicalBinary) and conj.op == "OR"):
+            return None
+
+        def disjuncts(e):
+            if isinstance(e, t.LogicalBinary) and e.op == "OR":
+                return disjuncts(e.left) + disjuncts(e.right)
+            return [e]
+
+        shared_key = None
+        shared_eqs = None
+        locals_ = []
+        for d in disjuncts(conj):
+            eqs, rest = [], []
+            for c in _conjuncts(d):
+                side = self._classify(c, probe)
+                if side == "corr_eq":
+                    eqs.append(c)
+                elif side == "local":
+                    rest.append(c)
+                else:
+                    return None
+            key = tuple(sorted(repr(e) for e in eqs))
+            if shared_key is None:
+                shared_key, shared_eqs = key, eqs
+            elif key != shared_key:
+                return None
+            locals_.append(_combine_ast(rest) if rest
+                           else t.BooleanLiteral(True))
+        if not shared_eqs:
+            return None
+        out = locals_[0]
+        for x in locals_[1:]:
+            out = t.LogicalBinary("OR", out, x)
+        return shared_eqs, out
 
     def _inner_name_probe(self, spec: t.QuerySpecification):
         """Set of column names/qualifiers visible inside the subquery FROM."""
