@@ -188,3 +188,31 @@ def test_concat_ws_null_args(r):
         "SELECT concat_ws(',', 'x', try_cast(substr(n_name, 1, 1) "
         "AS varchar), 'y') FROM nation LIMIT 1").rows
     assert rows[0][0] in ("x,A,y", "x,y") or rows[0][0].count(",") >= 1
+
+
+def test_bitwise_and_width_bucket(r):
+    assert one(r, "bitwise_and(12, 10)") == 8
+    assert one(r, "bitwise_or(12, 10)") == 14
+    assert one(r, "bitwise_xor(12, 10)") == 6
+    assert one(r, "bitwise_not(0)") == -1
+    assert one(r, "bitwise_left_shift(1, 4)") == 16
+    assert one(r, "bitwise_right_shift(-1, 62)") == 3
+    assert one(r, "bitwise_right_shift_arithmetic(-8, 2)") == -2
+    assert one(r, "bit_count(9, 64)") == 2
+    assert one(r, "width_bucket(5.3e0, 0e0, 10e0, 5)") == 3
+    assert one(r, "width_bucket(-1e0, 0e0, 10e0, 5)") == 0
+    assert one(r, "width_bucket(11e0, 0e0, 10e0, 5)") == 6
+
+
+def test_format_datetime(r):
+    assert one(r, "format_datetime(DATE '1995-03-15', 'yyyy-MM-dd')") \
+        == "1995-03-15"
+    assert one(r, "format_datetime(DATE '1995-03-15', 'MMM yyyy')") \
+        == "Mar 1995"
+    assert one(r, "date_format(DATE '1995-03-15', '%Y/%m/%d')") \
+        == "1995/03/15"
+    rows = r.execute(
+        "SELECT o_orderdate, format_datetime(o_orderdate, 'yyyy-MM') "
+        "FROM orders LIMIT 3").rows
+    for d, s in rows:
+        assert s == d.strftime("%Y-%m")

@@ -100,6 +100,8 @@ def _eval_call(expr: Call, page: Page) -> Column:
     if name in ("array_ctor", "cardinality", "element_at",
                 "map_element_at", "contains"):
         return _array_call(expr, page)
+    if name in ("format_datetime", "date_format"):
+        return _format_datetime(expr, page)
     # --- generic null-propagating scalar ----------------------------------
     impl = F.lookup(name)
     args = [_eval(a, page) for a in expr.args]
@@ -448,6 +450,103 @@ def _numeric_cast_ok(values: jnp.ndarray, src_t, target
         v = values.astype(jnp.int64)
         return _int_range_ok(v, -(bound - 1), bound - 1)
     return None   # float/bool/date targets: saturation matches Trino
+
+
+_DATE_FMT_CACHE: dict = {}
+_FMT_BASE_Y, _FMT_END_Y = 1900, 2100
+
+
+def _joda_to_strftime(pattern: str) -> str:
+    """Joda (format_datetime) -> strftime, date-resolution subset."""
+    out = []
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        run = 1
+        while i + run < len(pattern) and pattern[i + run] == ch:
+            run += 1
+        tok = ch * run
+        mapping = {"yyyy": "%Y", "yy": "%y", "y": "%Y", "MMMM": "%B",
+                   "MMM": "%b", "MM": "%m", "M": "%-m", "dd": "%d",
+                   "d": "%-d", "EEEE": "%A", "EEE": "%a", "e": "%u",
+                   "DDD": "%j", "D": "%-j"}
+        if ch in "HhmsSaKkZzwQx":
+            # time-of-day tokens are unrepresentable on a day-resolution
+            # table; 'w' (Joda ISO week-of-weekyear) has no strftime
+            # equivalent ('%W' is zero-based Monday weeks) — fail loud
+            raise NotImplementedError(
+                f"format_datetime token {tok!r} unsupported on DATE")
+        out.append(mapping.get(tok, tok))
+        i += run
+    return "".join(out)
+
+
+def _mysql_to_strftime(pattern: str) -> str:
+    """MySQL (date_format) -> strftime, date-resolution subset."""
+    out = []
+    i = 0
+    while i < len(pattern):
+        if pattern[i] == "%" and i + 1 < len(pattern):
+            c = pattern[i + 1]
+            mapping = {"Y": "%Y", "y": "%y", "m": "%m", "c": "%-m",
+                       "d": "%d", "e": "%-d", "j": "%j", "W": "%A",
+                       "a": "%a", "M": "%B", "b": "%b", "u": "%W",
+                       "%": "%%"}
+            if c in "HhiSsTrpf":
+                raise NotImplementedError(
+                    f"date_format time-of-day token %{c} on DATE")
+            out.append(mapping.get(c, "%" + c))
+            i += 2
+        else:
+            out.append(pattern[i])
+            i += 1
+    return "".join(out)
+
+
+def _format_datetime(expr: Call, page: Page) -> Column:
+    """format_datetime/date_format with a literal pattern over DATE (and
+    day-resolution TIMESTAMP) columns: the whole 1900-2100 day domain
+    formats ONCE into a memoized dictionary + code table, so the device
+    does one gather per row (DateTimeFunctions.java's per-row formatter
+    replaced by a bounded-domain lookup — the dictionary-encoding move
+    this engine makes for every string computation)."""
+    pat = expr.args[1]
+    if not isinstance(pat, Literal):
+        raise NotImplementedError(f"{expr.name} pattern must be a literal")
+    col = _eval(expr.args[0], page)
+    src_t = expr.args[0].type
+    values = col.values
+    if isinstance(src_t, T.TimestampType):
+        values = (values.astype(jnp.int64)
+                  // jnp.int64(86_400_000_000)).astype(jnp.int32)
+    elif not isinstance(src_t, T.DateType):
+        raise NotImplementedError(
+            f"{expr.name} over {src_t.display()}")
+    key = (expr.name, pat.value)
+    got = _DATE_FMT_CACHE.get(key)
+    if got is None:
+        import datetime as _dt
+        fmt = _joda_to_strftime(pat.value) if expr.name == "format_datetime" \
+            else _mysql_to_strftime(pat.value)
+        base = _dt.date(_FMT_BASE_Y, 1, 1)
+        days0 = (base - _dt.date(1970, 1, 1)).days
+        ndays = (_dt.date(_FMT_END_Y, 1, 1) - base).days
+        strings = np.asarray(
+            [(base + _dt.timedelta(days=i)).strftime(fmt)
+             for i in range(ndays)]
+            # explicit out-of-domain marker (silently clipping to the
+            # boundary would format extreme dates as 1900/2099 strings)
+            + [f"<date out of {_FMT_BASE_Y}-{_FMT_END_Y}>"], dtype=object)
+        uniq, remap = np.unique(strings, return_inverse=True)
+        got = _DATE_FMT_CACHE[key] = (
+            Dictionary(uniq), jnp.asarray(remap.astype(np.int32)),
+            days0, ndays)
+    d, remap, days0, ndays = got
+    off = values.astype(jnp.int64) - days0
+    oob = (off < 0) | (off >= ndays)
+    off = jnp.where(oob, ndays, off)    # marker slot
+    codes = jnp.take(remap, off, mode="clip")
+    return Column(codes.astype(jnp.int32), col.valid, expr.type, d)
 
 
 def _array_call(expr: Call, page: Page) -> Column:

@@ -136,6 +136,72 @@ def _negate(out_type, arg_types, a):
     return -a
 
 
+# ------------------------------------------------------- bitwise / buckets
+# Reference: operator/scalar/BitwiseFunctions.java, MathFunctions.java
+# widthBucket
+
+@scalar("bitwise_and")
+def _bitand(out_type, arg_types, a, b):
+    return a.astype(jnp.int64) & jnp.asarray(b).astype(jnp.int64)
+
+
+@scalar("bitwise_or")
+def _bitor(out_type, arg_types, a, b):
+    return a.astype(jnp.int64) | jnp.asarray(b).astype(jnp.int64)
+
+
+@scalar("bitwise_xor")
+def _bitxor(out_type, arg_types, a, b):
+    return a.astype(jnp.int64) ^ jnp.asarray(b).astype(jnp.int64)
+
+
+@scalar("bitwise_not")
+def _bitnot(out_type, arg_types, a):
+    return ~a.astype(jnp.int64)
+
+
+@scalar("bitwise_left_shift")
+def _bitshl(out_type, arg_types, a, b):
+    return a.astype(jnp.int64) << jnp.asarray(b).astype(jnp.int64)
+
+
+@scalar("bitwise_right_shift")
+def _bitshr(out_type, arg_types, a, b):
+    # logical shift (Trino bitwise_right_shift zero-fills)
+    ua = jax.lax.bitcast_convert_type(a.astype(jnp.int64), jnp.uint64)
+    out = ua >> jnp.asarray(b).astype(jnp.uint64)
+    return jax.lax.bitcast_convert_type(out, jnp.int64)
+
+
+@scalar("bitwise_right_shift_arithmetic")
+def _bitsar(out_type, arg_types, a, b):
+    return a.astype(jnp.int64) >> jnp.asarray(b).astype(jnp.int64)
+
+
+@scalar("bit_count")
+def _bit_count(out_type, arg_types, a, bits):
+    """Deviation: values not representable in `bits` MASK to the low bits
+    (Trino raises); jit kernels cannot raise per-row — same policy as the
+    div-by-zero garbage-not-error note."""
+    u = jax.lax.bitcast_convert_type(a.astype(jnp.int64), jnp.uint64)
+    mask = jnp.where(jnp.asarray(bits).astype(jnp.uint64) >= 64,
+                     jnp.uint64(0xFFFFFFFFFFFFFFFF),
+                     (jnp.uint64(1) << jnp.asarray(bits).astype(jnp.uint64))
+                     - 1)
+    return jax.lax.population_count(u & mask).astype(jnp.int64)
+
+
+@scalar("width_bucket")
+def _width_bucket(out_type, arg_types, x, lo, hi, n):
+    x = x.astype(jnp.float64)
+    lo = jnp.asarray(lo).astype(jnp.float64)
+    hi = jnp.asarray(hi).astype(jnp.float64)
+    n = jnp.asarray(n).astype(jnp.int64)
+    b = jnp.floor((x - lo) / (hi - lo) * n.astype(jnp.float64)) + 1
+    b = jnp.clip(b, 0, (n + 1).astype(jnp.float64))
+    return b.astype(jnp.int64)
+
+
 # ---------------------------------------------------------------------------
 # comparison (numeric / date / codes — string literals are pre-folded to codes
 # by the compiler using the column dictionary)

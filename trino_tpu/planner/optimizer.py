@@ -454,6 +454,64 @@ class StatsEstimator:
 # rules
 
 
+_FOLD_ARITH = {
+    "add": lambda a, b: a + b,
+    "subtract": lambda a, b: a - b,
+    "multiply": lambda a, b: a * b,
+}
+_FOLD_CMP = {
+    "eq": lambda a, b: a == b, "ne": lambda a, b: a != b,
+    "lt": lambda a, b: a < b, "le": lambda a, b: a <= b,
+    "gt": lambda a, b: a > b, "ge": lambda a, b: a >= b,
+}
+
+
+def fold_constants(e: RowExpression) -> RowExpression:
+    """ExpressionInterpreter-lite (sql/planner/ExpressionInterpreter.java
+    partial evaluation): fold arithmetic/comparisons over literals so
+    `BETWEEN 1200 AND (1200 + 11)` becomes domain-extractable and reaches
+    scan pushdown. Division/modulo keep their kernel rounding semantics
+    (not folded); decimal +,-,* fold exactly in scaled-int space because
+    the translator already aligned argument scales."""
+    if isinstance(e, Call):
+        args = tuple(fold_constants(a) for a in e.args)
+        e = Call(e.name, args, e.type)
+        if len(args) == 2 and all(
+                isinstance(a, Literal) and a.value is not None
+                and isinstance(a.value, (int, float))
+                and not isinstance(a.value, bool) for a in args):
+            a, b = args
+            if e.name in _FOLD_ARITH and a.type == b.type == e.type:
+                return Literal(_FOLD_ARITH[e.name](a.value, b.value),
+                               e.type)
+            if e.name in _FOLD_CMP and a.type == b.type:
+                return Literal(_FOLD_CMP[e.name](a.value, b.value),
+                               e.type)
+        if e.name == "negate" and len(args) == 1 and \
+                isinstance(args[0], Literal) and \
+                args[0].value is not None and e.type == args[0].type:
+            return Literal(-args[0].value, e.type)
+        return e
+    if isinstance(e, SpecialForm):
+        args = tuple(fold_constants(a) for a in e.args)
+        return SpecialForm(e.kind, args, e.type)
+    return e
+
+
+class FoldConstants(Rule):
+    def apply(self, node, ctx):
+        if isinstance(node, FilterNode):
+            folded = fold_constants(node.predicate)
+            if folded != node.predicate:
+                return FilterNode(node.source, folded)
+        if isinstance(node, ProjectNode):
+            assigns = tuple((s, fold_constants(x))
+                            for s, x in node.assignments)
+            if assigns != node.assignments:
+                return ProjectNode(node.source, assigns)
+        return None
+
+
 class ExtractCommonPredicates(Rule):
     def apply(self, node: PlanNode, ctx: "OptimizerContext"
               ) -> Optional[PlanNode]:
@@ -1458,8 +1516,10 @@ def fragment_plan(root: OutputNode) -> PlanFragment:
 
 def optimize(root: OutputNode, metadata: Metadata, session: Session,
              distributed: bool = False) -> OutputNode:
+    from trino_tpu.planner.validator import validate_plan
     ctx = OptimizerContext(metadata, session, StatsEstimator(metadata))
     rules = [
+        FoldConstants(),
         MergeFilters(),
         ExtractCommonPredicates(),
         MergeAdjacentProjects(),
@@ -1471,7 +1531,7 @@ def optimize(root: OutputNode, metadata: Metadata, session: Session,
         CreateTopN(),
     ]
     root = run_rules(root, rules, ctx)
-    root = prune_unreferenced(root)
+    root = validate_plan(prune_unreferenced(root))
     root = reorder_joins(root, ctx)
     root = run_rules(root, [
         MergeFilters(), MergeAdjacentProjects(), RemoveIdentityProjections(),
@@ -1479,7 +1539,7 @@ def optimize(root: OutputNode, metadata: Metadata, session: Session,
         PushPredicateIntoTableScan(), PushLimitIntoTableScan(),
         DetermineJoinDistributionType(), FlipJoinSides(),
     ], ctx)
-    root = prune_unreferenced(root)
+    root = validate_plan(prune_unreferenced(root))
     if distributed:
         root = add_exchanges(root, ctx)
     return root

@@ -242,6 +242,22 @@ def resolve_scalar(name: str, arg_types: Sequence[T.Type]) -> ResolvedFunction:
                                 common_type(args[0], args[1]) or args[0])
     if n == "sign":
         return sig(args[0])
+    if n in ("bitwise_and", "bitwise_or", "bitwise_xor",
+             "bitwise_left_shift", "bitwise_right_shift",
+             "bitwise_right_shift_arithmetic"):
+        return ResolvedFunction(n, (T.BIGINT, T.BIGINT), T.BIGINT)
+    if n == "bitwise_not":
+        return ResolvedFunction(n, (T.BIGINT,), T.BIGINT)
+    if n == "bit_count":
+        return ResolvedFunction(n, (T.BIGINT, T.BIGINT), T.BIGINT)
+    if n == "width_bucket":
+        return ResolvedFunction(
+            n, (T.DOUBLE, T.DOUBLE, T.DOUBLE, T.BIGINT), T.BIGINT)
+    if n in ("format_datetime", "date_format"):
+        if len(args) != 2 or not T.is_string(args[1]):
+            raise SemanticError(f"{n}(temporal, pattern) takes a "
+                                "temporal and a varchar pattern")
+        return ResolvedFunction(n, args, T.VarcharType())
     if n in ("greatest", "least"):
         ct = args[0]
         for t2 in args[1:]:
