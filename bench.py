@@ -201,7 +201,11 @@ def run_rung(tag: str) -> None:
         wall = time.perf_counter() - t0
         if tag == "tpch_q9_sf100":
             assert rows, "q9 returned no rows"
-        print(json.dumps({"wall_s": round(wall, 2)}), flush=True)
+        print(json.dumps({"wall_s": round(wall, 2),
+                          "retries": runner.stats["retries"],
+                          "faults_injected":
+                              runner.stats["faults_injected"]}),
+              flush=True)
     except Exception as e:  # noqa: BLE001 — the rung must report, not die
         print(json.dumps(
             {"error": f"{type(e).__name__}: {str(e)[:160]}"}), flush=True)
@@ -243,6 +247,10 @@ def _run_rung_subprocess(extra: dict, tag: str, base: float) -> None:
             wall = float(got["wall_s"])
             extra[f"{tag}_wall_s"] = wall
             extra[f"{tag}_vs_baseline"] = round(base / wall, 3)
+            if got.get("retries"):
+                extra[f"{tag}_retries"] = int(got["retries"])
+            if got.get("faults_injected"):
+                extra[f"{tag}_faults_injected"] = int(got["faults_injected"])
     except Exception as e:  # noqa: BLE001
         extra[f"{tag}_error"] = f"rung result parse: {type(e).__name__}: {e}"
 
@@ -289,6 +297,13 @@ def main():
     if os.environ.get("TRINO_TPU_BENCH_SF100", "1") != "0":
         for tag, (base, _, _) in SF100_RUNGS.items():
             _run_rung_subprocess(extra, tag, base)
+
+    # fault-tolerance counters (round 6): nonzero retries on a clean
+    # bench mean the engine degraded (memory-forced spill re-runs) —
+    # surfaced so a perf regression caused by silent retries is visible
+    extra["retries"] = sf1.stats["retries"] + sf10.stats["retries"]
+    extra["faults_injected"] = (sf1.stats["faults_injected"]
+                                + sf10.stats["faults_injected"])
 
     print(json.dumps({
         "metric": "tpch_q6_sf1_wall_s",

@@ -1,0 +1,116 @@
+"""Error taxonomy: every engine error carries a Trino-style error name.
+
+Reference parity: core/trino-spi StandardErrorCode.java (name + code +
+family) + TrinoException — the taxonomy is load-bearing: the retry
+machinery keys on `retryable`, the HTTP protocol surfaces
+errorName/errorCode/errorType, and the tracker records error_name.
+"""
+
+import pytest
+
+from trino_tpu import errors as E
+from trino_tpu.errors import (ExchangeTransportError, InjectedFault,
+                              InvalidSessionPropertyError,
+                              QueryCanceledError, QueryTimeoutError,
+                              TrinoError, classify, is_retryable)
+from trino_tpu.exec import LocalQueryRunner
+from trino_tpu.exec.local_planner import ExecutionError
+from trino_tpu.exec.memory import ExceededMemoryLimitError
+from trino_tpu.sql.analyzer import SemanticError
+from trino_tpu.sql.lexer import ParsingError
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch("tiny")
+
+
+# ------------------------------------------------------------- structure
+
+def test_code_families():
+    assert E.GENERIC_USER_ERROR.code == 0
+    assert E.GENERIC_INTERNAL_ERROR.code == 0x10000
+    assert E.GENERIC_INSUFFICIENT_RESOURCES.code == 0x20000
+    assert E.EXCEEDED_TIME_LIMIT.type == E.INSUFFICIENT_RESOURCES
+    assert E.SYNTAX_ERROR.type == E.USER_ERROR
+    assert E.REMOTE_TASK_ERROR.type == E.INTERNAL_ERROR
+
+
+def test_retryable_taxonomy():
+    """Only transient infrastructure failures retry; user/semantic/
+    resource errors never do (the FTE retry predicate)."""
+    assert is_retryable(InjectedFault("boom"))
+    assert is_retryable(ExchangeTransportError("page lost"))
+    assert not is_retryable(SemanticError("no such column"))
+    assert not is_retryable(ParsingError("bad token"))
+    assert not is_retryable(ExceededMemoryLimitError("over limit"))
+    assert not is_retryable(QueryTimeoutError("too slow"))
+    assert not is_retryable(QueryCanceledError("canceled"))
+    assert not is_retryable(ExecutionError("operator bug"))
+    assert not is_retryable(ValueError("random"))
+
+
+def test_engine_errors_carry_names():
+    """The satellite contract: every engine error class IS a TrinoError
+    with a stable name, so nothing surfaces as a bare Python class."""
+    cases = [
+        (SemanticError("x"), "GENERIC_USER_ERROR", "USER_ERROR"),
+        (ParsingError("x"), "SYNTAX_ERROR", "USER_ERROR"),
+        (ExecutionError("x"), "GENERIC_INTERNAL_ERROR", "INTERNAL_ERROR"),
+        (ExceededMemoryLimitError("x"), "EXCEEDED_LOCAL_MEMORY_LIMIT",
+         "INSUFFICIENT_RESOURCES"),
+        (QueryTimeoutError("x"), "EXCEEDED_TIME_LIMIT",
+         "INSUFFICIENT_RESOURCES"),
+        (QueryCanceledError("x"), "USER_CANCELED", "USER_ERROR"),
+        (InjectedFault("x"), "REMOTE_TASK_ERROR", "INTERNAL_ERROR"),
+        (InvalidSessionPropertyError("x"), "INVALID_SESSION_PROPERTY",
+         "USER_ERROR"),
+    ]
+    for exc, name, family in cases:
+        assert isinstance(exc, TrinoError)
+        assert exc.error_name == name
+        assert exc.error_type == family
+        assert classify(exc).name == name
+
+
+def test_classify_foreign_exceptions():
+    assert classify(KeyError("unknown scalar function: f")).name == \
+        "NOT_FOUND"
+    assert classify(ZeroDivisionError()).name == "DIVISION_BY_ZERO"
+    assert classify(RuntimeError("?")).name == "GENERIC_INTERNAL_ERROR"
+
+
+# -------------------------------------------------- raised through engine
+
+def test_parse_error_through_runner(runner):
+    with pytest.raises(ParsingError) as e:
+        runner.execute("SELEC 1")
+    assert e.value.error_name == "SYNTAX_ERROR"
+
+
+def test_semantic_error_through_runner(runner):
+    with pytest.raises(SemanticError) as e:
+        runner.execute("SELECT no_such_col FROM nation")
+    assert e.value.error_name == "GENERIC_USER_ERROR"
+    assert not e.value.retryable
+
+
+def test_invalid_session_property_through_runner(runner):
+    with pytest.raises(InvalidSessionPropertyError) as e:
+        runner.execute("SET SESSION no_such_property = 'x'")
+    assert e.value.error_name == "INVALID_SESSION_PROPERTY"
+    # KeyError-compatible for pre-taxonomy callers
+    assert isinstance(e.value, KeyError)
+    assert "no_such_property" in str(e.value)
+
+
+def test_tracker_records_error_name(runner):
+    try:
+        runner.execute("SELECT * FROM tpch.tiny.missing_table_for_err")
+    except Exception:
+        pass
+    rows = runner.execute(
+        "SELECT error_name FROM system.runtime.queries "
+        "WHERE query LIKE '%missing_table_for_err%' "
+        "AND state = 'FAILED'").rows
+    assert rows and rows[0][0] == "GENERIC_USER_ERROR"

@@ -112,6 +112,13 @@ def test_catalog_schema_headers(server):
 
 
 def test_cancel(server):
+    # occupy the single executor so the victim stays deterministically
+    # QUEUED when the DELETE lands (cancel of a TERMINAL query is a
+    # no-op, reference semantics — racing a bare SELECT 1 would flake)
+    blocker, _ = _post(server, "SELECT count(*) FROM lineitem l1, "
+                               "lineitem l2 WHERE l1.l_orderkey = "
+                               "l2.l_orderkey AND l1.l_partkey = "
+                               "l2.l_partkey")
     payload, _ = _post(server, "SELECT 1")
     uri = payload["nextUri"]
     req = urllib.request.Request(uri, method="DELETE")
@@ -119,6 +126,35 @@ def test_cancel(server):
         assert resp.status == 204
     payload, _ = _get(uri)
     assert payload["stats"]["state"] == "CANCELED"
+    assert payload["error"]["errorCode"] == 3      # USER_CANCELED
+    while "nextUri" in blocker:                    # drain the blocker
+        blocker, _ = _get(blocker["nextUri"])
+    assert blocker["stats"]["state"] == "FINISHED"
+
+
+def test_cancel_finished_query_is_noop(server):
+    """DELETE on a FINISHED query must not destroy access to its
+    buffered results (code-review finding)."""
+    import time
+    payload, _ = _post(server, "SELECT n_nationkey FROM nation")
+    uri = payload["nextUri"]
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        p, _ = _get(uri)
+        if p["stats"]["state"] not in ("QUEUED", "RUNNING"):
+            break
+        time.sleep(0.05)
+    req = urllib.request.Request(uri, method="DELETE")
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 204
+    rows = []
+    p, _ = _get(uri)
+    rows.extend(p.get("data", []))
+    while "nextUri" in p:
+        p, _ = _get(p["nextUri"])
+        rows.extend(p.get("data", []))
+    assert p["stats"]["state"] == "FINISHED"
+    assert len(rows) == 25
 
 
 def test_unknown_query_404(server):
@@ -160,6 +196,124 @@ def test_concurrent_paging_during_long_query(server):
     assert page.get("data") or "nextUri" in page
     th.join(timeout=120)
     assert done["result"][2][0][0] > 0       # long query completed too
+
+
+def test_invalid_token_is_404_not_500(server):
+    """A malformed or negative page token must answer 404, not crash the
+    handler into an HTTP 500 (the _resolve int() fix)."""
+    payload, _ = _post(server, "SELECT 1")
+    base = payload["nextUri"].rsplit("/", 1)[0]
+    for bad in ("abc", "-1", "1x", ""):
+        try:
+            _get(f"{base}/{bad}")
+            assert False, f"expected 404 for token {bad!r}"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404, f"token {bad!r} -> {e.code}"
+    # drain the good query so the module fixture stays clean
+    while "nextUri" in payload:
+        payload, _ = _get(payload["nextUri"])
+
+
+def test_pruned_query_answers_410_gone():
+    """Past the keep bound, a finished query's results are pruned and a
+    late GET answers 410 Gone (retrying is pointless), not a bare 404."""
+    from trino_tpu.exec import LocalQueryRunner
+    srv = TrinoServer(LocalQueryRunner.tpch("tiny"), keep=2).start()
+    try:
+        # finish one query and hold its page-0 URI, then submit enough
+        # queries to push it past the keep bound
+        first, _ = _post(srv, "SELECT 100")
+        first_uri = first["nextUri"]
+        p = first
+        while "nextUri" in p:
+            p, _ = _get(p["nextUri"])
+        for i in range(8):       # push the first query past keep=2
+            run_query(srv, f"SELECT {200 + i}")
+        try:
+            _get(first_uri)
+            assert False, "expected 410"
+        except urllib.error.HTTPError as e:
+            assert e.code == 410
+        # a never-existed id still answers 404
+        try:
+            _get(f"{srv.base_uri}/v1/statement/executing/nope/slug/0")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.stop()
+
+
+def test_cancel_running_query_frees_executor(server):
+    """DELETE on a RUNNING query transitions it to CANCELED at the next
+    cooperative checkpoint and the executor picks up the next queued
+    query (the ISSUE acceptance bar for cancellation)."""
+    import time
+    long_sql = ("SELECT count(*) FROM lineitem l1, lineitem l2, "
+                "lineitem l3 WHERE l1.l_orderkey = l2.l_orderkey "
+                "AND l2.l_orderkey = l3.l_orderkey "
+                "AND l1.l_partkey = l2.l_partkey AND l1.l_tax = l2.l_tax")
+    payload, _ = _post(server, long_sql)
+    uri = payload["nextUri"]
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        p, _ = _get(uri)
+        if p["stats"]["state"] == "RUNNING":
+            break
+        time.sleep(0.05)
+    req = urllib.request.Request(uri, method="DELETE")
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 204
+    p, _ = _get(uri)
+    assert p["stats"]["state"] == "CANCELED"
+    assert p["error"]["errorName"] == "USER_CANCELED"
+    # the executor must come free for the next client promptly even
+    # though the canceled query would have run for much longer
+    _, _, rows, _, _ = run_query(server, "SELECT 41 + 1")
+    assert rows == [[42]]
+    # tracker reflects the cancellation under the server's query id
+    from trino_tpu.exec.query_tracker import TRACKER
+    states = {q.query_id: q.state for q in TRACKER.list()}
+    assert states.get(p["id"]) == "CANCELED"
+
+
+def test_concurrent_submit_poll_cancel_race(server):
+    """N client threads submit/poll/cancel concurrently: no HTTP 500s,
+    every query reaches a terminal state, and the registry (now
+    lock-guarded) never corrupts."""
+    import threading
+
+    N = 8
+    results = [None] * N
+    failures = []
+
+    def client(i):
+        try:
+            sql = f"SELECT n_nationkey + {i} FROM nation"
+            payload, _ = _post(server, sql)
+            if i % 3 == 0:
+                # cancel mid-flight (QUEUED or RUNNING — both legal)
+                req = urllib.request.Request(payload["nextUri"],
+                                             method="DELETE")
+                with urllib.request.urlopen(req) as resp:
+                    assert resp.status == 204
+            while "nextUri" in payload:
+                payload, _ = _get(payload["nextUri"])
+            results[i] = payload["stats"]["state"]
+        except BaseException as e:  # noqa: BLE001
+            failures.append((i, e))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(N)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+    assert not failures, failures
+    assert all(r in ("FINISHED", "CANCELED") for r in results), results
+    # cancels observed as CANCELED or raced to FINISHED; non-cancelled
+    # clients must all have finished
+    assert all(results[i] == "FINISHED" for i in range(N) if i % 3)
 
 
 def test_queue_full_admission(server):

@@ -1,0 +1,137 @@
+"""Chaos runs: TPC-H under fault injection, oracle-verified.
+
+Reference parity: testing/trino-faulttolerant-tests
+(TestFaultTolerantExecution* — TPC queries stay correct under injected
+task failure with RetryPolicy.TASK).
+
+With a FIXED seed the injector's decisions replay exactly, so the green
+runs under retry_policy=TASK and the red run under retry_policy=NONE
+prove retries (not luck) produced the green results.
+
+Named test_zz_* so these sweeps collect LAST: the tier-1 wall budget
+spends on the seed suites first and on chaos afterwards. The full
+distributed sweep (all 22 queries, ~12 min) is marked slow; tier-1 keeps
+one seed over all 22 queries on the local engine plus a cheap
+distributed subset.
+"""
+
+import pytest
+
+from trino_tpu.errors import InjectedFault, is_retryable
+from trino_tpu.exec import LocalQueryRunner
+from trino_tpu.exec.distributed import DistributedQueryRunner
+
+from oracle import assert_same, load_tpch_sqlite
+from tpch_sql import PASSING, QUERIES
+
+CHAOS_SEED = 42
+CHAOS_RATE = 0.2
+
+# tier-1 distributed chaos subset (cheap fragments); the rest of the
+# distributed sweep runs under `slow`
+CHEAP_DIST = ["q1", "q6", "q12", "q14"]
+
+
+def set_chaos(runner, *, seed=CHAOS_SEED, rate=CHAOS_RATE, policy="TASK"):
+    runner.session.set("fault_injection_seed", seed)
+    runner.session.set("fault_injection_rate", rate)
+    runner.session.set("retry_policy", policy)
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    conn = load_tpch_sqlite(0.01)
+    yield conn
+    conn.close()
+
+
+@pytest.fixture(scope="module")
+def chaos_dist():
+    runner = DistributedQueryRunner.tpch("tiny")
+    set_chaos(runner, policy="TASK")
+    return runner
+
+
+@pytest.fixture(scope="module")
+def chaos_local():
+    runner = LocalQueryRunner.tpch("tiny")
+    set_chaos(runner, policy="TASK")
+    return runner
+
+
+@pytest.mark.parametrize("name", PASSING)
+def test_tpch_chaos_local(chaos_local, oracle, name):
+    """One seed over ALL 22 queries in tier-1 (local engine: same retry
+    scopes — plan task, scan and spill sites — at a fraction of the
+    distributed sweep's wall cost)."""
+    sql, oracle_sql, ordered = QUERIES[name]
+    got = chaos_local.execute(sql)
+    expected = oracle.execute(oracle_sql).fetchall()
+    assert_same(got.rows, expected, ordered)
+
+
+@pytest.mark.parametrize("name", CHEAP_DIST)
+def test_tpch_chaos_distributed(chaos_dist, oracle, name):
+    """Seed 42 / rate 0.2 / retry_policy=TASK — fragment-retry chaos on
+    the distributed engine, oracle-verified."""
+    sql, oracle_sql, ordered = QUERIES[name]
+    got = chaos_dist.execute(sql)
+    expected = oracle.execute(oracle_sql).fetchall()
+    assert_same(got.rows, expected, ordered)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", [q for q in PASSING
+                                  if q not in CHEAP_DIST])
+def test_tpch_chaos_distributed_full(chaos_dist, oracle, name):
+    """Acceptance sweep: seed 42 / rate 0.2 / retry_policy=TASK — EVERY
+    TPC-H query oracle-verifies despite injected fragment/exchange/scan
+    faults (verified green in full before being marked slow for the
+    tier-1 wall budget)."""
+    sql, oracle_sql, ordered = QUERIES[name]
+    got = chaos_dist.execute(sql)
+    expected = oracle.execute(oracle_sql).fetchall()
+    assert_same(got.rows, expected, ordered)
+
+
+def test_tpch_chaos_injected_something(chaos_dist, chaos_local):
+    """The green sweeps above must actually have seen faults — otherwise
+    they prove nothing. Cumulative counters live on the runners."""
+    injected = (chaos_local.stats["faults_injected"]
+                + chaos_dist.stats["faults_injected"])
+    retries = chaos_local.stats["retries"] + chaos_dist.stats["retries"]
+    assert injected > 0
+    assert retries >= injected
+
+
+def test_tpch_chaos_retry_none_fails():
+    """Same seed, retry_policy=NONE: the sweep fails with a
+    retryable-classified error — proof the TASK runs' green came from
+    retries, not luck."""
+    runner = DistributedQueryRunner.tpch("tiny")
+    set_chaos(runner, policy="NONE")
+    saw_fault = None
+    for name in PASSING:
+        sql, _, _ = QUERIES[name]
+        try:
+            runner.execute(sql)
+        except InjectedFault as e:
+            saw_fault = e
+            break
+    assert saw_fault is not None
+    assert is_retryable(saw_fault)
+    assert saw_fault.error_name == "REMOTE_TASK_ERROR"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_tpch_chaos_seed_sweep(oracle, seed):
+    """High-iteration chaos: several seeds at a higher rate, local engine
+    (cheaper per query, same retry scopes)."""
+    runner = LocalQueryRunner.tpch("tiny")
+    set_chaos(runner, seed=seed, rate=0.3, policy="TASK")
+    for name in PASSING:
+        sql, oracle_sql, ordered = QUERIES[name]
+        got = runner.execute(sql)
+        expected = oracle.execute(oracle_sql).fetchall()
+        assert_same(got.rows, expected, ordered)

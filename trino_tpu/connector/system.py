@@ -26,7 +26,8 @@ TABLES: Dict[str, tuple] = {
         ("query_id", T.VarcharType()), ("state", T.VarcharType()),
         ("user", T.VarcharType()), ("query", T.VarcharType()),
         ("rows", T.BIGINT), ("wall_ms", T.BIGINT),
-        ("error", T.VarcharType())),
+        ("error", T.VarcharType()), ("error_name", T.VarcharType()),
+        ("retries", T.BIGINT), ("faults_injected", T.BIGINT)),
     "tasks": (
         ("query_id", T.VarcharType()), ("task_id", T.VarcharType()),
         ("state", T.VarcharType()), ("rows", T.BIGINT),
@@ -41,7 +42,8 @@ def _rows_for(table: str) -> List[tuple]:
     from trino_tpu.exec.query_tracker import TRACKER
     if table == "queries":
         return [(q.query_id, q.state, q.user, q.query, q.rows,
-                 q.wall_ms if q.wall_ms is not None else 0, q.error)
+                 q.wall_ms if q.wall_ms is not None else 0, q.error,
+                 q.error_name, q.retries, q.faults_injected)
                 for q in TRACKER.list()]
     if table == "tasks":
         # single-controller engine: one task per query (the mesh's shards

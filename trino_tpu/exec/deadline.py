@@ -1,0 +1,106 @@
+"""Query deadlines + cooperative cancellation.
+
+Reference parity: QueryStateMachine's query_max_run_time /
+query_max_execution_time enforcement (execution/QueryTracker.java
+enforceTimeLimits:183 — run time counts from CREATE i.e. queueing,
+execution time from the start of planning) and cancellation propagation
+(QueryStateMachine.transitionToCanceled walking the stage tree). The
+single-controller engine has no per-stage threads to interrupt, so both
+collapse to ONE object threaded through the runner and checked
+cooperatively at fragment and page-batch boundaries; a device program
+already in flight finishes, but the query stops at the next boundary.
+
+The cancel flag is a threading.Event because it IS crossed by threads: the
+HTTP server's DELETE handler sets it while the executor thread runs the
+query.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from trino_tpu.errors import QueryCanceledError, QueryTimeoutError
+
+_UNITS = {"ns": 1e-9, "us": 1e-6, "ms": 1e-3, "s": 1.0,
+          "m": 60.0, "h": 3600.0, "d": 86400.0}
+
+
+def parse_duration(value) -> Optional[float]:
+    """Trino Duration strings ('30s', '2m', '500ms') or bare numbers
+    (seconds) -> seconds; None/''/0 -> no limit."""
+    if value is None:
+        return None
+    if isinstance(value, (int, float)):
+        return float(value) if value > 0 else None
+    text = str(value).strip().lower()
+    if not text:
+        return None
+    for unit in sorted(_UNITS, key=len, reverse=True):
+        if text.endswith(unit):
+            num = text[: -len(unit)].strip()
+            if num:
+                return float(num) * _UNITS[unit] or None
+    return float(text) or None
+
+
+class QueryDeadline:
+    """Wall-clock limits + cancel flag for one query."""
+
+    def __init__(self, max_run_s: Optional[float] = None,
+                 max_exec_s: Optional[float] = None,
+                 queued_at: Optional[float] = None,
+                 cancel_event: Optional[threading.Event] = None):
+        now = time.monotonic()
+        self._cancel = cancel_event or threading.Event()
+        self.queued_at = queued_at if queued_at is not None else now
+        self.exec_started = now
+        self.max_run_s = max_run_s
+        self.max_exec_s = max_exec_s
+        self._run_deadline = (self.queued_at + max_run_s
+                              if max_run_s else None)
+        self._exec_deadline = now + max_exec_s if max_exec_s else None
+
+    @classmethod
+    def from_session(cls, session, queued_at: Optional[float] = None,
+                     wall_cap_s: Optional[float] = None,
+                     cancel_event: Optional[threading.Event] = None
+                     ) -> "QueryDeadline":
+        """Session-property limits, optionally tightened by a server-side
+        wall cap (the resource-group hard limit analog)."""
+        max_run = parse_duration(session.get("query_max_run_time"))
+        max_exec = parse_duration(session.get("query_max_execution_time"))
+        if wall_cap_s is not None:
+            max_run = (wall_cap_s if max_run is None
+                       else min(max_run, wall_cap_s))
+        return cls(max_run, max_exec, queued_at, cancel_event)
+
+    def cancel(self) -> None:
+        self._cancel.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel.is_set()
+
+    def check(self) -> None:
+        """Cooperative checkpoint: raises if canceled or past a limit."""
+        if self._cancel.is_set():
+            raise QueryCanceledError("Query was canceled by user")
+        now = time.monotonic()
+        if self._run_deadline is not None and now > self._run_deadline:
+            raise QueryTimeoutError(
+                f"Query exceeded maximum run time of "
+                f"{_fmt_s(self.max_run_s)}")
+        if self._exec_deadline is not None and now > self._exec_deadline:
+            raise QueryTimeoutError(
+                f"Query exceeded maximum execution time of "
+                f"{_fmt_s(self.max_exec_s)}")
+
+
+def _fmt_s(seconds: Optional[float]) -> str:
+    if seconds is None:
+        return "?"
+    if seconds >= 1.0 and seconds == int(seconds):
+        return f"{int(seconds)}s"
+    return f"{seconds * 1000:.0f}ms"

@@ -95,7 +95,9 @@ class ShardExecutionPlanner(LocalExecutionPlanner):
 
         def gen():
             for split in mine:
+                self._fault_site("scan", f"{node.table} part {split.part}")
                 for page in conn.page_source.pages(split, columns, cap):
+                    self._checkpoint()
                     if self.device is not None:
                         page = jax.device_put(page, self.device)
                     yield page
@@ -245,13 +247,26 @@ class DistributedQueryRunner(LocalQueryRunner):
     def _execute_query(self, query: t.Query) -> MaterializedResult:
         plan = self._plan_distributed(query)
         frag = fragment_plan(plan)
+        # children schedule (and retry) independently BEFORE the root's
+        # retry scope opens: a root attempt failure re-runs only the root
+        # fragment against the already-materialized exchange inputs
         exchange_inputs = self._schedule_children(frag)
+        return self._retry_task(
+            "fragment-root",
+            lambda: self._root_attempt(frag, plan, exchange_inputs))
+
+    def _root_attempt(self, frag: PlanFragment, plan: OutputNode,
+                      exchange_inputs) -> MaterializedResult:
+        self._check_deadline()
         executor = ShardExecutionPlanner(
             self.metadata, self.session, 0, self.mesh.n, exchange_inputs)
+        executor.faults = self._faults
+        executor.deadline = self._deadline
         root_stream = executor.execute(frag.root)
         types = [s.type for s in plan.symbols]
         rows = []
         for page in root_stream.iter_pages():
+            self._check_deadline()      # page-batch cancellation point
             n = int(page.num_rows)
             if n == 0:
                 continue
@@ -260,6 +275,8 @@ class DistributedQueryRunner(LocalQueryRunner):
             for i in range(n):
                 rows.append(tuple(_to_python(cols[j][i], types[j])
                                   for j in range(len(cols))))
+        if self._faults is not None:
+            self._faults.site("fragment", "root")
         return MaterializedResult(list(plan.column_names), types, rows)
 
     def _plan_distributed(self, query: t.Statement) -> OutputNode:
@@ -278,15 +295,30 @@ class DistributedQueryRunner(LocalQueryRunner):
         for child in reversed(frag.children):
             child_pages = self._run_fragment_to_pages(child)
             remote = _find_remote(frag.root, child.fragment_id)
-            exchange_inputs[child.fragment_id] = self._apply_exchange(
-                child_pages, remote)
+            # the exchange apply is its own retry scope: a transient
+            # collective failure (or injected fault) re-applies the
+            # idempotent collective against the child's buffered output —
+            # the task-output-buffer re-fetch of the reference's retry
+            exchange_inputs[child.fragment_id] = self._retry_task(
+                f"exchange-{child.fragment_id}",
+                lambda p=child_pages, r=remote: self._apply_exchange(p, r))
         return exchange_inputs
 
     def _run_fragment_to_pages(self, frag: PlanFragment
                                ) -> List[Optional[Page]]:
         """Run one non-root fragment on its participating shards; returns one
-        concatenated output Page per shard (None = shard produced nothing)."""
+        concatenated output Page per shard (None = shard produced nothing).
+        The per-shard execution is one retry scope (RetryPolicy.TASK's
+        unit): retryable failures re-run THIS fragment only — its children
+        have already completed their own scopes."""
         exchange_inputs = self._schedule_children(frag)
+        return self._retry_task(
+            f"fragment-{frag.fragment_id}",
+            lambda: self._fragment_attempt(frag, exchange_inputs))
+
+    def _fragment_attempt(self, frag: PlanFragment, exchange_inputs
+                          ) -> List[Optional[Page]]:
+        self._check_deadline()
         shards = [0] if frag.partitioning == "single" else \
             list(range(self.mesh.n))
         # dispatch every shard's pipeline before the batched result sync.
@@ -299,12 +331,17 @@ class DistributedQueryRunner(LocalQueryRunner):
         # Reference: SqlQueryScheduler.java:538 concurrent stage tasks.
         dispatched: List[Tuple[int, ShardExecutionPlanner, list]] = []
         for shard in shards:
+            self._check_deadline()
             executor = ShardExecutionPlanner(
                 self.metadata, self.session, shard, self.mesh.n,
                 exchange_inputs, device=self.mesh.device_of(shard))
+            executor.faults = self._faults
+            executor.deadline = self._deadline
             dispatched.append(
                 (shard, executor, list(executor.execute(frag.root)
                                        .iter_pages())))
+        if self._faults is not None:
+            self._faults.site("fragment", f"fragment-{frag.fragment_id}")
         out: List[Optional[Page]] = [None] * self.mesh.n
         for shard, executor, pages in dispatched:
             out[shard] = executor.merge_counted(pages)
@@ -314,6 +351,9 @@ class DistributedQueryRunner(LocalQueryRunner):
 
     def _apply_exchange(self, child_pages: List[Optional[Page]],
                         remote: RemoteSourceNode) -> List[Optional[Page]]:
+        self._check_deadline()
+        if self._faults is not None:
+            self._faults.site("exchange", f"fragment-{remote.fragment_id}")
         n = self.mesh.n
         ref = next((p for p in child_pages if p is not None), None)
         if ref is None:

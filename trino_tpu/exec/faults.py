@@ -1,0 +1,96 @@
+"""Deterministic fault injection for chaos testing.
+
+Reference parity: the reference engine proves its RetryPolicy.TASK/QUERY
+machinery with induced worker failure
+(testing/trino-faulttolerant-tests FaultTolerantExecutionTest* + the
+exchange-manager failure injection in plugin/trino-exchange-filesystem
+tests); here the same discipline is a seeded in-process harness so chaos
+runs are REPLAYABLE: same seed + same statement sequence = same faults.
+
+Model: each retry scope ("task attempt" — a fragment attempt, an exchange
+apply, the local plan run) draws ONCE from the seeded RNG. With probability
+`fault_injection_rate` the attempt is armed with one named site; execution
+then raises InjectedFault the first time it passes that site. Arming
+per-attempt (not per-call) keeps the failure probability of an attempt
+exactly `rate`, independent of how many splits/pages it processes — the
+same per-task semantics the reference's retry policy reasons about.
+
+Installed via session properties (SystemSessionProperties analogs):
+`fault_injection_rate` (0 disables), `fault_injection_seed`,
+`fault_injection_sites` (comma list; empty = all of SITES).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, Optional, Tuple
+
+from trino_tpu.errors import InjectedFault
+
+SITES = ("fragment", "exchange", "scan", "spill")
+
+
+class FaultInjector:
+    """Per-query seeded chaos source. Single-threaded by construction: the
+    runner executes one query at a time, so draws happen in a
+    deterministic order."""
+
+    def __init__(self, seed: int, rate: float,
+                 sites: Optional[Tuple[str, ...]] = None):
+        self.seed = int(seed)
+        self.rate = float(rate)
+        self.sites = tuple(sites) if sites else SITES
+        self.config = (self.seed, self.rate, self.sites)
+        self._rng = random.Random(self.seed)
+        self._armed: Optional[str] = None
+        self._label: object = None
+        self.draws = 0
+        self.injected = 0
+        self.by_site: Dict[str, int] = {}
+
+    @classmethod
+    def from_session(cls, session) -> Optional["FaultInjector"]:
+        rate = float(session.get("fault_injection_rate"))
+        if rate <= 0.0:
+            return None
+        seed = int(session.get("fault_injection_seed"))
+        raw = str(session.get("fault_injection_sites") or "").strip()
+        sites = tuple(s.strip() for s in raw.split(",") if s.strip()) or None
+        return cls(seed, rate, sites)
+
+    @classmethod
+    def install(cls, session,
+                current: Optional["FaultInjector"]
+                ) -> Optional["FaultInjector"]:
+        """Injector for the NEXT query: keeps `current` (its draw sequence
+        keeps advancing — re-seeding per query would replay the same
+        decisions for every statement) unless the session's chaos config
+        changed, in which case a freshly seeded injector starts the new
+        replayable sequence."""
+        fresh = cls.from_session(session)
+        if fresh is None:
+            return None
+        if current is not None and current.config == fresh.config:
+            return current
+        return fresh
+
+    def begin_task(self, label) -> None:
+        """One retry scope starts: decide whether (and where) it fails."""
+        self.draws += 1
+        self._armed = None
+        self._label = label
+        if self._rng.random() < self.rate:
+            self._armed = self.sites[self._rng.randrange(len(self.sites))]
+
+    def site(self, site: str, detail: str = "") -> None:
+        """Execution passes a named fault site; raises iff armed for it."""
+        if self._armed != site:
+            return
+        self._armed = None
+        self.injected += 1
+        self.by_site[site] = self.by_site.get(site, 0) + 1
+        raise InjectedFault(
+            f"injected fault at {site}"
+            + (f" ({detail})" if detail else "")
+            + f" [task {self._label}, seed {self.seed}, "
+              f"draw {self.draws}]")

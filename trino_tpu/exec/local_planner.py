@@ -28,6 +28,7 @@ import numpy as np
 
 from trino_tpu import types as T
 from trino_tpu.connector.spi import Split
+from trino_tpu.errors import GENERIC_INTERNAL_ERROR, TrinoError
 from trino_tpu.exec.jit_cache import cached_kernel
 from trino_tpu.expr.compiler import compile_expression, compile_filter
 from trino_tpu.expr.ir import (Call, InputRef, Literal, RowExpression,
@@ -45,8 +46,11 @@ from trino_tpu.planner.nodes import (
     WindowNode, TableWriterNode)
 
 
-class ExecutionError(Exception):
-    pass
+class ExecutionError(TrinoError):
+    """Operator-lowering/runtime defect: internal, not retryable (the
+    same plan re-fails identically)."""
+
+    CODE = GENERIC_INTERNAL_ERROR
 
 
 def lower_expr(e: RowExpression, layout: Dict[str, int],
@@ -159,6 +163,19 @@ class LocalExecutionPlanner:
         from trino_tpu.exec.memory import QueryMemoryContext
         self.memory = QueryMemoryContext(
             int(session.get("query_max_memory")))
+        # fault-tolerance wiring (exec/faults.py + exec/deadline.py),
+        # installed by the owning runner; None = no chaos / no limits
+        self.faults = None
+        self.deadline = None
+
+    def _checkpoint(self) -> None:
+        """Cooperative cancellation/deadline point (page-batch boundary)."""
+        if self.deadline is not None:
+            self.deadline.check()
+
+    def _fault_site(self, site: str, detail: str = "") -> None:
+        if self.faults is not None:
+            self.faults.site(site, detail)
 
     # ------------------------------------------------------------ dispatch
 
@@ -207,7 +224,10 @@ class LocalExecutionPlanner:
 
         def gen():
             for split in splits:
-                yield from conn.page_source.pages(split, columns, cap)
+                self._fault_site("scan", str(node.table))
+                for page in conn.page_source.pages(split, columns, cap):
+                    self._checkpoint()
+                    yield page
         return PageStream(gen(), tuple(s for s, _ in node.assignments))
 
     def _scan_capacity(self, conn, node: TableScanNode) -> int:
@@ -586,6 +606,7 @@ class LocalExecutionPlanner:
 
             def spill(combined):
                 nonlocal store, part_op
+                self._fault_site("spill", "agg")
                 if store is None:
                     store = HostPartitionStore(npart)
                     part_op = cached_kernel(
@@ -595,6 +616,7 @@ class LocalExecutionPlanner:
                 store.spill_partitioned(sorted_pg, jax.device_get(counts))
 
             for page in src.pages:
+                self._checkpoint()
                 any_pages = True
                 pp = partial_op(page)
                 buf.append(pp)
@@ -705,6 +727,7 @@ class LocalExecutionPlanner:
 
             def flush():
                 nonlocal store, bounds, part_op, buf, buf_bytes
+                self._fault_site("spill", "sort")
                 merged = self.merge_counted(buf)
                 buf, buf_bytes = [], 0
                 if merged is None:
@@ -729,6 +752,7 @@ class LocalExecutionPlanner:
                 store.spill_partitioned(sorted_pg, jax.device_get(counts))
 
             for page in src.iter_pages():
+                self._checkpoint()
                 buf.append(page)
                 buf_bytes += page_bytes(page)
                 if spillable and buf_bytes >= threshold:
@@ -945,6 +969,7 @@ class LocalExecutionPlanner:
                                         prepare_build_spilled,
                                         spilled_dense_probe,
                                         spilled_unique_probe)
+        self._fault_site("spill", "join-build")
         # varchar join keys compare by per-dictionary code — the spilled
         # probe never sees the build dictionaries, so it cannot apply the
         # shared-dictionary guard the in-memory kernels enforce; route

@@ -17,11 +17,39 @@ scopes call free()).
 
 from __future__ import annotations
 
+import contextlib
 from typing import Dict, Optional
 
+from trino_tpu.errors import EXCEEDED_LOCAL_MEMORY_LIMIT, TrinoError
 
-class ExceededMemoryLimitError(RuntimeError):
-    """io.trino.ExceededMemoryLimitException analog."""
+
+class ExceededMemoryLimitError(TrinoError, RuntimeError):
+    """io.trino.ExceededMemoryLimitException analog (RuntimeError kept in
+    the bases for pre-taxonomy callers)."""
+
+    CODE = EXCEEDED_LOCAL_MEMORY_LIMIT
+
+
+@contextlib.contextmanager
+def degrade_to_spill(session):
+    """Graceful degradation for a fragment retry after an
+    ExceededMemoryLimitError: force the spill path on and pull every spill
+    threshold under the memory limit, so blocking operators flush to host
+    partitions instead of materializing over-limit device pages
+    (TaskExecutor's revoke-memory-then-retry analog). Restores the
+    session's property bag on exit."""
+    saved = dict(session.properties)
+    limit = int(session.get("query_max_memory"))
+    threshold = max(1, limit // 4)
+    session.properties["spill_enabled"] = True
+    for prop in ("join_spill_threshold_bytes", "agg_spill_threshold_bytes",
+                 "sort_spill_threshold_bytes"):
+        session.properties[prop] = min(int(session.get(prop)), threshold)
+    try:
+        yield
+    finally:
+        session.properties.clear()
+        session.properties.update(saved)
 
 
 def _fmt_bytes(n: int) -> str:

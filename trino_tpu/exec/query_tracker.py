@@ -2,11 +2,13 @@
 
 Reference parity: execution/QueryTracker.java + QueryStateMachine.java —
 every statement entering a runner is registered with a monotonically
-assigned id and walks QUEUED -> RUNNING -> FINISHED | FAILED, carrying the
-stats rollup (row count, wall time, error) that system.runtime.queries and
-the HTTP server surface. The reference's CAS state machine with listeners
-collapses to a lock-guarded registry: execution here is synchronous per
-query (the mesh, not threads, is the concurrency), so states never race.
+assigned id and walks QUEUED -> RUNNING -> FINISHED | FAILED | CANCELED,
+carrying the stats rollup (row count, wall time, error name, retry/fault
+counters) that system.runtime.queries and the HTTP server surface. The
+reference's CAS state machine with listeners collapses to a lock-guarded
+registry; transitions can now arrive from two threads (the server's
+executor runs the query while an HTTP thread cancels it), so every
+mutation takes the registry lock.
 """
 
 from __future__ import annotations
@@ -21,6 +23,9 @@ QUEUED = "QUEUED"
 RUNNING = "RUNNING"
 FINISHED = "FINISHED"
 FAILED = "FAILED"
+CANCELED = "CANCELED"
+
+TERMINAL = (FINISHED, FAILED, CANCELED)
 
 
 @dataclasses.dataclass
@@ -34,6 +39,9 @@ class QueryInfo:
     ended: Optional[float] = None
     rows: int = 0
     error: Optional[str] = None
+    error_name: Optional[str] = None
+    retries: int = 0
+    faults_injected: int = 0
 
     @property
     def wall_ms(self) -> Optional[int]:
@@ -53,31 +61,51 @@ class QueryTracker:
     def begin(self, sql: str, user: str = "user",
               query_id: Optional[str] = None) -> QueryInfo:
         with self._lock:
+            if query_id is not None and query_id in self._queries:
+                # the HTTP server pre-registers at submit (QUEUED); the
+                # runner's begin then adopts that entry instead of
+                # double-counting the query
+                return self._queries[query_id]
             qid = query_id or f"{time.strftime('%Y%m%d')}_{next(self._seq):06d}"
             info = QueryInfo(qid, QUEUED, user, sql, time.monotonic())
             self._queries[qid] = info
             # bound the registry (QueryTracker prunes expired queries)
             while len(self._queries) > self._keep:
                 done = next((k for k, v in self._queries.items()
-                             if v.state in (FINISHED, FAILED)), None)
+                             if v.state in TERMINAL), None)
                 if done is None:
                     break
                 del self._queries[done]
             return info
 
     def running(self, info: QueryInfo) -> None:
-        info.state = RUNNING
-        info.started = time.monotonic()
+        with self._lock:
+            info.state = RUNNING
+            info.started = time.monotonic()
 
     def finish(self, info: QueryInfo, rows: int) -> None:
-        info.rows = rows
-        info.ended = time.monotonic()
-        info.state = FINISHED
+        with self._lock:
+            info.rows = rows
+            info.ended = time.monotonic()
+            info.state = FINISHED
 
-    def fail(self, info: QueryInfo, error: str) -> None:
-        info.error = error
-        info.ended = time.monotonic()
-        info.state = FAILED
+    def fail(self, info: QueryInfo, error: str,
+             error_name: Optional[str] = None) -> None:
+        with self._lock:
+            info.error = error
+            info.error_name = error_name
+            info.ended = time.monotonic()
+            info.state = FAILED
+
+    def cancel(self, info: QueryInfo,
+               reason: str = "Query was canceled by user") -> None:
+        with self._lock:
+            if info.state in TERMINAL:
+                return        # cancel raced a finish: first writer wins
+            info.error = reason
+            info.error_name = "USER_CANCELED"
+            info.ended = time.monotonic()
+            info.state = CANCELED
 
     def list(self) -> List[QueryInfo]:
         with self._lock:

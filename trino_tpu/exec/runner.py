@@ -79,6 +79,15 @@ class LocalQueryRunner:
         self.metadata = Metadata(self.catalogs)
         self.session = session or Session()
         self._prepared = {}
+        # per-query fault-tolerance state (set in execute, read by the
+        # execution paths; one query at a time per runner)
+        self._deadline = None
+        self._faults = None
+        self._retries = 0
+        # cumulative counters across the runner's lifetime (bench.py
+        # emits these alongside timings) + the last query's snapshot
+        self.stats = {"retries": 0, "faults_injected": 0}
+        self.last_query_stats = {"retries": 0, "faults_injected": 0}
 
     @classmethod
     def tpch(cls, schema: str = "tiny") -> "LocalQueryRunner":
@@ -95,24 +104,148 @@ class LocalQueryRunner:
 
     # ------------------------------------------------------------- execute
 
-    def execute(self, sql: str) -> MaterializedResult:
+    def execute(self, sql: str, *, query_id: Optional[str] = None,
+                queued_at: Optional[float] = None,
+                wall_cap_s: Optional[float] = None,
+                cancel_event=None) -> MaterializedResult:
         """Run one statement through the query lifecycle registry
-        (QueryStateMachine analog): QUEUED -> RUNNING -> FINISHED/FAILED,
-        visible in system.runtime.queries while executing and after."""
+        (QueryStateMachine analog): QUEUED -> RUNNING ->
+        FINISHED/FAILED/CANCELED, visible in system.runtime.queries while
+        executing and after. Builds the query's fault-tolerance state: a
+        QueryDeadline (query_max_run_time/query_max_execution_time +
+        `wall_cap_s`, the server's per-query hard cap; `cancel_event`
+        lets the HTTP DELETE handler cancel cooperatively), the seeded
+        FaultInjector when chaos is on, and the retry loop for
+        retry_policy=QUERY (fragment-level TASK retry lives in the
+        execution paths)."""
+        from trino_tpu.errors import (QueryCanceledError, classify,
+                                      is_retryable)
+        from trino_tpu.exec.deadline import QueryDeadline
+        from trino_tpu.exec.faults import FaultInjector
         from trino_tpu.exec.query_tracker import TRACKER
-        info = TRACKER.begin(sql, user=self.session.user)
+        info = TRACKER.begin(sql, user=self.session.user, query_id=query_id)
+        self._retries = 0
         TRACKER.running(info)
         try:
+            # fault-tolerance setup INSIDE the try: a malformed session
+            # property value must fail the tracker entry (terminal state,
+            # prunable), not leave a phantom RUNNING row
+            try:
+                self._deadline = QueryDeadline.from_session(
+                    self.session, queued_at=queued_at,
+                    wall_cap_s=wall_cap_s, cancel_event=cancel_event)
+                self._faults = FaultInjector.install(self.session,
+                                                     self._faults)
+                policy = str(self.session.get("retry_policy")).upper()
+                attempts = max(1, int(self.session.get("retry_attempts"))) \
+                    if policy == "QUERY" else 1
+            except (TypeError, ValueError) as e:
+                from trino_tpu.errors import InvalidSessionPropertyError
+                raise InvalidSessionPropertyError(
+                    f"invalid session property value: {e}") from e
             stmt = parse_statement(sql)
-            result = self._execute_statement(stmt)
+            attempt = 0
+            while True:
+                attempt += 1
+                try:
+                    result = self._execute_statement(stmt)
+                    break
+                except Exception as e:
+                    if attempt >= attempts or not is_retryable(e):
+                        raise
+                    self._retries += 1
+                    self._backoff(attempt)
         except BaseException as e:
             # BaseException too: a KeyboardInterrupt/SystemExit escaping
             # mid-query must not leave a forever-RUNNING phantom row in
             # system.runtime.queries
-            TRACKER.fail(info, f"{type(e).__name__}: {e}")
+            self._finish_query_stats(info)
+            if isinstance(e, QueryCanceledError):
+                TRACKER.cancel(info, str(e))
+            else:
+                TRACKER.fail(info, f"{type(e).__name__}: {e}",
+                             error_name=classify(e).name)
             raise
+        finally:
+            self._deadline = None
+        self._finish_query_stats(info)
         TRACKER.finish(info, len(result.rows))
         return result
+
+    def cancel_current(self) -> None:
+        """Cancel the in-flight query (no-op when idle): sets the cancel
+        flag; the executing thread raises QueryCanceledError at its next
+        cooperative checkpoint."""
+        deadline = self._deadline
+        if deadline is not None:
+            deadline.cancel()
+
+    def _finish_query_stats(self, info) -> None:
+        faults = self._faults.injected if self._faults else 0
+        info.retries = self._retries
+        info.faults_injected = faults
+        self.last_query_stats = {"retries": self._retries,
+                                 "faults_injected": faults}
+        self.stats["retries"] += self._retries
+        self.stats["faults_injected"] += faults
+        if self._faults is not None:
+            # reset at query END (not start): a next-query setup failure
+            # then reads 0 instead of double-counting this query's faults
+            self._faults.injected = 0
+            self._faults.by_site.clear()
+
+    def _backoff(self, attempt: int) -> None:
+        """Exponential backoff + jitter between retry attempts
+        (fault-tolerant execution's RetryPolicy backoff)."""
+        import random
+        import time as _time
+        initial = float(self.session.get("retry_initial_delay_ms")) / 1e3
+        cap = float(self.session.get("retry_max_delay_ms")) / 1e3
+        delay = min(cap, initial * (2 ** (attempt - 1)))
+        _time.sleep(delay * random.uniform(0.5, 1.0))
+
+    def _check_deadline(self) -> None:
+        if self._deadline is not None:
+            self._deadline.check()
+
+    def _retry_task(self, label: str, fn):
+        """Run one retry scope ('task': a fragment attempt, an exchange
+        apply, the local plan run) under the session's retry policy.
+        Retryable errors (errors.is_retryable: injected faults, exchange
+        transport) re-run the task up to retry_attempts times with
+        backoff under retry_policy=TASK; an ExceededMemoryLimitError gets
+        ONE re-run with the spill path forced on (graceful degradation)
+        when any retry policy is active; everything else propagates.
+        Each attempt is also a fault-injection scope (faults.begin_task),
+        so chaos arms at most one site per attempt."""
+        from trino_tpu.errors import is_retryable
+        from trino_tpu.exec.memory import (ExceededMemoryLimitError,
+                                           degrade_to_spill)
+        policy = str(self.session.get("retry_policy")).upper()
+        attempts = max(1, int(self.session.get("retry_attempts"))) \
+            if policy == "TASK" else 1
+        spill_forced = False
+        attempt = 0
+        while True:
+            attempt += 1
+            if self._faults is not None:
+                self._faults.begin_task((label, attempt))
+            try:
+                if spill_forced:
+                    with degrade_to_spill(self.session):
+                        return fn()
+                return fn()
+            except ExceededMemoryLimitError:
+                if spill_forced or policy == "NONE":
+                    raise
+                spill_forced = True
+                attempt -= 1          # the degrade re-run is free
+                self._retries += 1
+            except Exception as e:
+                if attempt >= attempts or not is_retryable(e):
+                    raise
+                self._retries += 1
+                self._backoff(attempt)
 
     def _execute_statement(self, stmt: t.Statement) -> MaterializedResult:
         if isinstance(stmt, t.Query):
@@ -183,11 +316,29 @@ class LocalQueryRunner:
         return self._run_plan(plan)
 
     def _run_plan(self, plan: OutputNode) -> MaterializedResult:
+        # the whole local plan is ONE retry scope (a single-fragment
+        # "task"): retryable failures re-run it under retry_policy=TASK,
+        # and an over-memory failure re-runs once with spill forced.
+        # Write plans are exempt: re-running a TableWriterNode would
+        # double-write (the reference's FTE requires connector support
+        # for write retry — this engine's memory connector has none)
+        if _contains_writer(plan):
+            self._check_deadline()
+            return self._run_plan_attempt(plan, chaos=False)
+        return self._retry_task("local-plan",
+                                lambda: self._run_plan_attempt(plan))
+
+    def _run_plan_attempt(self, plan: OutputNode,
+                          chaos: bool = True) -> MaterializedResult:
+        self._check_deadline()
         executor = LocalExecutionPlanner(self.metadata, self.session)
+        executor.faults = self._faults if chaos else None
+        executor.deadline = self._deadline
         stream = executor.execute(plan)
         types = [s.type for s in plan.symbols]
         rows: List[Tuple[Any, ...]] = []
         for page in stream.iter_pages():
+            self._check_deadline()      # page-batch cancellation point
             n = int(page.num_rows)
             if n == 0:
                 continue
@@ -196,6 +347,8 @@ class LocalQueryRunner:
                 rows.append(tuple(
                     _to_python(cols[j][i], types[j])
                     for j in range(len(cols))))
+        if chaos and self._faults is not None:
+            self._faults.site("fragment", "local-plan")
         return MaterializedResult(list(plan.column_names), types, rows)
 
     # --------------------------------------------------------------- DDL
@@ -348,6 +501,12 @@ class LocalQueryRunner:
         return MaterializedResult(
             ["Column", "Type"], [T.VARCHAR, T.VARCHAR],
             [(c.name, c.type.display()) for c in meta.columns])
+
+
+def _contains_writer(node) -> bool:
+    if isinstance(node, TableWriterNode):
+        return True
+    return any(_contains_writer(s) for s in node.sources)
 
 
 def _literal_value(e: t.Expression):

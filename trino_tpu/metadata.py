@@ -49,6 +49,25 @@ SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     # sort spill: buffered input over this flushes as host runs, finished
     # by range partitions of the leading sort key
     "sort_spill_threshold_bytes": 2 << 30,
+    # fault-tolerant execution (RetryPolicy / SystemSessionProperties
+    # retry_policy + task_retry_attempts_per_task analogs): TASK retries
+    # individual fragments, QUERY re-runs the whole statement, NONE fails
+    # fast. Backoff is exponential with jitter between attempts.
+    "retry_policy": "NONE",            # NONE | TASK | QUERY
+    "retry_attempts": 4,
+    "retry_initial_delay_ms": 10,
+    "retry_max_delay_ms": 1000,
+    # chaos harness (exec/faults.py): rate > 0 arms a seeded injector per
+    # query; sites is a comma list drawn from fragment,exchange,scan,spill
+    # (empty = all). Same seed + same statements = same faults.
+    "fault_injection_rate": 0.0,
+    "fault_injection_seed": 0,
+    "fault_injection_sites": "",
+    # deadlines (QueryTracker.enforceTimeLimits analogs): Trino Duration
+    # strings ('30s', '2m', '500ms') or bare seconds; empty = unlimited.
+    # run time counts from queueing, execution time from planning start.
+    "query_max_run_time": "",
+    "query_max_execution_time": "",
 }
 
 
@@ -73,12 +92,16 @@ class Session:
         if prop in self.properties:
             return self.properties[prop]
         if prop not in SESSION_PROPERTY_DEFAULTS:
-            raise KeyError(f"unknown session property: {prop}")
+            from trino_tpu.errors import InvalidSessionPropertyError
+            raise InvalidSessionPropertyError(
+                f"unknown session property: {prop}")
         return SESSION_PROPERTY_DEFAULTS[prop]
 
     def set(self, prop: str, value: Any):
         if prop not in SESSION_PROPERTY_DEFAULTS:
-            raise KeyError(f"unknown session property: {prop}")
+            from trino_tpu.errors import InvalidSessionPropertyError
+            raise InvalidSessionPropertyError(
+                f"unknown session property: {prop}")
         self.properties[prop] = value
 
 

@@ -12,12 +12,20 @@ Dispatch model (round 5): queries QUEUE (FIFO) and ONE dedicated executor
 thread drains them — the single-controller JAX process can only run one
 device program at a time, so max_running=1 is the honest resource-group
 shape — while HTTP threads page any FINISHED query's buffered results
-concurrently. A long-running query therefore never blocks another
-client's result paging, and a GET on a still-queued/running query returns
-its state with the same nextUri (the polling contract the stock CLI
-implements). Admission control: the queue is bounded
+concurrently. Admission control: the queue is bounded
 (`max_queued_queries`) and an over-limit submit fails with
 QUERY_QUEUE_FULL, the InternalResourceGroup.canQueueMore analog.
+
+Fault tolerance (round 6): the registry is lock-guarded (HTTP threads and
+the executor mutate it concurrently) and pruned past `keep` terminal
+queries (a pruned id answers 410 Gone, not 404). Every query registers in
+the process-wide TRACKER under its server id, so system.runtime.queries
+reflects server traffic. DELETE on a RUNNING query sets its cancel event;
+the runner observes it at the next cooperative checkpoint
+(exec/deadline.py), transitions the query to CANCELED, and frees the
+executor for the next queued query. `query_timeout_s` is the per-query
+wall-clock cap (resource-group hard limit analog): one hung query fails
+with EXCEEDED_TIME_LIMIT instead of wedging the queue forever.
 """
 
 from __future__ import annotations
@@ -32,6 +40,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
+from trino_tpu.errors import QueryCanceledError
 from trino_tpu.exec.runner import MaterializedResult
 from trino_tpu.server import protocol
 
@@ -56,20 +65,33 @@ class _Query:
         self.set_session: Optional[tuple] = None
         self.clear_session: Optional[str] = None
         self.cancelled = False
+        # crossed by threads: DELETE (HTTP) sets it, the runner's
+        # cooperative checkpoints (executor thread) observe it
+        self.cancel_event = threading.Event()
+        self.info = None               # QueryTracker entry
         self.started = time.monotonic()
 
     @property
     def elapsed_ms(self) -> int:
         return int((time.monotonic() - self.started) * 1000)
 
+    @property
+    def done(self) -> bool:
+        return self.state in ("FINISHED", "FAILED", "CANCELED")
+
 
 class TrinoServer:
     """Wire-compatible statement server wrapping a query runner."""
 
     def __init__(self, runner, host: str = "127.0.0.1", port: int = 0,
-                 max_queued: int = 200):
+                 max_queued: int = 200, keep: int = 200,
+                 query_timeout_s: Optional[float] = None):
         self.runner = runner
+        self.keep = keep
+        self.query_timeout_s = query_timeout_s
+        self._lock = threading.Lock()
         self._queries: Dict[str, _Query] = {}
+        self._pruned: Dict[str, None] = {}   # ordered set of purged ids
         self._seq = itertools.count(1)
         self._queue: "queue_mod.Queue[Optional[_Query]]" = \
             queue_mod.Queue(maxsize=max_queued)
@@ -111,39 +133,66 @@ class TrinoServer:
     def _submit(self, sql: str, headers) -> _Query:
         """Admit + enqueue (DispatchManager.createQuery analog): returns
         immediately with the QUEUED query; the executor thread runs it."""
+        from trino_tpu.exec.query_tracker import TRACKER
         day = time.strftime("%Y%m%d")
         qid = f"{day}_{next(self._seq):06d}_{uuid.uuid4().hex[:5]}"
         # lower-cased snapshot: header lookup must stay case-insensitive
         # after leaving the email.Message (HTTP header names are)
         q = _Query(qid, uuid.uuid4().hex[:12], sql,
                    {k.lower(): v for k, v in headers.items()})
-        self._queries[qid] = q
+        user = q.headers.get("x-trino-user", "user")
+        q.info = TRACKER.begin(sql, user=user, query_id=qid)
+        with self._lock:
+            self._queries[qid] = q
+            self._prune_locked()
         try:
             self._queue.put_nowait(q)
         except queue_mod.Full:
             q.state = "FAILED"
             q.error = protocol.error_json(
-                "Too many queued queries", error_name="QUERY_QUEUE_FULL")
+                "Too many queued queries", error_name="QUERY_QUEUE_FULL",
+                error_code=131074, error_type="INSUFFICIENT_RESOURCES")
+            TRACKER.fail(q.info, "Too many queued queries",
+                         error_name="QUERY_QUEUE_FULL")
         return q
+
+    def _prune_locked(self) -> None:
+        """Bound the paging registry (QueryTracker expiry analog): drop
+        the oldest terminal queries past `keep`, remembering their ids so
+        a late GET answers 410 Gone instead of 404."""
+        if len(self._queries) <= self.keep:
+            return
+        for qid in list(self._queries):
+            if len(self._queries) <= self.keep:
+                break
+            if self._queries[qid].done:
+                del self._queries[qid]
+                self._pruned[qid] = None
+        while len(self._pruned) > 5 * self.keep:
+            self._pruned.pop(next(iter(self._pruned)))
 
     def _drain(self) -> None:
         """Executor loop: one query at a time against the single-controller
         runner; paging of finished queries proceeds on HTTP threads."""
+        from trino_tpu.exec.query_tracker import TRACKER
         while True:
             q = self._queue.get()
             if q is None:
                 return
             if q.cancelled:
                 q.state = "CANCELED"
+                TRACKER.cancel(q.info)
                 continue
             q.state = "RUNNING"
             try:
                 self._execute(q)
-                q.state = "FAILED" if q.error is not None else "FINISHED"
+                if q.cancelled and q.result is None:
+                    q.state = "CANCELED"
+                else:
+                    q.state = "FAILED" if q.error is not None \
+                        else "FINISHED"
             except BaseException as e:  # noqa: BLE001 — keep draining
-                q.error = protocol.error_json(
-                    f"{type(e).__name__}: {e}",
-                    error_name=type(e).__name__.upper())
+                q.error = protocol.error_from_exception(e)
                 q.state = "FAILED"
 
     def _execute(self, q: _Query) -> None:
@@ -179,7 +228,15 @@ class TrinoServer:
                 except Exception:
                     pass
             try:
-                result = self.runner.execute(q.sql)
+                # the runner builds the query's deadline AFTER the session
+                # overrides apply (so header-sent limits bind), from the
+                # submit time (query_max_run_time counts queueing) capped
+                # by the server's per-query wall-clock limit, and adopts
+                # q.cancel_event so DELETE cancels cooperatively
+                result = self.runner.execute(
+                    q.sql, query_id=q.query_id, queued_at=q.started,
+                    wall_cap_s=self.query_timeout_s,
+                    cancel_event=q.cancel_event)
             finally:
                 session.properties.clear()
                 session.properties.update(saved_props)
@@ -196,10 +253,10 @@ class TrinoServer:
             # q.result must also see update_type/set_session (else the
             # X-Trino-Set-Session header is lost)
             q.result = result
+        except QueryCanceledError:
+            q.cancelled = True         # surfaces as CANCELED, not FAILED
         except Exception as e:  # surface as QueryError, not HTTP 500
-            q.error = protocol.error_json(
-                f"{type(e).__name__}: {e}",
-                error_name=type(e).__name__.upper())
+            q.error = protocol.error_from_exception(e)
         finally:
             session.catalog, session.schema = saved
 
@@ -214,11 +271,15 @@ class TrinoServer:
             return protocol.query_results(
                 q.query_id, self.base_uri, state="FAILED", error=q.error,
                 elapsed_ms=q.elapsed_ms)
-        if q.cancelled:
+        # a materialized result outranks a cancel flag: the query beat the
+        # cancel to the finish line, so its buffered pages stay servable
+        # (the reference treats cancel of a terminal query as a no-op)
+        if q.cancelled and q.result is None:
             return protocol.query_results(
                 q.query_id, self.base_uri, state="CANCELED",
-                error=protocol.error_json("Query was canceled",
-                                          "USER_CANCELED"),
+                error=protocol.error_json(
+                    "Query was canceled", error_name="USER_CANCELED",
+                    error_code=3, error_type="USER_ERROR"),
                 elapsed_ms=q.elapsed_ms)
         if q.result is None:
             # still queued/running: same token again (client poll loop)
@@ -294,7 +355,15 @@ class TrinoServer:
                 q, _ = self._resolve()
                 if q is None:
                     return
-                q.cancelled = True
+                if not q.done:
+                    # cancel of a terminal query is a no-op (reference
+                    # semantics); otherwise the runner observes the
+                    # event at its next cooperative checkpoint — no
+                    # current-query bookkeeping race: if the executor
+                    # picks this query up LATER, the already-set event
+                    # cancels it at its first checkpoint
+                    q.cancelled = True
+                    q.cancel_event.set()
                 self.send_response(204)
                 self.send_header("Content-Length", "0")
                 self.end_headers()
@@ -306,10 +375,29 @@ class TrinoServer:
                                                     "executing"]:
                     self.send_error(404)
                     return None, 0
-                q = server._queries.get(parts[3])
-                if q is None or q.slug != parts[4]:
+                qid, slug, token_str = parts[3], parts[4], parts[5]
+                with server._lock:
+                    q = server._queries.get(qid)
+                    purged = qid in server._pruned
+                if q is None:
+                    if purged:
+                        # the query existed but its results were pruned:
+                        # 410 tells the client retrying is pointless
+                        self.send_error(410, "Query results purged")
+                    else:
+                        self.send_error(404, "Query not found")
+                    return None, 0
+                if q.slug != slug:
                     self.send_error(404, "Query not found")
                     return None, 0
-                return q, int(parts[5])
+                try:
+                    token = int(token_str)
+                except ValueError:
+                    self.send_error(404, "Invalid page token")
+                    return None, 0
+                if token < 0:
+                    self.send_error(404, "Invalid page token")
+                    return None, 0
+                return q, token
 
         return Handler

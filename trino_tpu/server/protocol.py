@@ -82,6 +82,18 @@ def error_json(message: str, error_name: str = "GENERIC_USER_ERROR",
     }
 
 
+def error_from_exception(exc: BaseException) -> Dict[str, Any]:
+    """QueryError from the engine taxonomy (trino_tpu/errors.py): the
+    wire errorName/errorCode/errorType come from classify, so the client
+    sees EXCEEDED_TIME_LIMIT / USER_CANCELED / SYNTAX_ERROR instead of a
+    Python class name."""
+    from trino_tpu.errors import classify
+    code = classify(exc)
+    return error_json(f"{type(exc).__name__}: {exc}",
+                      error_name=code.name, error_code=code.code,
+                      error_type=code.type)
+
+
 def stats_json(state: str, *, queued: bool = False, done: bool = False,
                rows: int = 0, elapsed_ms: int = 0) -> Dict[str, Any]:
     """StatementStats.java — the CLI renders progress from these fields."""

@@ -19,10 +19,14 @@ import dataclasses
 from typing import Optional, Sequence, Tuple
 
 from trino_tpu import types as T
+from trino_tpu.errors import GENERIC_USER_ERROR, TrinoError
 
 
-class SemanticError(Exception):
-    pass
+class SemanticError(TrinoError):
+    """Analysis-time user error: never retryable (re-running the same
+    statement re-fails the same way — the FTE non-retryable class)."""
+
+    CODE = GENERIC_USER_ERROR
 
 
 @dataclasses.dataclass(frozen=True)

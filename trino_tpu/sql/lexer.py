@@ -12,7 +12,12 @@ import re
 from typing import List
 
 
-class ParsingError(Exception):
+from trino_tpu.errors import SYNTAX_ERROR, TrinoError
+
+
+class ParsingError(TrinoError):
+    CODE = SYNTAX_ERROR
+
     def __init__(self, message: str, line: int = 0, column: int = 0):
         super().__init__(f"line {line}:{column}: {message}")
         self.message = message
