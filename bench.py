@@ -267,51 +267,74 @@ def _time_query(runner, sql, iters=3):
 
 
 def main():
-    import trino_tpu
-    # persistent compile cache: repeat driver rounds skip XLA recompiles
-    trino_tpu.enable_persistent_cache()
-
-    from trino_tpu.connector.tpch import table_row_count
-    from trino_tpu.exec import LocalQueryRunner
-
+    """Always emits exactly one final JSON line: a backend-init or rung
+    failure lands in an `"error"` field (value stays null) instead of a
+    bare rc=1 with nothing to parse — the perf trajectory must never
+    have a silent hole."""
     extra = {}
-    sf1 = LocalQueryRunner.tpch("sf1")
-    q6 = _time_query(sf1, Q6)
-    q1 = _time_query(sf1, Q1)
-    extra["tpch_q1_sf1_wall_s"] = round(q1, 4)
-    extra["tpch_q1_sf1_vs_baseline"] = round(BASE_Q1_SF1_S / q1, 3)
+    q6 = None
+    error = None
+    try:
+        import trino_tpu
+        # persistent compile cache: repeat rounds skip XLA recompiles
+        trino_tpu.enable_persistent_cache()
 
-    sf10 = LocalQueryRunner.tpch("sf10")
-    q3 = _time_query(sf10, Q3)
-    extra["tpch_q3_sf10_wall_s"] = round(q3, 4)
-    extra["tpch_q3_sf10_vs_baseline"] = round(BASE_Q3_SF10_S / q3, 3)
+        from trino_tpu.connector.tpch import table_row_count
+        from trino_tpu.exec import LocalQueryRunner
 
-    # BASELINE metric: hash-join probe rows/sec/chip (60M-row lineitem
-    # probe into a unique 15M-row orders build)
-    probe_rows = table_row_count("lineitem", 10.0)
-    jm = _time_query(sf10, JOIN_MICRO, iters=2)
-    extra["hash_join_probe_rows_per_s_per_chip"] = round(probe_rows / jm)
-    extra["hash_join_vs_baseline"] = round(
-        (probe_rows / jm) / BASE_JOIN_ROWS_PER_S, 3)
+        sf1 = LocalQueryRunner.tpch("sf1")
+        q6 = _time_query(sf1, Q6)
+        q1 = _time_query(sf1, Q1)
+        extra["tpch_q1_sf1_wall_s"] = round(q1, 4)
+        extra["tpch_q1_sf1_vs_baseline"] = round(BASE_Q1_SF1_S / q1, 3)
 
-    if os.environ.get("TRINO_TPU_BENCH_SF100", "1") != "0":
-        for tag, (base, _, _) in SF100_RUNGS.items():
-            _run_rung_subprocess(extra, tag, base)
+        sf10 = LocalQueryRunner.tpch("sf10")
+        q3 = _time_query(sf10, Q3)
+        extra["tpch_q3_sf10_wall_s"] = round(q3, 4)
+        extra["tpch_q3_sf10_vs_baseline"] = round(BASE_Q3_SF10_S / q3, 3)
 
-    # fault-tolerance counters (round 6): nonzero retries on a clean
-    # bench mean the engine degraded (memory-forced spill re-runs) —
-    # surfaced so a perf regression caused by silent retries is visible
-    extra["retries"] = sf1.stats["retries"] + sf10.stats["retries"]
-    extra["faults_injected"] = (sf1.stats["faults_injected"]
-                                + sf10.stats["faults_injected"])
+        # BASELINE metric: hash-join probe rows/sec/chip (60M-row lineitem
+        # probe into a unique 15M-row orders build)
+        probe_rows = table_row_count("lineitem", 10.0)
+        jm = _time_query(sf10, JOIN_MICRO, iters=2)
+        extra["hash_join_probe_rows_per_s_per_chip"] = round(probe_rows / jm)
+        extra["hash_join_vs_baseline"] = round(
+            (probe_rows / jm) / BASE_JOIN_ROWS_PER_S, 3)
 
-    print(json.dumps({
+        if os.environ.get("TRINO_TPU_BENCH_SF100", "1") != "0":
+            for tag, (base, _, _) in SF100_RUNGS.items():
+                _run_rung_subprocess(extra, tag, base)
+
+        # fault-tolerance counters (round 6): nonzero retries on a clean
+        # bench mean the engine degraded (memory-forced spill re-runs) —
+        # surfaced so a perf regression caused by silent retries is visible
+        extra["retries"] = sf1.stats["retries"] + sf10.stats["retries"]
+        extra["faults_injected"] = (sf1.stats["faults_injected"]
+                                    + sf10.stats["faults_injected"])
+    except (KeyboardInterrupt, SystemExit) as e:
+        # still emit the JSON line, but PROPAGATE: an interrupted bench
+        # must not exit rc=0 looking green to a gating harness
+        error = f"{type(e).__name__}: {str(e)[:300]}"
+        interrupted = e
+    except Exception as e:  # noqa: BLE001 — the JSON line must print
+        error = f"{type(e).__name__}: {str(e)[:300]}"
+        interrupted = None
+    else:
+        interrupted = None
+
+    payload = {
         "metric": "tpch_q6_sf1_wall_s",
-        "value": round(q6, 4),
+        "value": round(q6, 4) if q6 is not None else None,
         "unit": "s",
-        "vs_baseline": round(BASE_Q6_SF1_S / q6, 3),
         "extra": extra,
-    }))
+    }
+    if q6 is not None:
+        payload["vs_baseline"] = round(BASE_Q6_SF1_S / q6, 3)
+    if error is not None:
+        payload["error"] = error
+    print(json.dumps(payload), flush=True)
+    if interrupted is not None:
+        raise interrupted
 
 
 if __name__ == "__main__":

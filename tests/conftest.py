@@ -27,3 +27,34 @@ jax.config.update("jax_platforms", "cpu")
 import trino_tpu
 
 trino_tpu.enable_persistent_cache()
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def node_pool_leak_gate():
+    """Leak gate: after EVERY engine test the node memory pool must read
+    zero reserved bytes — a nonzero pool means some query's ledger closed
+    dirty or never closed (the reservation-leak class of bug this round's
+    resource-governance layer exists to catch). Server tests finish
+    queries on background executor threads, so give stragglers a short
+    grace window before failing."""
+    yield
+    import time
+
+    from trino_tpu.exec.memory import NODE_POOL
+    deadline = time.monotonic() + 5.0
+    while NODE_POOL.reserved != 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    leaked, culprits = NODE_POOL.reserved, list(NODE_POOL._contexts)
+    if leaked:
+        # reset so exactly ONE test reports the leak — without this,
+        # every subsequent test inherits the nonzero pool (plus the 5s
+        # grace wait) and the real culprit drowns in cascade failures
+        with NODE_POOL._cond:
+            NODE_POOL._contexts.clear()
+            NODE_POOL.reserved = 0
+            NODE_POOL._cond.notify_all()
+    assert leaked == 0, (
+        f"node memory pool leaked {leaked} bytes "
+        f"(live contexts: {culprits})")

@@ -1,0 +1,155 @@
+"""Resource-group tree: admission, concurrency caps, weighted-fair drain.
+
+Reference parity: execution/resourcegroups/InternalResourceGroup.java
+(canQueueMore / canRunMore walking ancestors, WEIGHTED_FAIR scheduling)
+exercised at the manager level, where the stride scheduler's decisions
+are fully deterministic.
+"""
+
+import threading
+
+from trino_tpu.exec.resource_groups import ResourceGroupManager
+
+
+def drain_order(mgr, n):
+    """Take n items one slot at a time (saturated single-slot drain)."""
+    order = []
+    for _ in range(n):
+        got = mgr.take(timeout=0.1)
+        if got is None:
+            break
+        group, item = got
+        order.append(item)
+        mgr.finish(group, item)
+    return order
+
+
+def test_weighted_fair_two_to_one():
+    """A 2:1-weighted sibling pair drains ~2:1 under saturation — the
+    stride scheduler makes it exactly 2:1 over any window."""
+    mgr = ResourceGroupManager()
+    mgr.configure("a", weight=2)
+    mgr.configure("b", weight=1)
+    for i in range(12):
+        assert mgr.submit("a", f"a{i}", f"a{i}")
+        assert mgr.submit("b", f"b{i}", f"b{i}")
+    order = drain_order(mgr, 18)
+    assert len(order) == 18
+    # over the first 9 starts: 6 from a, 3 from b (exact 2:1)
+    first9 = order[:9]
+    a_count = sum(1 for x in first9 if x.startswith("a"))
+    assert a_count == 6, first9
+    # and the full drain keeps the ratio until a's queue runs dry
+    first18 = order
+    a_all = sum(1 for x in first18 if x.startswith("a"))
+    assert a_all == 12, first18
+
+
+def test_tree_admission_and_queue_bounds():
+    """max_queued binds at EVERY level of the chain (canQueueMore)."""
+    mgr = ResourceGroupManager()
+    mgr.configure("etl", max_queued=2)
+    mgr.configure("etl.a", max_queued=5)
+    mgr.configure("etl.b", max_queued=5)
+    assert mgr.submit("etl.a", "x1", "x1")
+    assert mgr.submit("etl.b", "x2", "x2")
+    # the parent's bound (2) trips even though each leaf has room
+    assert not mgr.submit("etl.a", "x3", "x3")
+    # sibling tree unaffected
+    assert mgr.submit("adhoc", "y1", "y1")
+
+
+def test_hard_concurrency_caps_subtree():
+    """hard_concurrency caps simultaneously RUNNING queries per level;
+    a freed slot hands the next queued query out."""
+    mgr = ResourceGroupManager()
+    mgr.configure("g", hard_concurrency=1)
+    assert mgr.submit("g", "q1", "q1")
+    assert mgr.submit("g", "q2", "q2")
+    group, item = mgr.take(timeout=0.1)
+    assert item == "q1"
+    # q2 must NOT come out while q1 runs
+    assert mgr.take(timeout=0.05) is None
+    mgr.finish(group, "q1")
+    group2, item2 = mgr.take(timeout=0.1)
+    assert item2 == "q2"
+    mgr.finish(group2, "q2")
+
+
+def test_parent_concurrency_caps_children():
+    mgr = ResourceGroupManager()
+    mgr.configure("p", hard_concurrency=1)
+    mgr.configure("p.x", hard_concurrency=5)
+    mgr.configure("p.y", hard_concurrency=5)
+    assert mgr.submit("p.x", "q1", "q1")
+    assert mgr.submit("p.y", "q2", "q2")
+    g1, i1 = mgr.take(timeout=0.1)
+    assert mgr.take(timeout=0.05) is None     # parent cap binds
+    mgr.finish(g1, i1)
+    g2, i2 = mgr.take(timeout=0.1)
+    assert {i1, i2} == {"q1", "q2"}
+    mgr.finish(g2, i2)
+
+
+def test_manager_wide_queue_bound():
+    """Per-group budgets alone would let a client mint fresh groups for
+    fresh budgets; max_total_queued is the server-wide admission bound."""
+    mgr = ResourceGroupManager(max_total_queued=3)
+    assert mgr.submit("a", "q1", "q1")
+    assert mgr.submit("b", "q2", "q2")
+    assert mgr.submit("c", "q3", "q3")
+    assert not mgr.submit("d", "q4", "q4")     # global bound trips
+    g, item = mgr.take(timeout=0.1)
+    mgr.finish(g, item)
+    assert mgr.submit("d", "q4", "q4")         # room again after drain
+
+
+def test_group_minting_capped():
+    """Unknown client-supplied group names beyond max_groups route to
+    'global' instead of growing server state without bound."""
+    mgr = ResourceGroupManager(max_groups=3)
+    assert mgr.submit("g1", "a", "a")
+    assert mgr.submit("g2", "b", "b")
+    assert mgr.submit("g3.sub", "c", "c")      # creates g3 AND g3.sub
+    names_before = {g.name for g in mgr.groups()}
+    assert mgr.submit("attacker-minted", "d", "d")
+    names_after = {g.name for g in mgr.groups()}
+    assert names_after - names_before == {"global"}
+    # a PRE-EXISTING group keeps routing normally past the cap
+    assert mgr.submit("g1", "e", "e")
+
+
+def test_take_blocks_until_submit():
+    mgr = ResourceGroupManager()
+    got = []
+
+    def taker():
+        got.append(mgr.take(timeout=5))
+    th = threading.Thread(target=taker)
+    th.start()
+    assert mgr.submit("g", "item", "item")
+    th.join(timeout=5)
+    assert got and got[0] is not None and got[0][1] == "item"
+
+
+def test_soft_memory_limit_blocks_admission(monkeypatch):
+    """A group over its soft_memory_limit admits no new query until its
+    node-pool usage drops (InternalResourceGroup softMemoryLimit)."""
+    from trino_tpu.exec.memory import NODE_POOL, QueryMemoryContext
+    mgr = ResourceGroupManager()
+    mgr.configure("mem", soft_memory_limit_bytes=1000)
+    assert mgr.submit("mem", "q1", "q1")
+    g, _ = mgr.take(timeout=0.1)
+    # q1 now "runs" holding 2000 bytes of the node pool
+    ctx = QueryMemoryContext(None, query_id="q1", pool=NODE_POOL)
+    try:
+        ctx.reserve(2000, "collect")
+        assert mgr.submit("mem", "q2", "q2")
+        assert mgr.take(timeout=0.05) is None   # over the soft limit
+        ctx.free(2000, "collect")
+        got = mgr.take(timeout=0.1)
+        assert got is not None and got[1] == "q2"
+        mgr.finish(got[0], "q2")
+    finally:
+        ctx.close()
+        mgr.finish(g, "q1")

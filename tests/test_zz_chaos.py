@@ -107,7 +107,8 @@ def test_tpch_chaos_injected_something(chaos_dist, chaos_local):
 def test_tpch_chaos_retry_none_fails():
     """Same seed, retry_policy=NONE: the sweep fails with a
     retryable-classified error — proof the TASK runs' green came from
-    retries, not luck."""
+    retries, not luck. (Site `memory` raises CLUSTER_OUT_OF_MEMORY-
+    classified pressure; every other site is REMOTE_TASK_ERROR.)"""
     runner = DistributedQueryRunner.tpch("tiny")
     set_chaos(runner, policy="NONE")
     saw_fault = None
@@ -120,7 +121,8 @@ def test_tpch_chaos_retry_none_fails():
             break
     assert saw_fault is not None
     assert is_retryable(saw_fault)
-    assert saw_fault.error_name == "REMOTE_TASK_ERROR"
+    assert saw_fault.error_name in ("REMOTE_TASK_ERROR",
+                                    "CLUSTER_OUT_OF_MEMORY")
 
 
 @pytest.mark.slow
@@ -135,3 +137,176 @@ def test_tpch_chaos_seed_sweep(oracle, seed):
         got = runner.execute(sql)
         expected = oracle.execute(oracle_sql).fetchall()
         assert_same(got.rows, expected, ordered)
+
+
+# ------------------------------------------------- concurrency + node OOM
+#
+# The round-7 resource-governance acceptance bar: concurrent TPC-H
+# queries over a NODE pool sized to fit only ~2 of them, fault site
+# `memory` active — the low-memory killer selects victims, victims fail
+# with retryable CLUSTER_OUT_OF_MEMORY, retry_policy=QUERY re-runs them,
+# and everything finishes oracle-correct; under NONE the same pressure
+# provably loses queries.
+
+CONCURRENT_QS = ["q1", "q3", "q10", "q18"]
+
+
+def _solo_peak(name) -> int:
+    """Peak node-pool bytes of one query run alone (sizes the pool)."""
+    from trino_tpu.exec.query_tracker import TRACKER
+    r = LocalQueryRunner.tpch("tiny")
+    qid = f"solo_peak_{name}_{id(r)}"
+    r.execute(QUERIES[name][0], query_id=qid)
+    info = next(q for q in TRACKER.list() if q.query_id == qid)
+    return info.pool_peak_bytes
+
+
+def _tight_pool(queries=None) -> int:
+    """A pool that fits ~2 of the concurrent set: each query runs fine
+    alone (>= 1.2x the largest solo peak) but the set's combined peaks
+    overflow (~55% of their sum)."""
+    queries = queries or CONCURRENT_QS
+    peaks = [_solo_peak(n) for n in queries]
+    return max(int(1.2 * max(peaks)), int(0.55 * sum(peaks)), 1 << 20)
+
+
+def _run_concurrent(policy, pool_limit, *, rate=0.0, rounds=1,
+                    attempts=10, queries=None):
+    """Run each query on its own thread (per-query runner clones over
+    shared catalogs — the server's executor-pool shape), all released by
+    a barrier, over a bounded NODE pool. Returns (results, errors)."""
+    import threading
+
+    from trino_tpu.exec.memory import NODE_POOL
+    queries = queries or CONCURRENT_QS
+    base = LocalQueryRunner.tpch("tiny")
+    results, errors = {}, {}
+    barrier = threading.Barrier(len(queries))
+
+    def worker(name):
+        try:
+            r = base.for_query()
+            r.session.set("retry_policy", policy)
+            r.session.set("retry_attempts", attempts)
+            r.session.set("cluster_memory_wait_ms", 500)
+            if rate > 0:
+                r.session.set("fault_injection_rate", rate)
+                r.session.set("fault_injection_seed", CHAOS_SEED)
+                r.session.set("fault_injection_sites", "memory")
+            barrier.wait(timeout=60)
+            for _ in range(rounds):
+                results[name] = r.execute(QUERIES[name][0])
+        except Exception as e:  # noqa: BLE001 — the assertions decide
+            errors[name] = e
+            results.pop(name, None)
+
+    with NODE_POOL.limited(pool_limit):
+        threads = [threading.Thread(target=worker, args=(n,))
+                   for n in queries]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=600)
+        assert not any(th.is_alive() for th in threads)
+    return results, errors
+
+
+def test_zz_concurrent_pair_smoke(oracle):
+    """Tier-1 smoke: two concurrent queries over a bounded pool with
+    QUERY retry — both oracle-correct, pool drains to zero (the full
+    4-query OOM sweeps run under `slow`)."""
+    from trino_tpu.exec.memory import NODE_POOL
+    pair = ["q1", "q3"]
+    results, errors = _run_concurrent("QUERY", _tight_pool(pair),
+                                      queries=pair)
+    assert not errors, {k: repr(v) for k, v in errors.items()}
+    for name in pair:
+        _, oracle_sql, ordered = QUERIES[name]
+        expected = oracle.execute(oracle_sql).fetchall()
+        assert_same(results[name].rows, expected, ordered)
+    assert NODE_POOL.reserved == 0
+
+
+@pytest.mark.slow
+def test_zz_concurrent_oom_query_retry_all_correct(oracle):
+    """4 concurrent TPC-H queries, pool sized for ~2, chaos site
+    `memory` armed: kills/pressure happen, QUERY retry absorbs them, and
+    EVERY query finishes oracle-correct."""
+    from trino_tpu.exec.memory import NODE_POOL
+    pool_limit = _tight_pool()
+    kills_before = NODE_POOL.kills
+    results, errors = _run_concurrent("QUERY", pool_limit, rate=0.25,
+                                      rounds=2)
+    assert not errors, {k: repr(v) for k, v in errors.items()}
+    for name in CONCURRENT_QS:
+        _, oracle_sql, ordered = QUERIES[name]
+        expected = oracle.execute(oracle_sql).fetchall()
+        assert_same(results[name].rows, expected, ordered)
+    # the run must have actually seen pressure (killer or injected)
+    from trino_tpu.exec.query_tracker import TRACKER
+    pressure = (NODE_POOL.kills - kills_before) + sum(
+        q.faults_injected for q in TRACKER.list())
+    assert pressure > 0
+    assert NODE_POOL.reserved == 0
+
+
+@pytest.mark.slow
+def test_zz_concurrent_oom_retry_none_loses_victims():
+    """Same pressure, retry_policy=NONE: the victims are LOST, and they
+    die with the retryable CLUSTER_OUT_OF_MEMORY verdict (proof the
+    QUERY-policy green above came from retries, not luck)."""
+    results, errors = _run_concurrent("NONE", _tight_pool(), rate=0.25,
+                                      rounds=3)
+    assert errors, "expected at least one lost victim under NONE"
+    from trino_tpu.errors import TrinoError, is_retryable
+    for name, e in errors.items():
+        assert isinstance(e, TrinoError), (name, repr(e))
+        assert e.error_name == "CLUSTER_OUT_OF_MEMORY", (name, repr(e))
+        assert is_retryable(e)
+
+
+@pytest.mark.slow
+def test_zz_concurrent_oom_sustained_rounds(oracle):
+    """Sustained load: every query runs multiple rounds under the tight
+    pool + chaos; all rounds stay oracle-correct."""
+    results, errors = _run_concurrent("QUERY", _tight_pool(), rate=0.25,
+                                      rounds=3)
+    assert not errors, {k: repr(v) for k, v in errors.items()}
+    for name in CONCURRENT_QS:
+        _, oracle_sql, ordered = QUERIES[name]
+        expected = oracle.execute(oracle_sql).fetchall()
+        assert_same(results[name].rows, expected, ordered)
+
+
+@pytest.mark.slow
+def test_zz_concurrent_all22_two_lanes(oracle):
+    """Two lanes race through ALL 22 TPC-H queries concurrently over an
+    UNBOUNDED pool (pure concurrency shake-out of the shared caches /
+    tracker / ledger); verification runs on the main thread afterwards
+    (the sqlite oracle connection is thread-bound)."""
+    import threading
+    base = LocalQueryRunner.tpch("tiny")
+    lanes = {0: list(PASSING), 1: list(reversed(PASSING))}
+    got_rows = {0: {}, 1: {}}
+    failures = []
+
+    def worker(lane):
+        r = base.for_query()
+        name = None
+        try:
+            for name in lanes[lane]:
+                got_rows[lane][name] = r.execute(QUERIES[name][0]).rows
+        except BaseException as e:  # noqa: BLE001
+            failures.append((lane, name, e))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in (0, 1)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=1200)
+    assert not failures, failures[:2]
+    for lane in (0, 1):
+        for name in PASSING:
+            _, oracle_sql, ordered = QUERIES[name]
+            expected = oracle.execute(oracle_sql).fetchall()
+            assert_same(got_rows[lane][name], expected, ordered)

@@ -27,14 +27,28 @@ TABLES: Dict[str, tuple] = {
         ("user", T.VarcharType()), ("query", T.VarcharType()),
         ("rows", T.BIGINT), ("wall_ms", T.BIGINT),
         ("error", T.VarcharType()), ("error_name", T.VarcharType()),
-        ("retries", T.BIGINT), ("faults_injected", T.BIGINT)),
+        ("retries", T.BIGINT), ("faults_injected", T.BIGINT),
+        ("resource_group", T.VarcharType()),
+        ("pool_reserved_bytes", T.BIGINT), ("pool_peak_bytes", T.BIGINT),
+        ("memory_kills", T.BIGINT), ("leaked_bytes", T.BIGINT)),
     "tasks": (
         ("query_id", T.VarcharType()), ("task_id", T.VarcharType()),
         ("state", T.VarcharType()), ("rows", T.BIGINT),
         ("wall_ms", T.BIGINT)),
     "nodes": (
         ("node_id", T.VarcharType()), ("node_version", T.VarcharType()),
-        ("coordinator", T.BOOLEAN), ("state", T.VarcharType())),
+        ("coordinator", T.BOOLEAN), ("state", T.VarcharType()),
+        ("pool_limit_bytes", T.BIGINT), ("pool_reserved_bytes", T.BIGINT),
+        ("pool_peak_bytes", T.BIGINT), ("pool_kills", T.BIGINT),
+        ("pool_leaks", T.BIGINT), ("pool_leaked_bytes", T.BIGINT)),
+    "resource_groups": (
+        ("name", T.VarcharType()), ("parent", T.VarcharType()),
+        ("queued", T.BIGINT), ("running", T.BIGINT),
+        ("started", T.BIGINT), ("finished", T.BIGINT),
+        ("hard_concurrency", T.BIGINT), ("max_queued", T.BIGINT),
+        ("soft_memory_limit_bytes", T.BIGINT),
+        ("scheduling_weight", T.BIGINT),
+        ("memory_usage_bytes", T.BIGINT)),
 }
 
 
@@ -43,7 +57,13 @@ def _rows_for(table: str) -> List[tuple]:
     if table == "queries":
         return [(q.query_id, q.state, q.user, q.query, q.rows,
                  q.wall_ms if q.wall_ms is not None else 0, q.error,
-                 q.error_name, q.retries, q.faults_injected)
+                 q.error_name, q.retries, q.faults_injected,
+                 q.resource_group, q.pool_reserved_bytes,
+                 max(q.pool_peak_bytes,
+                     q.mem.peak if q.mem is not None else 0),
+                 max(q.memory_kills,
+                     q.mem.kills if q.mem is not None else 0),
+                 q.leaked_bytes)
                 for q in TRACKER.list()]
     if table == "tasks":
         # single-controller engine: one task per query (the mesh's shards
@@ -53,12 +73,28 @@ def _rows_for(table: str) -> List[tuple]:
                 for q in TRACKER.list()]
     if table == "nodes":
         import jax
+
+        from trino_tpu.exec.memory import NODE_POOL
         try:
             devices = jax.devices()
         except Exception:
             devices = []
+        # the pool columns repeat per device row: the node pool is the
+        # single-controller process's budget, not per-chip
+        pool = (NODE_POOL.limit or 0, NODE_POOL.reserved, NODE_POOL.peak,
+                NODE_POOL.kills, NODE_POOL.leaks, NODE_POOL.leaked_bytes)
         return [(f"{d.platform}-{d.id}", jax.__version__, d.id == 0,
-                 "active") for d in devices]
+                 "active") + pool for d in devices]
+    if table == "resource_groups":
+        from trino_tpu.exec.resource_groups import list_all_groups
+        return [(g.name,
+                 g.parent.name if g.parent is not None else None,
+                 g.queued, len(g.running), g.started, g.finished,
+                 g.hard_concurrency, g.max_queued,
+                 g.soft_memory_limit_bytes if
+                 g.soft_memory_limit_bytes is not None else 0,
+                 g.weight, g.memory_usage())
+                for g in list_all_groups()]
     raise KeyError(table)
 
 

@@ -231,10 +231,17 @@ class TpchSplitManager(ConnectorSplitManager):
 
 import collections
 import os
+import threading
 
 # device-side generation (tpch_dev): default ON; set =0 to force the host
 # numpy path (debugging / byte-equivalence comparisons)
 _DEVICE_GEN = os.environ.get("TRINO_TPU_DEVICE_GEN", "1") != "0"
+
+# one lock for both LRU caches: the server's executor pool scans
+# concurrently, and the byte-accounting (USED counters vs OrderedDict)
+# must not interleave. Generation under the lock serializes a cold miss;
+# warm hits are a dict probe.
+_CACHE_LOCK = threading.RLock()
 
 # host-side generated-chunk LRU: at SF100 the working set (~29GB for q9's
 # seven lineitem/orders columns) exceeds the DEVICE cache budget, and
@@ -249,20 +256,25 @@ _HOST_CHUNK_CACHE_USED = 0
 
 def _host_cached(key: tuple, build) -> np.ndarray:
     global _HOST_CHUNK_CACHE_USED
-    arr = _HOST_CHUNK_CACHE.get(key)
-    if arr is not None:
-        _HOST_CHUNK_CACHE.move_to_end(key)
-        return arr
+    with _CACHE_LOCK:
+        arr = _HOST_CHUNK_CACHE.get(key)
+        if arr is not None:
+            _HOST_CHUNK_CACHE.move_to_end(key)
+            return arr
+    # build OUTSIDE the lock: a cold SF100 chunk generation takes minutes
+    # and must not stall concurrent queries' warm cache hits (two racers
+    # may both build; check-then-insert keeps the accounting exact)
     arr = build()
     nbytes = arr.nbytes
-    if nbytes > _HOST_CHUNK_CACHE_BYTES:
-        return arr
-    while (_HOST_CHUNK_CACHE_USED + nbytes > _HOST_CHUNK_CACHE_BYTES
-           and _HOST_CHUNK_CACHE):
-        _, evicted = _HOST_CHUNK_CACHE.popitem(last=False)
-        _HOST_CHUNK_CACHE_USED -= evicted.nbytes
-    _HOST_CHUNK_CACHE[key] = arr
-    _HOST_CHUNK_CACHE_USED += nbytes
+    with _CACHE_LOCK:
+        if nbytes <= _HOST_CHUNK_CACHE_BYTES \
+                and key not in _HOST_CHUNK_CACHE:
+            while (_HOST_CHUNK_CACHE_USED + nbytes > _HOST_CHUNK_CACHE_BYTES
+                   and _HOST_CHUNK_CACHE):
+                _, evicted = _HOST_CHUNK_CACHE.popitem(last=False)
+                _HOST_CHUNK_CACHE_USED -= evicted.nbytes
+            _HOST_CHUNK_CACHE[key] = arr
+            _HOST_CHUNK_CACHE_USED += nbytes
     return arr
 
 
@@ -279,11 +291,12 @@ def set_device_cache_budget(nbytes: int) -> None:
     """Adjust the staged-column LRU budget at runtime (bench shrinks it
     before SF100 rungs so join state owns the HBM, evicting as needed)."""
     global _DEVICE_COL_CACHE_BYTES, _DEVICE_COL_CACHE_USED
-    _DEVICE_COL_CACHE_BYTES = int(nbytes)
-    while _DEVICE_COL_CACHE_USED > _DEVICE_COL_CACHE_BYTES \
-            and _DEVICE_COL_CACHE:
-        _, evicted = _DEVICE_COL_CACHE.popitem(last=False)
-        _DEVICE_COL_CACHE_USED -= evicted.nbytes
+    with _CACHE_LOCK:
+        _DEVICE_COL_CACHE_BYTES = int(nbytes)
+        while _DEVICE_COL_CACHE_USED > _DEVICE_COL_CACHE_BYTES \
+                and _DEVICE_COL_CACHE:
+            _, evicted = _DEVICE_COL_CACHE.popitem(last=False)
+            _DEVICE_COL_CACHE_USED -= evicted.nbytes
 
 
 def _staged_column(table: str, sf: float, name: str, typ: T.Type,
@@ -297,10 +310,11 @@ def _staged_column(table: str, sf: float, name: str, typ: T.Type,
     Trino's memory connector / a warmed OS page cache."""
     global _DEVICE_COL_CACHE_USED
     key = (table, round(sf * 1000), name, off, hi, page_capacity)
-    col = _DEVICE_COL_CACHE.get(key)
-    if col is not None:
-        _DEVICE_COL_CACHE.move_to_end(key)
-        return col
+    with _CACHE_LOCK:
+        col = _DEVICE_COL_CACHE.get(key)
+        if col is not None:
+            _DEVICE_COL_CACHE.move_to_end(key)
+            return col
     hkey = (table, round(sf * 1000), name, off, hi)
     if _DEVICE_GEN and tpch_dev.supported(table, name):
         # generate ON the device: same hash-stream expressions jit'd via
@@ -330,14 +344,16 @@ def _staged_column(table: str, sf: float, name: str, typ: T.Type,
                 T.to_numpy_dtype(typ))), page_capacity, 0)
         col = Column.from_numpy(arr, typ)
     nbytes = col.nbytes
-    if nbytes > _DEVICE_COL_CACHE_BYTES:
-        return col       # larger than the whole budget: never cache
-    while (_DEVICE_COL_CACHE_USED + nbytes > _DEVICE_COL_CACHE_BYTES
-           and _DEVICE_COL_CACHE):
-        _, evicted = _DEVICE_COL_CACHE.popitem(last=False)
-        _DEVICE_COL_CACHE_USED -= evicted.nbytes
-    _DEVICE_COL_CACHE[key] = col
-    _DEVICE_COL_CACHE_USED += nbytes
+    with _CACHE_LOCK:
+        if nbytes > _DEVICE_COL_CACHE_BYTES:
+            return col   # larger than the whole budget: never cache
+        if key not in _DEVICE_COL_CACHE:
+            while (_DEVICE_COL_CACHE_USED + nbytes
+                   > _DEVICE_COL_CACHE_BYTES and _DEVICE_COL_CACHE):
+                _, evicted = _DEVICE_COL_CACHE.popitem(last=False)
+                _DEVICE_COL_CACHE_USED -= evicted.nbytes
+            _DEVICE_COL_CACHE[key] = col
+            _DEVICE_COL_CACHE_USED += nbytes
     return col
 
 

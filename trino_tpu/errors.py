@@ -67,6 +67,12 @@ QUERY_QUEUE_FULL = ErrorCode("QUERY_QUEUE_FULL", 131074,
                              INSUFFICIENT_RESOURCES)
 EXCEEDED_TIME_LIMIT = ErrorCode("EXCEEDED_TIME_LIMIT", 131075,
                                 INSUFFICIENT_RESOURCES)
+# retryable (the ONLY retryable resource error): the low-memory killer's
+# victim may succeed once the node pool pressure clears, so
+# retry_policy=QUERY transparently re-runs it (the reference's
+# ClusterMemoryManager + TotalReservationLowMemoryKiller contract)
+CLUSTER_OUT_OF_MEMORY = ErrorCode("CLUSTER_OUT_OF_MEMORY", 131076,
+                                  INSUFFICIENT_RESOURCES, retryable=True)
 EXCEEDED_LOCAL_MEMORY_LIMIT = ErrorCode(
     "EXCEEDED_LOCAL_MEMORY_LIMIT", 131079, INSUFFICIENT_RESOURCES)
 

@@ -262,6 +262,8 @@ class DistributedQueryRunner(LocalQueryRunner):
             self.metadata, self.session, 0, self.mesh.n, exchange_inputs)
         executor.faults = self._faults
         executor.deadline = self._deadline
+        if self._memory is not None:
+            executor.memory = self._memory   # query-level shared ledger
         root_stream = executor.execute(frag.root)
         types = [s.type for s in plan.symbols]
         rows = []
@@ -337,6 +339,8 @@ class DistributedQueryRunner(LocalQueryRunner):
                 exchange_inputs, device=self.mesh.device_of(shard))
             executor.faults = self._faults
             executor.deadline = self._deadline
+            if self._memory is not None:
+                executor.memory = self._memory  # shards share the ledger
             dispatched.append(
                 (shard, executor, list(executor.execute(frag.root)
                                        .iter_pages())))

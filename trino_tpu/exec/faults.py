@@ -25,9 +25,18 @@ from __future__ import annotations
 import random
 from typing import Dict, Optional, Tuple
 
-from trino_tpu.errors import InjectedFault
+from trino_tpu.errors import CLUSTER_OUT_OF_MEMORY, InjectedFault
 
-SITES = ("fragment", "exchange", "scan", "spill")
+SITES = ("fragment", "exchange", "scan", "spill", "memory")
+
+
+class InjectedMemoryPressure(InjectedFault):
+    """Synthetic node-pool pressure (site `memory`): classifies as
+    CLUSTER_OUT_OF_MEMORY — retryable like a real low-memory-killer
+    verdict — so chaos tests drive the killer/degrade paths
+    deterministically without racing real concurrent reservations."""
+
+    CODE = CLUSTER_OUT_OF_MEMORY
 
 
 class FaultInjector:
@@ -89,7 +98,8 @@ class FaultInjector:
         self._armed = None
         self.injected += 1
         self.by_site[site] = self.by_site.get(site, 0) + 1
-        raise InjectedFault(
+        exc = InjectedMemoryPressure if site == "memory" else InjectedFault
+        raise exc(
             f"injected fault at {site}"
             + (f" ({detail})" if detail else "")
             + f" [task {self._label}, seed {self.seed}, "

@@ -13,12 +13,17 @@ beneath each entry. Executing the same query shape twice must not re-trace.
 from __future__ import annotations
 
 import collections
+import threading
 from typing import Any, Callable, Dict, Hashable
 
 import jax
 
 _CACHE: "collections.OrderedDict[Hashable, Callable]" = \
     collections.OrderedDict()
+# concurrent queries (the server's executor pool) share this cache; the
+# lock guards the LRU structure only — jitted kernels themselves are
+# thread-safe to call
+_LOCK = threading.RLock()   # reentrant: a build() may consult the cache
 # LRU bound: every cached kernel pins a loaded XLA executable (JIT code
 # pages + device buffers); unbounded growth across a long session exhausts
 # executable memory maps. 512 is far above any single query's kernel count,
@@ -33,15 +38,16 @@ def cached_kernel(key: Hashable, build: Callable[[], Callable]) -> Callable:
     `build()` must construct the kernel purely from information encoded in
     `key` (no capture of per-query state), so a cache hit is always correct.
     """
-    fn = _CACHE.get(key)
-    if fn is None:
-        fn = jax.jit(build())
-        while len(_CACHE) >= _MAX_KERNELS:
-            _CACHE.popitem(last=False)
-        _CACHE[key] = fn
-    else:
-        _CACHE.move_to_end(key)
-    return fn
+    with _LOCK:
+        fn = _CACHE.get(key)
+        if fn is None:
+            fn = jax.jit(build())
+            while len(_CACHE) >= _MAX_KERNELS:
+                _CACHE.popitem(last=False)
+            _CACHE[key] = fn
+        else:
+            _CACHE.move_to_end(key)
+        return fn
 
 
 def cache_info() -> int:
@@ -49,4 +55,5 @@ def cache_info() -> int:
 
 
 def clear():  # for tests
-    _CACHE.clear()
+    with _LOCK:
+        _CACHE.clear()

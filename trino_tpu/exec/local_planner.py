@@ -169,9 +169,11 @@ class LocalExecutionPlanner:
         self.deadline = None
 
     def _checkpoint(self) -> None:
-        """Cooperative cancellation/deadline point (page-batch boundary)."""
+        """Cooperative cancellation/deadline point (page-batch boundary);
+        also where a low-memory-killer victim notices its kill mark."""
         if self.deadline is not None:
             self.deadline.check()
+        self.memory.poll()
 
     def _fault_site(self, site: str, detail: str = "") -> None:
         if self.faults is not None:
@@ -352,6 +354,9 @@ class LocalExecutionPlanner:
         page = self.merge_counted(list(stream.iter_pages()))
         if page is None:
             return None
+        # chaos site `memory`: injected node-pool pressure at the point a
+        # real reservation would hit the killer
+        self._fault_site("memory", "collect")
         self.memory.reserve(page_bytes(page), "collect")
         return page
 

@@ -1,26 +1,36 @@
-"""Query memory accounting.
+"""Query + node memory accounting with a low-memory killer.
 
 Reference parity: memory/MemoryPool.java:44 + lib/trino-memory-context
-(AggregatedMemoryContext tree) + ExceededMemoryLimitException — every
-blocking materialization (join build side, aggregation/sort/window collect,
-exchange buffers) reserves its page bytes against the session's
-`query_max_memory` before the device call, and the query fails with the
-reference's "Query exceeded per-node memory limit" error when the
-reservation would overflow.
+(AggregatedMemoryContext tree) + memory/ClusterMemoryManager.java with
+memory/TotalReservationLowMemoryKiller.java — accounting is hierarchical:
+every blocking materialization (join build side, aggregation/sort/window
+collect, exchange buffers) reserves its page bytes against the query's
+`query_max_memory` ledger AND the process-wide `NodeMemoryPool`. A
+reservation that would overflow the query limit fails the query with the
+reference's "Query exceeded per-node memory limit" error; one that would
+overflow the NODE pool invokes the low-memory killer, which picks a victim
+query by policy (`total-reservation`: the largest ledger) and fails it with
+CLUSTER_OUT_OF_MEMORY — retryable, so retry_policy=QUERY re-runs the
+victim once the pressure clears.
 
-TPU framing: the pool models HBM, the scarce resource a fused streaming
-pipeline does NOT consume (pages flow through one kernel) but blocking
-operators do. Reservations are tracked per operator tag so the error names
-the offender, and freed when an operator's output is consumed (operator
-scopes call free()).
+TPU framing: the pool models one chip's HBM, the scarce resource a fused
+streaming pipeline does NOT consume (pages flow through one kernel) but
+blocking operators do. Reservations are tracked per operator tag so errors
+name the offender, and freed when an operator's output is consumed
+(operator scopes call free()). At query end the ledger must read zero; a
+nonzero ledger on a successful query is a reservation LEAK, surfaced as a
+query warning and counted on the pool (system.runtime.nodes).
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
+import time
 from typing import Dict, Optional
 
-from trino_tpu.errors import EXCEEDED_LOCAL_MEMORY_LIMIT, TrinoError
+from trino_tpu.errors import (CLUSTER_OUT_OF_MEMORY,
+                              EXCEEDED_LOCAL_MEMORY_LIMIT, TrinoError)
 
 
 class ExceededMemoryLimitError(TrinoError, RuntimeError):
@@ -30,14 +40,23 @@ class ExceededMemoryLimitError(TrinoError, RuntimeError):
     CODE = EXCEEDED_LOCAL_MEMORY_LIMIT
 
 
+class ClusterOutOfMemoryError(TrinoError, RuntimeError):
+    """The low-memory killer's verdict: this query was selected (or timed
+    out waiting for a victim's release) when a reservation would overflow
+    the NODE pool. Retryable — re-running after the pressure clears may
+    succeed (ClusterMemoryManager kill + FTE retry contract)."""
+
+    CODE = CLUSTER_OUT_OF_MEMORY
+
+
 @contextlib.contextmanager
 def degrade_to_spill(session):
     """Graceful degradation for a fragment retry after an
-    ExceededMemoryLimitError: force the spill path on and pull every spill
-    threshold under the memory limit, so blocking operators flush to host
-    partitions instead of materializing over-limit device pages
-    (TaskExecutor's revoke-memory-then-retry analog). Restores the
-    session's property bag on exit."""
+    ExceededMemoryLimitError / ClusterOutOfMemoryError: force the spill
+    path on and pull every spill threshold under the memory limit, so
+    blocking operators flush to host partitions instead of materializing
+    over-limit device pages (TaskExecutor's revoke-memory-then-retry
+    analog). Restores the session's property bag on exit."""
     saved = dict(session.properties)
     limit = int(session.get("query_max_memory"))
     threshold = max(1, limit // 4)
@@ -67,31 +86,293 @@ def page_bytes(page) -> int:
     return sum(col.nbytes for col in page.columns)
 
 
-class QueryMemoryContext:
-    """Single-query reservation ledger checked against query_max_memory."""
+class NodeMemoryPool:
+    """Process-wide reservation pool all queries share (MemoryPool.java +
+    ClusterMemoryManager collapsed to the single-node case).
 
-    def __init__(self, limit_bytes: Optional[int]):
+    `limit` is the node's reservable byte budget (None = unbounded — the
+    engine's default, since tests and direct runners size their own
+    queries). When a reservation would overflow the pool, the low-memory
+    killer picks a victim by `killer_policy`:
+
+      total-reservation  kill the query with the largest ledger
+                         (TotalReservationLowMemoryKiller)
+      none               never kill; the requester fails
+
+    The victim is marked killed (it raises ClusterOutOfMemoryError at its
+    next reservation or cooperative checkpoint) and the requester WAITS for
+    the victim's unwind to release bytes, up to its `wait_s`; a timeout
+    fails the requester with the same retryable error.
+    """
+
+    def __init__(self, limit_bytes: Optional[int] = None,
+                 killer_policy: str = "total-reservation"):
+        self._cond = threading.Condition()
+        self.limit = limit_bytes
+        self.killer_policy = killer_policy
+        self.reserved = 0
+        self.peak = 0
+        self.kills = 0          # victims selected by the killer
+        self.leaks = 0          # successful queries that ended nonzero
+        self.leaked_bytes = 0
+        self._contexts: Dict[str, "QueryMemoryContext"] = {}
+
+    # ------------------------------------------------------- configuration
+
+    def set_limit(self, limit_bytes: Optional[int]) -> None:
+        with self._cond:
+            self.limit = limit_bytes
+            self._cond.notify_all()
+
+    @contextlib.contextmanager
+    def limited(self, limit_bytes: Optional[int],
+                killer_policy: Optional[str] = None):
+        """Scoped pool reconfiguration (tests / chaos harnesses)."""
+        with self._cond:
+            saved = (self.limit, self.killer_policy)
+            self.limit = limit_bytes
+            if killer_policy is not None:
+                self.killer_policy = killer_policy
+            self._cond.notify_all()
+        try:
+            yield self
+        finally:
+            with self._cond:
+                self.limit, self.killer_policy = saved
+                self._cond.notify_all()
+
+    # -------------------------------------------------------- registration
+
+    def register(self, ctx: "QueryMemoryContext") -> None:
+        with self._cond:
+            self._contexts[ctx.query_id] = ctx
+
+    def unregister(self, ctx: "QueryMemoryContext") -> None:
+        with self._cond:
+            if self._contexts.get(ctx.query_id) is ctx:
+                del self._contexts[ctx.query_id]
+            self._cond.notify_all()
+
+    def reserved_of(self, query_id: str) -> int:
+        ctx = self._contexts.get(query_id)
+        return ctx.reserved if ctx is not None else 0
+
+    # ----------------------------------------------------------- the pool
+
+    def acquire(self, ctx: "QueryMemoryContext", nbytes: int, tag: str,
+                wait_s: float) -> None:
+        """Grant `nbytes` to `ctx` or raise ClusterOutOfMemoryError.
+
+        Runs the low-memory killer when the pool would overflow; blocks
+        (releasing the pool lock) while a marked victim unwinds."""
+        deadline: Optional[float] = None
+        with self._cond:
+            while True:
+                if ctx.kill_reason is not None:
+                    raise ClusterOutOfMemoryError(ctx.kill_reason)
+                if self.limit is None or self.reserved + nbytes <= self.limit:
+                    self.reserved += nbytes
+                    self.peak = max(self.peak, self.reserved)
+                    return
+                # kill at most ONE victim per pressure event: while a
+                # marked victim still holds bytes, spurious wakeups (any
+                # unrelated free() notifies) must WAIT for its unwind,
+                # not cascade-kill the rest of the fleet
+                if not any(c.kill_reason is not None and c.reserved > 0
+                           for c in self._contexts.values()):
+                    if self.killer_policy == "none":
+                        # never kill: the requester fails, and NO kill
+                        # is recorded (pool_kills must read zero on a
+                        # node whose killer is disabled)
+                        raise ClusterOutOfMemoryError(
+                            f"node memory pool exhausted (killer "
+                            f"disabled): [{tag}] requested "
+                            f"{_fmt_bytes(nbytes)} with "
+                            f"{_fmt_bytes(self.reserved)}/"
+                            f"{_fmt_bytes(self.limit)} reserved")
+                    victim = self._select_victim_locked()
+                    if victim is None or victim is ctx:
+                        # the requester itself is the largest reservation
+                        # (or nothing is killable): self-inflicted
+                        # pressure — fail the requester; its retry
+                        # re-runs with spill forced
+                        self._kill_locked(ctx, nbytes, tag, ctx)
+                        raise ClusterOutOfMemoryError(ctx.kill_reason)
+                    self._kill_locked(victim, nbytes, tag, ctx)
+                if deadline is None:
+                    deadline = time.monotonic() + max(0.0, wait_s)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cond.wait(remaining):
+                    raise ClusterOutOfMemoryError(
+                        f"node memory pool exhausted: [{tag}] requested "
+                        f"{_fmt_bytes(nbytes)} with {_fmt_bytes(self.reserved)}"
+                        f"/{_fmt_bytes(self.limit)} reserved and no victim "
+                        f"released within {wait_s:.1f}s")
+
+    def release(self, nbytes: int) -> None:
+        if nbytes <= 0:
+            return
+        with self._cond:
+            self.reserved = max(0, self.reserved - nbytes)
+            self._cond.notify_all()
+
+    def reset_context(self, ctx: "QueryMemoryContext") -> None:
+        """Atomically drop ALL of a context's reservation and clear its
+        kill mark (between retry attempts): a killed victim must hand
+        back every byte the killer wanted — and the mark must clear
+        under the pool lock so it can't race a concurrent re-kill."""
+        with self._cond:
+            delta = ctx.reserved
+            ctx.reserved = 0
+            ctx.kill_reason = None
+            self.reserved = max(0, self.reserved - delta)
+            self._cond.notify_all()
+
+    # ---------------------------------------------------------- the killer
+
+    def _select_victim_locked(self) -> Optional["QueryMemoryContext"]:
+        if self.killer_policy == "none":
+            return None
+        # total-reservation: largest live ledger not already marked
+        best = None
+        for c in self._contexts.values():
+            if c.kill_reason is not None or c.reserved <= 0:
+                continue
+            if best is None or c.reserved > best.reserved:
+                best = c
+        return best
+
+    def _kill_locked(self, victim: "QueryMemoryContext", nbytes: int,
+                     tag: str, requester: "QueryMemoryContext") -> None:
+        if victim.kill_reason is not None:
+            return
+        victim.kill_reason = (
+            f"Query killed because the node is out of memory (low-memory "
+            f"killer, policy {self.killer_policy}): query "
+            f"{requester.query_id} [{tag}] requested {_fmt_bytes(nbytes)} "
+            f"with {_fmt_bytes(self.reserved)}/{_fmt_bytes(self.limit)} "
+            f"reserved; victim {victim.query_id} held "
+            f"{_fmt_bytes(victim.reserved)}. Please retry in a few minutes")
+        victim.kills += 1
+        self.kills += 1
+        # wake the victim if it is itself blocked in acquire()
+        self._cond.notify_all()
+
+    def record_leak(self, nbytes: int) -> None:
+        with self._cond:
+            self.leaks += 1
+            self.leaked_bytes += nbytes
+
+
+# the process-wide pool (the single node's HBM budget; unbounded until a
+# server/operator sizes it — LocalMemoryManager singleton scope)
+NODE_POOL = NodeMemoryPool()
+
+
+class QueryMemoryContext:
+    """Single-query reservation ledger checked against query_max_memory,
+    mirrored into a NodeMemoryPool when one is attached (the query level
+    of the query→operator→node hierarchy; by_tag is the operator level).
+
+    Mutations come from the query's own executor thread; the killer thread
+    only writes `kill_reason`/`kills` under the pool lock."""
+
+    _anon = 0
+
+    def __init__(self, limit_bytes: Optional[int],
+                 query_id: Optional[str] = None,
+                 pool: Optional[NodeMemoryPool] = None,
+                 wait_s: float = 2.0):
         self.limit = int(limit_bytes) if limit_bytes is not None else None
         self.reserved = 0
         self.peak = 0
         self.by_tag: Dict[str, int] = {}
+        if not query_id:
+            QueryMemoryContext._anon += 1
+            query_id = f"ctx_{QueryMemoryContext._anon}"
+        self.query_id = query_id
+        self.pool = pool
+        self.wait_s = float(wait_s)
+        self.kill_reason: Optional[str] = None
+        self.kills = 0          # times this query was selected as victim
+        if pool is not None:
+            pool.register(self)
 
     def reserve(self, nbytes: int, tag: str = "operator") -> None:
         nbytes = int(nbytes)
         if nbytes <= 0:
             return
+        if self.kill_reason is not None:
+            raise ClusterOutOfMemoryError(self.kill_reason)
         if self.limit is not None and self.reserved + nbytes > self.limit:
             raise ExceededMemoryLimitError(
                 f"Query exceeded per-node memory limit of "
                 f"{_fmt_bytes(self.limit)} [{tag} requested "
                 f"{_fmt_bytes(nbytes)}, reserved "
                 f"{_fmt_bytes(self.reserved)}]")
+        if self.pool is not None:
+            self.pool.acquire(self, nbytes, tag, self.wait_s)
         self.reserved += nbytes
         self.by_tag[tag] = self.by_tag.get(tag, 0) + nbytes
         self.peak = max(self.peak, self.reserved)
 
     def free(self, nbytes: int, tag: str = "operator") -> None:
         nbytes = int(nbytes)
-        self.reserved = max(0, self.reserved - nbytes)
+        released = min(max(nbytes, 0), self.reserved)
+        self.reserved -= released
         if tag in self.by_tag:
             self.by_tag[tag] = max(0, self.by_tag[tag] - nbytes)
+        if self.pool is not None:
+            self.pool.release(released)
+
+    def poll(self) -> None:
+        """Cooperative kill checkpoint: raise if the low-memory killer (or
+        a `memory` fault site) marked this query."""
+        if self.kill_reason is not None:
+            raise ClusterOutOfMemoryError(self.kill_reason)
+
+    def clear_kill(self) -> None:
+        """Clear the kill mark under the pool lock (a task-scope retry is
+        about to re-run): unlocked clearing could race a concurrent
+        re-kill and leave a requester waiting on a victim that never
+        unwinds."""
+        if self.pool is not None:
+            with self.pool._cond:
+                self.kill_reason = None
+                self.pool._cond.notify_all()
+        else:
+            self.kill_reason = None
+
+    def rollback_to(self, mark: int) -> None:
+        """Release everything reserved past `mark` back to the pool — a
+        failed attempt's unfreed reservations must not stack across
+        retries. (by_tag is advisory after a rollback: it names offenders
+        in error messages, it is not the ledger.)"""
+        delta = self.reserved - int(mark)
+        if delta <= 0:
+            return
+        self.reserved = int(mark)
+        if self.pool is not None:
+            self.pool.release(delta)
+
+    def reset_attempt(self) -> None:
+        """Between retry attempts: drop the failed attempt's reservations
+        and clear a kill mark so the re-run starts clean (all bytes go
+        back to the pool — a killed victim releases what the killer was
+        reclaiming, not just its latest task's delta)."""
+        if self.pool is not None:
+            self.pool.reset_context(self)
+        else:
+            self.reserved = 0
+            self.kill_reason = None
+        self.by_tag.clear()
+
+    def close(self) -> int:
+        """Query end: the ledger must read zero. Returns the leaked byte
+        count (0 when clean), releases any remainder back to the pool, and
+        unregisters from it."""
+        leaked = self.reserved
+        self.rollback_to(0)
+        if self.pool is not None:
+            self.pool.unregister(self)
+        return leaked

@@ -4,11 +4,16 @@ Reference parity: execution/QueryTracker.java + QueryStateMachine.java —
 every statement entering a runner is registered with a monotonically
 assigned id and walks QUEUED -> RUNNING -> FINISHED | FAILED | CANCELED,
 carrying the stats rollup (row count, wall time, error name, retry/fault
-counters) that system.runtime.queries and the HTTP server surface. The
-reference's CAS state machine with listeners collapses to a lock-guarded
-registry; transitions can now arrive from two threads (the server's
-executor runs the query while an HTTP thread cancels it), so every
-mutation takes the registry lock.
+counters, resource group, memory-pool reservation/kill/leak counters)
+that system.runtime.queries and the HTTP server surface.
+
+Concurrency model (round 7): transitions arrive from MANY threads (the
+server's executor pool runs queries concurrently while HTTP threads
+cancel and page), so the registry lock guards membership and each
+QueryInfo carries its own transition lock — the per-query CAS of the
+reference's state machine. Illegal transitions (FINISHED -> RUNNING,
+resurrecting a CANCELED query) raise instead of silently corrupting the
+rollup; cancel keeps its race-tolerant first-writer-wins semantics.
 """
 
 from __future__ import annotations
@@ -17,7 +22,7 @@ import dataclasses
 import itertools
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 QUEUED = "QUEUED"
 RUNNING = "RUNNING"
@@ -26,6 +31,14 @@ FAILED = "FAILED"
 CANCELED = "CANCELED"
 
 TERMINAL = (FINISHED, FAILED, CANCELED)
+
+# QueryStateMachine's legal edges (terminal states have none)
+_ALLOWED = {
+    RUNNING: (QUEUED,),
+    FINISHED: (RUNNING,),
+    FAILED: (QUEUED, RUNNING),
+    CANCELED: (QUEUED, RUNNING),
+}
 
 
 @dataclasses.dataclass
@@ -42,6 +55,17 @@ class QueryInfo:
     error_name: Optional[str] = None
     retries: int = 0
     faults_injected: int = 0
+    resource_group: Optional[str] = None
+    pool_peak_bytes: int = 0
+    memory_kills: int = 0        # times the low-memory killer chose us
+    leaked_bytes: int = 0        # nonzero ledger at successful end
+    warnings: List[str] = dataclasses.field(default_factory=list)
+    # the live memory context while executing (None before/after): lets
+    # system.runtime.queries read the current pool reservation
+    mem: Optional[object] = dataclasses.field(
+        default=None, repr=False, compare=False)
+    lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False, compare=False)
 
     @property
     def wall_ms(self) -> Optional[int]:
@@ -50,12 +74,26 @@ class QueryInfo:
         end = self.ended if self.ended is not None else time.monotonic()
         return int((end - self.started) * 1000)
 
+    @property
+    def pool_reserved_bytes(self) -> int:
+        ctx = self.mem
+        return int(ctx.reserved) if ctx is not None else 0
+
+    def _check_transition(self, to_state: str) -> None:
+        """Validate an edge; the caller sets the stats fields and THEN
+        publishes the state (readers don't take the per-info lock, so the
+        terminal state must land last)."""
+        if self.state not in _ALLOWED[to_state]:
+            raise ValueError(
+                f"illegal query state transition {self.state} -> "
+                f"{to_state} for {self.query_id}")
+
 
 class QueryTracker:
     def __init__(self, keep: int = 200):
         self._lock = threading.Lock()
         self._seq = itertools.count(1)
-        self._queries: Dict[str, QueryInfo] = {}
+        self._queries: "dict[str, QueryInfo]" = {}
         self._keep = keep
 
     def begin(self, sql: str, user: str = "user",
@@ -79,19 +117,22 @@ class QueryTracker:
             return info
 
     def running(self, info: QueryInfo) -> None:
-        with self._lock:
-            info.state = RUNNING
+        with info.lock:
+            info._check_transition(RUNNING)
             info.started = time.monotonic()
+            info.state = RUNNING
 
     def finish(self, info: QueryInfo, rows: int) -> None:
-        with self._lock:
+        with info.lock:
+            info._check_transition(FINISHED)
             info.rows = rows
             info.ended = time.monotonic()
             info.state = FINISHED
 
     def fail(self, info: QueryInfo, error: str,
              error_name: Optional[str] = None) -> None:
-        with self._lock:
+        with info.lock:
+            info._check_transition(FAILED)
             info.error = error
             info.error_name = error_name
             info.ended = time.monotonic()
@@ -99,9 +140,10 @@ class QueryTracker:
 
     def cancel(self, info: QueryInfo,
                reason: str = "Query was canceled by user") -> None:
-        with self._lock:
+        with info.lock:
             if info.state in TERMINAL:
                 return        # cancel raced a finish: first writer wins
+            info._check_transition(CANCELED)
             info.error = reason
             info.error_name = "USER_CANCELED"
             info.ended = time.monotonic()

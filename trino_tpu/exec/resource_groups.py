@@ -1,0 +1,252 @@
+"""Resource groups: admission control + weighted-fair query scheduling.
+
+Reference parity: execution/resourcegroups/InternalResourceGroup.java +
+InternalResourceGroupManager.java:66 — a tree of named groups, each with
+`max_queued` (admission: an over-limit submit fails QUERY_QUEUE_FULL),
+`hard_concurrency` (cap on simultaneously running queries in the subtree),
+`soft_memory_limit_bytes` (a group whose running queries hold this much of
+the node pool admits no new query until usage drops), and a
+`scheduling_weight` used for WEIGHTED_FAIR selection across siblings.
+
+Scheduling is stride-based (the deterministic form of the reference's
+WEIGHTED_FAIR policy): every group carries a virtual `pass` advanced by
+1/weight per started query; when an executor slot frees, selection walks
+the tree picking the eligible child with the smallest pass. Under
+saturation a 2:1-weighted sibling pair therefore drains queries 2:1 —
+exactly, not just in expectation.
+
+Group names are dotted paths ("adhoc.alice"); intermediate groups are
+created on demand, and limits are enforced at EVERY level of the chain
+(InternalResourceGroup.canQueueMore / canRunMore walk the ancestors).
+
+The manager is the server's dispatch queue: `submit` enqueues, the
+executor pool's workers block in `take`, and `finish` releases the slot.
+A module-level registry of live managers backs
+system.runtime.resource_groups.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import weakref
+from typing import Deque, Dict, List, Optional, Tuple
+
+DEFAULT_HARD_CONCURRENCY = 16
+DEFAULT_MAX_QUEUED = 200
+
+# live managers, for system.runtime.resource_groups (weak: a stopped
+# server's manager disappears with it)
+_MANAGERS: "weakref.WeakSet[ResourceGroupManager]" = weakref.WeakSet()
+
+
+class ResourceGroup:
+    """One node of the group tree. Counters are guarded by the owning
+    manager's condition lock."""
+
+    def __init__(self, name: str, parent: Optional["ResourceGroup"] = None,
+                 hard_concurrency: int = DEFAULT_HARD_CONCURRENCY,
+                 max_queued: int = DEFAULT_MAX_QUEUED,
+                 soft_memory_limit_bytes: Optional[int] = None,
+                 weight: int = 1):
+        self.name = name                      # full dotted path
+        self.parent = parent
+        self.children: Dict[str, ResourceGroup] = {}
+        self.hard_concurrency = int(hard_concurrency)
+        self.max_queued = int(max_queued)
+        self.soft_memory_limit_bytes = soft_memory_limit_bytes
+        self.weight = max(1, int(weight))
+        self.queue: Deque[Tuple[object, str]] = collections.deque()
+        self.queued = 0          # subtree queued count (incl. own queue)
+        self.running: set = set()  # subtree running query ids
+        self.started = 0
+        self.finished = 0
+        self._pass = 0.0         # stride virtual time (starts / weight)
+
+    def memory_usage(self) -> int:
+        """Node-pool bytes currently held by this subtree's running
+        queries (the soft_memory_limit denominator)."""
+        from trino_tpu.exec.memory import NODE_POOL
+        return sum(NODE_POOL.reserved_of(qid) for qid in self.running)
+
+    def _chain(self) -> List["ResourceGroup"]:
+        out, g = [], self
+        while g is not None:
+            out.append(g)
+            g = g.parent
+        return out
+
+
+class ResourceGroupManager:
+    """The group tree + the dispatch queue the server's executor pool
+    drains (InternalResourceGroupManager + the dispatcher's queue)."""
+
+    def __init__(self, default_hard_concurrency: int =
+                 DEFAULT_HARD_CONCURRENCY,
+                 default_max_queued: int = DEFAULT_MAX_QUEUED,
+                 max_total_queued: Optional[int] = None,
+                 max_groups: int = 64):
+        self._cond = threading.Condition()
+        self.default_hard_concurrency = default_hard_concurrency
+        self.default_max_queued = default_max_queued
+        # manager-wide admission bound (the round-5 global queue bound):
+        # per-group max_queued alone would let a client mint fresh
+        # groups, each with its own budget
+        self.max_total_queued = max_total_queued
+        # cap on CLIENT-minted groups (submit with an unknown name):
+        # beyond it, unknown names route to "global" instead of growing
+        # server state without bound from untrusted header input
+        self.max_groups = max_groups
+        self._top: Dict[str, ResourceGroup] = {}
+        self._by_name: Dict[str, ResourceGroup] = {}
+        _MANAGERS.add(self)
+
+    # ------------------------------------------------------------ the tree
+
+    def get_or_create(self, name: str, **config) -> ResourceGroup:
+        with self._cond:
+            return self._get_or_create_locked(name, **config)
+
+    def _get_or_create_locked(self, name: str, **config) -> ResourceGroup:
+        name = name.strip() or "global"
+        g = self._by_name.get(name)
+        if g is not None:
+            if config:
+                self._configure_locked(g, **config)
+            return g
+        parent = None
+        if "." in name:
+            parent = self._get_or_create_locked(name.rsplit(".", 1)[0])
+        g = ResourceGroup(
+            name, parent,
+            hard_concurrency=config.pop("hard_concurrency",
+                                        self.default_hard_concurrency),
+            max_queued=config.pop("max_queued", self.default_max_queued),
+            soft_memory_limit_bytes=config.pop("soft_memory_limit_bytes",
+                                               None),
+            weight=config.pop("weight", 1))
+        siblings = self._top if parent is None else parent.children
+        # a newcomer joins at the CURRENT virtual time, not pass 0 —
+        # otherwise a group created late monopolizes slots until it
+        # catches up with long-lived siblings (stride-scheduler rule)
+        g._pass = min((s._pass for s in siblings.values()), default=0.0)
+        self._by_name[name] = g
+        siblings[name] = g
+        return g
+
+    def configure(self, name: str, **config) -> ResourceGroup:
+        """Create-or-update a group's limits (the file-based
+        ResourceGroupConfigurationManager analog, driven from code)."""
+        with self._cond:
+            g = self._get_or_create_locked(name)
+            self._configure_locked(g, **config)
+            self._cond.notify_all()
+            return g
+
+    @staticmethod
+    def _configure_locked(g: ResourceGroup, **config) -> None:
+        for key in ("hard_concurrency", "max_queued", "weight"):
+            if key in config:
+                setattr(g, key, max(0, int(config.pop(key))) if
+                        key != "weight" else max(1, int(config.pop(key))))
+        if "soft_memory_limit_bytes" in config:
+            g.soft_memory_limit_bytes = config.pop("soft_memory_limit_bytes")
+        if config:
+            raise TypeError(f"unknown resource group config: {config}")
+
+    def groups(self) -> List[ResourceGroup]:
+        with self._cond:
+            return sorted(self._by_name.values(), key=lambda g: g.name)
+
+    # -------------------------------------------------------- the dispatch
+
+    def submit(self, group_name: str, item: object, query_id: str) -> bool:
+        """Admit + enqueue. False = some level of the chain (or the
+        manager-wide bound) is at max_queued — the caller surfaces
+        QUERY_QUEUE_FULL."""
+        with self._cond:
+            if self.max_total_queued is not None and sum(
+                    t.queued for t in self._top.values()
+            ) >= self.max_total_queued:
+                return False
+            if group_name.strip() not in self._by_name \
+                    and len(self._by_name) >= self.max_groups:
+                group_name = "global"   # don't mint unbounded groups
+            g = self._get_or_create_locked(group_name)
+            for a in g._chain():
+                if a.queued >= a.max_queued:
+                    return False
+            g.queue.append((item, query_id))
+            for a in g._chain():
+                a.queued += 1
+            self._cond.notify_all()
+            return True
+
+    def take(self, timeout: Optional[float] = None
+             ) -> Optional[Tuple[ResourceGroup, object]]:
+        """Block until some eligible group has a queued item; pop it by
+        weighted-fair selection and mark it running. None on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                leaf = self._pick_locked()
+                if leaf is not None:
+                    item, qid = leaf.queue.popleft()
+                    for a in leaf._chain():
+                        a.queued -= 1
+                        a.running.add(qid)
+                        a.started += 1
+                        a._pass += 1.0 / a.weight
+                    return leaf, item
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    self._cond.wait(remaining)
+                else:
+                    self._cond.wait()
+
+    def finish(self, group: ResourceGroup, query_id: str) -> None:
+        with self._cond:
+            for a in group._chain():
+                a.running.discard(query_id)
+                a.finished += 1
+            self._cond.notify_all()
+
+    # ------------------------------------------------- weighted-fair pick
+
+    def _eligible_locked(self, g: ResourceGroup) -> bool:
+        if g.queued == 0:
+            return False
+        if len(g.running) >= g.hard_concurrency:
+            return False
+        lim = g.soft_memory_limit_bytes
+        if lim is not None and g.memory_usage() >= lim:
+            return False
+        return True
+
+    def _pick_locked(self) -> Optional[ResourceGroup]:
+        """Smallest pass-vector (root-to-leaf) among groups whose own
+        queue is nonempty and whose whole ancestor chain can run — the
+        lexicographic form of recursive stride descent, with correct
+        backtracking past subtrees blocked deeper down."""
+        best = best_key = None
+        for g in self._by_name.values():
+            if not g.queue:
+                continue
+            chain = g._chain()               # leaf .. root
+            if any(not self._eligible_locked(a) for a in chain):
+                continue
+            key = tuple((a._pass, a.name) for a in reversed(chain))
+            if best is None or key < best_key:
+                best, best_key = g, key
+        return best
+
+
+def list_all_groups() -> List[ResourceGroup]:
+    """Every live manager's groups (system.runtime.resource_groups)."""
+    out: List[ResourceGroup] = []
+    for mgr in list(_MANAGERS):
+        out.extend(mgr.groups())
+    return sorted(out, key=lambda g: g.name)

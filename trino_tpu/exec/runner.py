@@ -80,14 +80,36 @@ class LocalQueryRunner:
         self.session = session or Session()
         self._prepared = {}
         # per-query fault-tolerance state (set in execute, read by the
-        # execution paths; one query at a time per runner)
+        # execution paths; one query at a time per runner — concurrent
+        # queries each run on a for_query() clone)
         self._deadline = None
         self._faults = None
+        self._memory = None
         self._retries = 0
         # cumulative counters across the runner's lifetime (bench.py
         # emits these alongside timings) + the last query's snapshot
         self.stats = {"retries": 0, "faults_injected": 0}
         self.last_query_stats = {"retries": 0, "faults_injected": 0}
+
+    def for_query(self) -> "LocalQueryRunner":
+        """Per-query view of this runner: shared catalogs/metadata/
+        prepared statements, PRIVATE session and fault-tolerance state —
+        the unit the server's executor pool runs, so concurrent queries
+        never share a session property bag or a deadline
+        (SqlQueryExecution-per-query vs the shared QueryRunner)."""
+        import copy
+        clone = copy.copy(self)
+        clone.session = Session(
+            catalog=self.session.catalog, schema=self.session.schema,
+            user=self.session.user, start_date=self.session.start_date,
+            properties=dict(self.session.properties))
+        clone._deadline = None
+        clone._faults = None
+        clone._memory = None
+        clone._retries = 0
+        clone.stats = {"retries": 0, "faults_injected": 0}
+        clone.last_query_stats = {"retries": 0, "faults_injected": 0}
+        return clone
 
     @classmethod
     def tpch(cls, schema: str = "tiny") -> "LocalQueryRunner":
@@ -122,6 +144,8 @@ class LocalQueryRunner:
                                       is_retryable)
         from trino_tpu.exec.deadline import QueryDeadline
         from trino_tpu.exec.faults import FaultInjector
+        from trino_tpu.exec.memory import (NODE_POOL, QueryMemoryContext,
+                                           degrade_to_spill)
         from trino_tpu.exec.query_tracker import TRACKER
         info = TRACKER.begin(sql, user=self.session.user, query_id=query_id)
         self._retries = 0
@@ -139,27 +163,53 @@ class LocalQueryRunner:
                 policy = str(self.session.get("retry_policy")).upper()
                 attempts = max(1, int(self.session.get("retry_attempts"))) \
                     if policy == "QUERY" else 1
+                # the query level of the query->operator->node accounting
+                # hierarchy: the ledger reserves against the node pool,
+                # making this query visible to the low-memory killer
+                self._memory = QueryMemoryContext(
+                    int(self.session.get("query_max_memory")),
+                    query_id=info.query_id, pool=NODE_POOL,
+                    wait_s=float(
+                        self.session.get("cluster_memory_wait_ms")) / 1e3)
+                info.mem = self._memory
+                info.resource_group = str(
+                    self.session.get("resource_group"))
             except (TypeError, ValueError) as e:
                 from trino_tpu.errors import InvalidSessionPropertyError
                 raise InvalidSessionPropertyError(
                     f"invalid session property value: {e}") from e
             stmt = parse_statement(sql)
             attempt = 0
+            spill_forced = False
             while True:
                 attempt += 1
                 try:
-                    result = self._execute_statement(stmt)
+                    if spill_forced:
+                        with degrade_to_spill(self.session):
+                            result = self._execute_statement(stmt)
+                    else:
+                        result = self._execute_statement(stmt)
                     break
                 except Exception as e:
-                    if attempt >= attempts or not is_retryable(e):
+                    if (attempts > 1 and not spill_forced
+                            and _is_memory_pressure(e)):
+                        # the killer's victim (or injected pressure):
+                        # once per query, re-run with the spill path
+                        # forced so the retry's footprint shrinks —
+                        # this degrade re-run is free
+                        spill_forced = True
+                        attempt -= 1
+                    elif attempt >= attempts or not is_retryable(e):
                         raise
                     self._retries += 1
+                    self._memory.reset_attempt()
                     self._backoff(attempt)
         except BaseException as e:
             # BaseException too: a KeyboardInterrupt/SystemExit escaping
             # mid-query must not leave a forever-RUNNING phantom row in
             # system.runtime.queries
             self._finish_query_stats(info)
+            self._close_memory(info, failed=True)
             if isinstance(e, QueryCanceledError):
                 TRACKER.cancel(info, str(e))
             else:
@@ -169,8 +219,31 @@ class LocalQueryRunner:
         finally:
             self._deadline = None
         self._finish_query_stats(info)
+        self._close_memory(info, failed=False)
         TRACKER.finish(info, len(result.rows))
         return result
+
+    def _close_memory(self, info, failed: bool) -> None:
+        """Close the query's ledger: record peak/kill counters and run
+        the reservation LEAK DETECTOR — a successful query whose ledger
+        is nonzero leaked an operator reservation (a missing free());
+        surfaced as a query warning plus pool counters rather than an
+        error, since the bytes ARE released here."""
+        ctx = self._memory
+        if ctx is None:
+            return
+        from trino_tpu.exec.memory import NODE_POOL, _fmt_bytes
+        leaked = ctx.close()
+        info.pool_peak_bytes = ctx.peak
+        info.memory_kills = ctx.kills
+        if leaked and not failed:
+            info.leaked_bytes = leaked
+            info.warnings.append(
+                f"reservation leak: query ended with {_fmt_bytes(leaked)} "
+                f"still reserved (tags: "
+                f"{ {k: v for k, v in ctx.by_tag.items() if v} })")
+            NODE_POOL.record_leak(leaked)
+        self._memory = None
 
     def cancel_current(self) -> None:
         """Cancel the in-flight query (no-op when idle): sets the cancel
@@ -207,15 +280,20 @@ class LocalQueryRunner:
     def _check_deadline(self) -> None:
         if self._deadline is not None:
             self._deadline.check()
+        if self._memory is not None:
+            self._memory.poll()     # low-memory-killer checkpoint
 
     def _retry_task(self, label: str, fn):
         """Run one retry scope ('task': a fragment attempt, an exchange
         apply, the local plan run) under the session's retry policy.
         Retryable errors (errors.is_retryable: injected faults, exchange
         transport) re-run the task up to retry_attempts times with
-        backoff under retry_policy=TASK; an ExceededMemoryLimitError gets
-        ONE re-run with the spill path forced on (graceful degradation)
-        when any retry policy is active; everything else propagates.
+        backoff under retry_policy=TASK; memory pressure — an
+        ExceededMemoryLimitError or a low-memory-killer
+        CLUSTER_OUT_OF_MEMORY — gets ONE re-run with the spill path
+        forced on (graceful degradation) when any retry policy is
+        active; everything else propagates. A failed attempt's unfreed
+        reservations roll back so retries don't stack phantom bytes.
         Each attempt is also a fault-injection scope (faults.begin_task),
         so chaos arms at most one site per attempt."""
         from trino_tpu.errors import is_retryable
@@ -224,6 +302,7 @@ class LocalQueryRunner:
         policy = str(self.session.get("retry_policy")).upper()
         attempts = max(1, int(self.session.get("retry_attempts"))) \
             if policy == "TASK" else 1
+        mark = self._memory.reserved if self._memory is not None else 0
         spill_forced = False
         attempt = 0
         while True:
@@ -235,17 +314,30 @@ class LocalQueryRunner:
                     with degrade_to_spill(self.session):
                         return fn()
                 return fn()
-            except ExceededMemoryLimitError:
-                if spill_forced or policy == "NONE":
-                    raise
-                spill_forced = True
-                attempt -= 1          # the degrade re-run is free
-                self._retries += 1
             except Exception as e:
-                if attempt >= attempts or not is_retryable(e):
+                memory_pressure = (isinstance(e, ExceededMemoryLimitError)
+                                   or _is_memory_pressure(e))
+                if memory_pressure and not spill_forced \
+                        and policy != "NONE":
+                    spill_forced = True
+                    attempt -= 1      # the degrade re-run is free
+                    self._retries += 1
+                elif attempt >= attempts or not is_retryable(e):
                     raise
-                self._retries += 1
-                self._backoff(attempt)
+                else:
+                    self._retries += 1
+                    self._backoff(attempt)
+                if self._memory is not None:
+                    # roll back THIS attempt's delta only — bytes below
+                    # `mark` belong to enclosing scopes (completed
+                    # fragments' still-live state on the query-wide
+                    # shared ledger) and must survive a task retry. In
+                    # practice mark is ~0 at every scope entry, so a
+                    # killed victim hands back everything the killer
+                    # wanted; the kill mark clears under the pool lock.
+                    self._memory.rollback_to(mark)
+                    if memory_pressure:
+                        self._memory.clear_kill()
 
     def _execute_statement(self, stmt: t.Statement) -> MaterializedResult:
         if isinstance(stmt, t.Query):
@@ -334,6 +426,8 @@ class LocalQueryRunner:
         executor = LocalExecutionPlanner(self.metadata, self.session)
         executor.faults = self._faults if chaos else None
         executor.deadline = self._deadline
+        if self._memory is not None:
+            executor.memory = self._memory   # query-level shared ledger
         stream = executor.execute(plan)
         types = [s.type for s in plan.symbols]
         rows: List[Tuple[Any, ...]] = []
@@ -501,6 +595,13 @@ class LocalQueryRunner:
         return MaterializedResult(
             ["Column", "Type"], [T.VARCHAR, T.VARCHAR],
             [(c.name, c.type.display()) for c in meta.columns])
+
+
+def _is_memory_pressure(exc: BaseException) -> bool:
+    """A low-memory-killer verdict or injected node-pool pressure —
+    retryable, and worth ONE spill-forced re-run."""
+    from trino_tpu.errors import CLUSTER_OUT_OF_MEMORY, TrinoError
+    return isinstance(exc, TrinoError) and exc.code is CLUSTER_OUT_OF_MEMORY
 
 
 def _contains_writer(node) -> bool:

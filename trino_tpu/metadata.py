@@ -21,8 +21,6 @@ _query_ids = itertools.count(1)
 SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     "join_distribution_type": "AUTOMATIC",   # BROADCAST | PARTITIONED
     "join_reordering_strategy": "AUTOMATIC",  # NONE | ELIMINATE_CROSS_JOINS | AUTOMATIC
-    "hash_partition_count": 8,
-    "task_concurrency": 1,
     "query_max_memory": 16 << 30,
     "page_capacity": 1 << 16,      # rows per device page
     "scan_page_capacity": 1 << 22,  # max rows per scan page (big fused scans)
@@ -34,8 +32,6 @@ SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     "probe_coalesce_rows": 1 << 25,
     "distributed_sort": True,
     "enable_dynamic_filtering": True,
-    "push_aggregation_through_outer_join": True,
-    "colocated_join": True,
     # spill defaults ON (SystemSessionProperties spill_enabled; the v5e
     # HBM is the scarce resource — a >threshold INNER build keeps only its
     # sorted key array on device and pays host gathers at match count)
@@ -68,6 +64,14 @@ SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     # run time counts from queueing, execution time from planning start.
     "query_max_run_time": "",
     "query_max_execution_time": "",
+    # resource governance (InternalResourceGroup + ClusterMemoryManager
+    # analogs): `resource_group` routes the query through the server's
+    # group tree (admission + weighted-fair scheduling) and is stamped on
+    # system.runtime.queries; `cluster_memory_wait_ms` bounds how long a
+    # reservation blocks for a low-memory-killer victim to release node
+    # pool bytes before failing retryable (CLUSTER_OUT_OF_MEMORY).
+    "resource_group": "global",
+    "cluster_memory_wait_ms": 2000,
 }
 
 

@@ -8,39 +8,46 @@ Session state travels in X-Trino-* headers both ways (Set-Session /
 Clear-Session on SET/RESET), keeping the server stateless across requests
 the way the reference's dispatcher is.
 
-Dispatch model (round 5): queries QUEUE (FIFO) and ONE dedicated executor
-thread drains them — the single-controller JAX process can only run one
-device program at a time, so max_running=1 is the honest resource-group
-shape — while HTTP threads page any FINISHED query's buffered results
-concurrently. Admission control: the queue is bounded
-(`max_queued_queries`) and an over-limit submit fails with
-QUERY_QUEUE_FULL, the InternalResourceGroup.canQueueMore analog.
+Dispatch model (round 7): queries submit into a RESOURCE-GROUP tree
+(exec/resource_groups.py — the InternalResourceGroupManager analog) and a
+pool of `max_running` executor threads drains it by weighted-fair
+selection. Each query executes on a `runner.for_query()` clone (private
+session + fault-tolerance state over shared catalogs), so independent
+queries genuinely interleave: JAX dispatch is thread-safe and per-query
+device programs queue on the device stream. Admission control: every
+level of a query's group chain bounds its queue (`max_queued`) and an
+over-limit submit fails with QUERY_QUEUE_FULL
+(InternalResourceGroup.canQueueMore); `hard_concurrency` caps a group's
+simultaneously running queries and `soft_memory_limit_bytes` stops
+admitting queries from a group whose node-pool usage is over the line.
+The query's group comes from the `resource_group` session property
+(X-Trino-Session header).
 
 Fault tolerance (round 6): the registry is lock-guarded (HTTP threads and
-the executor mutate it concurrently) and pruned past `keep` terminal
+the executors mutate it concurrently) and pruned past `keep` terminal
 queries (a pruned id answers 410 Gone, not 404). Every query registers in
 the process-wide TRACKER under its server id, so system.runtime.queries
 reflects server traffic. DELETE on a RUNNING query sets its cancel event;
 the runner observes it at the next cooperative checkpoint
 (exec/deadline.py), transitions the query to CANCELED, and frees the
 executor for the next queued query. `query_timeout_s` is the per-query
-wall-clock cap (resource-group hard limit analog): one hung query fails
-with EXCEEDED_TIME_LIMIT instead of wedging the queue forever.
+wall-clock cap: one hung query fails with EXCEEDED_TIME_LIMIT instead of
+wedging an executor forever.
 """
 
 from __future__ import annotations
 
 import itertools
 import json
-import queue as queue_mod
 import re
 import threading
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from trino_tpu.errors import QueryCanceledError
+from trino_tpu.exec.resource_groups import ResourceGroupManager
 from trino_tpu.exec.runner import MaterializedResult
 from trino_tpu.server import protocol
 
@@ -85,20 +92,28 @@ class TrinoServer:
 
     def __init__(self, runner, host: str = "127.0.0.1", port: int = 0,
                  max_queued: int = 200, keep: int = 200,
-                 query_timeout_s: Optional[float] = None):
+                 query_timeout_s: Optional[float] = None,
+                 max_running: int = 4,
+                 resource_groups: Optional[ResourceGroupManager] = None):
         self.runner = runner
         self.keep = keep
         self.query_timeout_s = query_timeout_s
+        self.max_running = max(1, int(max_running))
+        # the group tree this server dispatches through; callers may hand
+        # in a preconfigured manager (group limits/weights). max_queued
+        # stays the SERVER-WIDE admission bound (round-5 contract) on top
+        # of per-group budgets
+        self.groups = resource_groups or ResourceGroupManager(
+            default_max_queued=max_queued, max_total_queued=max_queued)
         self._lock = threading.Lock()
         self._queries: Dict[str, _Query] = {}
         self._pruned: Dict[str, None] = {}   # ordered set of purged ids
         self._seq = itertools.count(1)
-        self._queue: "queue_mod.Queue[Optional[_Query]]" = \
-            queue_mod.Queue(maxsize=max_queued)
+        self._stopping = threading.Event()
         handler = self._make_handler()
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None
-        self._executor: Optional[threading.Thread] = None
+        self._executors: List[threading.Thread] = []
 
     # ---------------------------------------------------------- lifecycle
 
@@ -112,8 +127,11 @@ class TrinoServer:
         return f"http://{host}:{port}"
 
     def start(self) -> "TrinoServer":
-        self._executor = threading.Thread(target=self._drain, daemon=True)
-        self._executor.start()
+        for i in range(self.max_running):
+            th = threading.Thread(target=self._drain, daemon=True,
+                                  name=f"query-executor-{i}")
+            th.start()
+            self._executors.append(th)
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -122,17 +140,43 @@ class TrinoServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
-        self._queue.put(None)          # executor shutdown sentinel
-        if self._executor:
-            self._executor.join(timeout=10)
+        self._stopping.set()
+        for th in self._executors:
+            th.join(timeout=10)
         if self._thread:
             self._thread.join(timeout=5)
 
     # ---------------------------------------------------------- execution
 
+    @staticmethod
+    def _session_overrides(headers: dict) -> dict:
+        """Parse the X-Trino-Session header once for everyone (reference
+        wire format, ProtocolHeaders/StatementClientV1: comma-separated
+        key=value pairs, values URL-encoded so raw commas never appear
+        inside a value)."""
+        from urllib.parse import unquote
+        overrides = {}
+        for part in headers.get("x-trino-session", "").split(","):
+            if "=" in part:
+                k, _, v = part.partition("=")
+                overrides[k.strip()] = unquote(v.strip())
+        return overrides
+
+    def _group_for(self, q: _Query) -> str:
+        """The query's resource group: the `resource_group` key of the
+        client's X-Trino-Session header, else the base session default."""
+        group = self._session_overrides(q.headers).get("resource_group")
+        if group:
+            return group
+        try:
+            return str(self.runner.session.get("resource_group"))
+        except Exception:
+            return "global"
+
     def _submit(self, sql: str, headers) -> _Query:
         """Admit + enqueue (DispatchManager.createQuery analog): returns
-        immediately with the QUEUED query; the executor thread runs it."""
+        immediately with the QUEUED query; an executor-pool worker runs
+        it after weighted-fair selection from its resource group."""
         from trino_tpu.exec.query_tracker import TRACKER
         day = time.strftime("%Y%m%d")
         qid = f"{day}_{next(self._seq):06d}_{uuid.uuid4().hex[:5]}"
@@ -142,15 +186,15 @@ class TrinoServer:
                    {k.lower(): v for k, v in headers.items()})
         user = q.headers.get("x-trino-user", "user")
         q.info = TRACKER.begin(sql, user=user, query_id=qid)
+        q.info.resource_group = group = self._group_for(q)
         with self._lock:
             self._queries[qid] = q
             self._prune_locked()
-        try:
-            self._queue.put_nowait(q)
-        except queue_mod.Full:
+        if not self.groups.submit(group, q, qid):
             q.state = "FAILED"
             q.error = protocol.error_json(
-                "Too many queued queries", error_name="QUERY_QUEUE_FULL",
+                f"Too many queued queries for resource group {group!r}",
+                error_name="QUERY_QUEUE_FULL",
                 error_code=131074, error_type="INSUFFICIENT_RESOURCES")
             TRACKER.fail(q.info, "Too many queued queries",
                          error_name="QUERY_QUEUE_FULL")
@@ -172,39 +216,43 @@ class TrinoServer:
             self._pruned.pop(next(iter(self._pruned)))
 
     def _drain(self) -> None:
-        """Executor loop: one query at a time against the single-controller
-        runner; paging of finished queries proceeds on HTTP threads."""
+        """Executor-pool worker: block on the resource-group manager for
+        the next weighted-fair pick, run it on a per-query runner clone;
+        paging of finished queries proceeds on HTTP threads."""
         from trino_tpu.exec.query_tracker import TRACKER
-        while True:
-            q = self._queue.get()
-            if q is None:
-                return
-            if q.cancelled:
-                q.state = "CANCELED"
-                TRACKER.cancel(q.info)
+        while not self._stopping.is_set():
+            got = self.groups.take(timeout=0.2)
+            if got is None:
                 continue
-            q.state = "RUNNING"
+            group, q = got
             try:
-                self._execute(q)
-                if q.cancelled and q.result is None:
+                if q.cancelled:
                     q.state = "CANCELED"
-                else:
-                    q.state = "FAILED" if q.error is not None \
-                        else "FINISHED"
-            except BaseException as e:  # noqa: BLE001 — keep draining
-                q.error = protocol.error_from_exception(e)
-                q.state = "FAILED"
+                    TRACKER.cancel(q.info)
+                    continue
+                q.state = "RUNNING"
+                try:
+                    self._execute(q)
+                    if q.cancelled and q.result is None:
+                        q.state = "CANCELED"
+                    else:
+                        q.state = "FAILED" if q.error is not None \
+                            else "FINISHED"
+                except BaseException as e:  # noqa: BLE001 — keep draining
+                    q.error = protocol.error_from_exception(e)
+                    q.state = "FAILED"
+            finally:
+                self.groups.finish(group, q.query_id)
 
     def _execute(self, q: _Query) -> None:
         headers = q.headers
-        session = self.runner.session
-        saved = (session.catalog, session.schema)
-        # snapshot ALL properties: restoring only header-derived keys
-        # would leak one client's SET SESSION into every other client
-        # (the protocol is stateless — the X-Trino-Set-Session response
-        # header hands the state back to THIS client, which re-sends it
-        # via X-Trino-Session on its next request)
-        saved_props = dict(session.properties)
+        # per-query runner clone: a PRIVATE session over the shared
+        # catalogs, so concurrent executors never cross-contaminate
+        # session state (the protocol is stateless — the
+        # X-Trino-Set-Session response header hands SET SESSION state
+        # back to THIS client, which re-sends it via X-Trino-Session)
+        runner = self.runner.for_query()
+        session = runner.session
         try:
             catalog = headers.get("x-trino-catalog")
             schema = headers.get("x-trino-schema")
@@ -212,34 +260,20 @@ class TrinoServer:
                 session.catalog = catalog
             if schema:
                 session.schema = schema
-            overrides = {}
-            props_header = headers.get("x-trino-session", "")
-            # reference wire format (ProtocolHeaders/StatementClientV1):
-            # comma-separated key=value pairs, values URL-encoded (so
-            # raw commas never appear inside a value)
-            from urllib.parse import unquote
-            for part in props_header.split(","):
-                if "=" in part:
-                    k, _, v = part.partition("=")
-                    overrides[k.strip()] = unquote(v.strip())
-            for k, v in overrides.items():
+            for k, v in self._session_overrides(headers).items():
                 try:
                     session.set(k, v)
                 except Exception:
                     pass
-            try:
-                # the runner builds the query's deadline AFTER the session
-                # overrides apply (so header-sent limits bind), from the
-                # submit time (query_max_run_time counts queueing) capped
-                # by the server's per-query wall-clock limit, and adopts
-                # q.cancel_event so DELETE cancels cooperatively
-                result = self.runner.execute(
-                    q.sql, query_id=q.query_id, queued_at=q.started,
-                    wall_cap_s=self.query_timeout_s,
-                    cancel_event=q.cancel_event)
-            finally:
-                session.properties.clear()
-                session.properties.update(saved_props)
+            # the runner builds the query's deadline AFTER the session
+            # overrides apply (so header-sent limits bind), from the
+            # submit time (query_max_run_time counts queueing) capped
+            # by the server's per-query wall-clock limit, and adopts
+            # q.cancel_event so DELETE cancels cooperatively
+            result = runner.execute(
+                q.sql, query_id=q.query_id, queued_at=q.started,
+                wall_cap_s=self.query_timeout_s,
+                cancel_event=q.cancel_event)
             m = _SET_SESSION.match(q.sql)
             if m:
                 q.update_type = "SET SESSION"
@@ -257,8 +291,6 @@ class TrinoServer:
             q.cancelled = True         # surfaces as CANCELED, not FAILED
         except Exception as e:  # surface as QueryError, not HTTP 500
             q.error = protocol.error_from_exception(e)
-        finally:
-            session.catalog, session.schema = saved
 
     # ------------------------------------------------------------ paging
 
@@ -266,11 +298,25 @@ class TrinoServer:
         return (f"{self.base_uri}/v1/statement/executing/"
                 f"{q.query_id}/{q.slug}/{token}")
 
+    def _warnings_for(self, q: _Query) -> list:
+        info = q.info
+        if info is None or not info.warnings:
+            return []
+        return [protocol.warning_json(w) for w in info.warnings]
+
     def _response_for(self, q: _Query, token: int) -> dict:
+        info = q.info
+        # live while RUNNING (info.mem is the executing ledger), final
+        # after close (info.pool_peak_bytes)
+        peak = 0
+        if info is not None:
+            peak = max(info.pool_peak_bytes,
+                       info.mem.peak if info.mem is not None else 0)
         if q.error is not None:
             return protocol.query_results(
                 q.query_id, self.base_uri, state="FAILED", error=q.error,
-                elapsed_ms=q.elapsed_ms)
+                elapsed_ms=q.elapsed_ms, peak_memory_bytes=peak,
+                warnings=self._warnings_for(q))
         # a materialized result outranks a cancel flag: the query beat the
         # cancel to the finish line, so its buffered pages stay servable
         # (the reference treats cancel of a terminal query as a no-op)
@@ -286,7 +332,7 @@ class TrinoServer:
             return protocol.query_results(
                 q.query_id, self.base_uri,
                 next_uri=self._page_uri(q, token), state=q.state,
-                elapsed_ms=q.elapsed_ms)
+                elapsed_ms=q.elapsed_ms, peak_memory_bytes=peak)
         res = q.result
         cols = protocol.columns_json(res.column_names, res.column_types)
         lo, hi = token * PAGE_ROWS, (token + 1) * PAGE_ROWS
@@ -298,7 +344,8 @@ class TrinoServer:
             next_uri=self._page_uri(q, token + 1) if has_more else None,
             state="RUNNING" if has_more else "FINISHED",
             update_type=q.update_type, rows=len(res.rows),
-            elapsed_ms=q.elapsed_ms)
+            elapsed_ms=q.elapsed_ms, peak_memory_bytes=peak,
+            warnings=self._warnings_for(q))
 
     # ----------------------------------------------------------- handler
 

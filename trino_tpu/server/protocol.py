@@ -94,8 +94,16 @@ def error_from_exception(exc: BaseException) -> Dict[str, Any]:
                       error_type=code.type)
 
 
+def warning_json(message: str, code: int = 1,
+                 name: str = "MEMORY_LEAK") -> Dict[str, Any]:
+    """TrinoWarning.java shape (warningCode is a nested code+name)."""
+    return {"warningCode": {"code": code, "name": name},
+            "message": message}
+
+
 def stats_json(state: str, *, queued: bool = False, done: bool = False,
-               rows: int = 0, elapsed_ms: int = 0) -> Dict[str, Any]:
+               rows: int = 0, elapsed_ms: int = 0,
+               peak_memory_bytes: int = 0) -> Dict[str, Any]:
     """StatementStats.java — the CLI renders progress from these fields."""
     return {
         "state": state,
@@ -113,7 +121,7 @@ def stats_json(state: str, *, queued: bool = False, done: bool = False,
         "processedRows": rows,
         "processedBytes": 0,
         "physicalInputBytes": 0,
-        "peakMemoryBytes": 0,
+        "peakMemoryBytes": peak_memory_bytes,
         "spilledBytes": 0,
     }
 
@@ -126,14 +134,18 @@ def query_results(query_id: str, base_uri: str, *,
                   error: Optional[Dict[str, Any]] = None,
                   update_type: Optional[str] = None,
                   rows: int = 0,
-                  elapsed_ms: int = 0) -> Dict[str, Any]:
+                  elapsed_ms: int = 0,
+                  peak_memory_bytes: int = 0,
+                  warnings: Optional[List[Dict[str, Any]]] = None
+                  ) -> Dict[str, Any]:
     out: Dict[str, Any] = {
         "id": query_id,
         "infoUri": f"{base_uri}/ui/query.html?{query_id}",
         "stats": stats_json(state, queued=(state == "QUEUED"),
                             done=next_uri is None, rows=rows,
-                            elapsed_ms=elapsed_ms),
-        "warnings": [],
+                            elapsed_ms=elapsed_ms,
+                            peak_memory_bytes=peak_memory_bytes),
+        "warnings": warnings or [],
     }
     if next_uri is not None:
         out["nextUri"] = next_uri
