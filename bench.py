@@ -204,7 +204,9 @@ def run_rung(tag: str) -> None:
         print(json.dumps({"wall_s": round(wall, 2),
                           "retries": runner.stats["retries"],
                           "faults_injected":
-                              runner.stats["faults_injected"]}),
+                              runner.stats["faults_injected"],
+                          "breakdown": _stats_breakdown(
+                              runner.last_query_stats)}),
               flush=True)
     except Exception as e:  # noqa: BLE001 — the rung must report, not die
         print(json.dumps(
@@ -251,19 +253,54 @@ def _run_rung_subprocess(extra: dict, tag: str, base: float) -> None:
                 extra[f"{tag}_retries"] = int(got["retries"])
             if got.get("faults_injected"):
                 extra[f"{tag}_faults_injected"] = int(got["faults_injected"])
+            if got.get("breakdown"):
+                extra[f"{tag}_breakdown"] = got["breakdown"]
     except Exception as e:  # noqa: BLE001
         extra[f"{tag}_error"] = f"rung result parse: {type(e).__name__}: {e}"
 
 
-def _time_query(runner, sql, iters=3):
+def _time_query(runner, sql, iters=3, breakdown=None):
+    t0 = time.perf_counter()
     rows = runner.execute(sql).rows  # warm-up (compile) run, untimed
+    cold = time.perf_counter() - t0
     assert rows, "query returned no rows"
+    cold_stats = dict(runner.last_query_stats)
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
         runner.execute(sql)
         times.append(time.perf_counter() - t0)
-    return sorted(times)[len(times) // 2]  # median
+    warm = sorted(times)[len(times) // 2]  # median
+    if breakdown is not None:
+        breakdown.update(_breakdown(runner, cold, warm, cold_stats))
+    return warm
+
+
+def _stats_breakdown(stats):
+    """The collector-snapshot keys every breakdown object shares."""
+    return {
+        "planning_s": round(stats.get("planning_s", 0.0), 4),
+        "execution_s": round(stats.get("execution_s", 0.0), 4),
+        "jit_misses": int(stats.get("jit_misses", 0)),
+        "output_rows": int(stats.get("output_rows", 0)),
+        "output_bytes": int(stats.get("output_bytes", 0)),
+        "spilled_bytes": int(stats.get("spilled_bytes", 0)),
+    }
+
+
+def _breakdown(runner, cold, warm, cold_stats):
+    """Compile-vs-execute wall split from the query stats collector
+    (obs/stats.py): the cold run pays jit builds + XLA compiles, the warm
+    median is steady state, and the collector's phase walls split the
+    warm run into planning vs device execution."""
+    out = _stats_breakdown(runner.last_query_stats)
+    out.update({
+        "cold_wall_s": round(cold, 4),
+        "warm_wall_s": round(warm, 4),
+        "compile_overhead_s": round(max(cold - warm, 0.0), 4),
+        "cold_jit_misses": int(cold_stats.get("jit_misses", 0)),
+    })
+    return out
 
 
 def main():
@@ -283,15 +320,27 @@ def main():
         from trino_tpu.exec import LocalQueryRunner
 
         sf1 = LocalQueryRunner.tpch("sf1")
-        q6 = _time_query(sf1, Q6)
-        q1 = _time_query(sf1, Q1)
+        bd6, bd1, bd3 = {}, {}, {}
+        q6 = _time_query(sf1, Q6, breakdown=bd6)
+        q1 = _time_query(sf1, Q1, breakdown=bd1)
+        extra["tpch_q6_sf1_breakdown"] = bd6
         extra["tpch_q1_sf1_wall_s"] = round(q1, 4)
         extra["tpch_q1_sf1_vs_baseline"] = round(BASE_Q1_SF1_S / q1, 3)
+        extra["tpch_q1_sf1_breakdown"] = bd1
+
+        # per-operator totals from one instrumented q6 run (node-boundary
+        # instrumentation splits fused chains, so it runs OUTSIDE timing)
+        sf1.session.set("collect_operator_stats", True)
+        sf1.execute(Q6)
+        extra["tpch_q6_sf1_operators"] = \
+            sf1.last_query_stats.get("operators", [])
+        sf1.session.properties.pop("collect_operator_stats", None)
 
         sf10 = LocalQueryRunner.tpch("sf10")
-        q3 = _time_query(sf10, Q3)
+        q3 = _time_query(sf10, Q3, breakdown=bd3)
         extra["tpch_q3_sf10_wall_s"] = round(q3, 4)
         extra["tpch_q3_sf10_vs_baseline"] = round(BASE_Q3_SF10_S / q3, 3)
+        extra["tpch_q3_sf10_breakdown"] = bd3
 
         # BASELINE metric: hash-join probe rows/sec/chip (60M-row lineitem
         # probe into a unique 15M-row orders build)

@@ -1,0 +1,412 @@
+"""Observability: operator stats, EXPLAIN ANALYZE, spans, events, metrics.
+
+Reference parity: core/trino-main execution/QueryStats + EXPLAIN ANALYZE
+rendering (TestExplainAnalyze), the EventListener SPI contract
+(TestEventListenerBasic: created/completed/failed with stats payloads),
+and the metrics surface (jmx-prometheus scrape shape) — exercised through
+the runner, the tracker, and the wire server.
+"""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from trino_tpu.exec import LocalQueryRunner
+from trino_tpu.obs.listeners import (EventListener, register_listener,
+                                     unregister_listener)
+
+from oracle import assert_same, load_tpch_sqlite
+from tpch_sql import QUERIES
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch("tiny")
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    conn = load_tpch_sqlite(SF)
+    yield conn
+    conn.close()
+
+
+# ------------------------------------------------- EXPLAIN ANALYZE sweep
+
+
+def _node_lines(plan_text: str):
+    return [ln for ln in plan_text.splitlines() if ln.lstrip().startswith("- ")]
+
+
+@pytest.mark.parametrize("name", ["q1", "q3", "q5", "q6"])
+def test_explain_analyze_annotates_every_node(runner, name):
+    engine_sql, _, _ = QUERIES[name]
+    text = runner.execute("EXPLAIN ANALYZE " + engine_sql).only_value()
+    nodes = _node_lines(text)
+    assert nodes, text
+    # every plan node line carries a stats annotation with rows, bytes,
+    # and wall time (acceptance: per-operator wall/rows/bytes everywhere)
+    annotations = [ln for ln in text.splitlines()
+                   if "output:" in ln and "rows" in ln]
+    assert len(annotations) == len(nodes), text
+    for ln in annotations:
+        assert re.search(r"output: \d+ rows \(\d+ pages, [\d.]+[kMGT]?B\)",
+                         ln), ln
+        assert re.search(r"time: [\d.]+ms \([\d.]+ms cumulative\)", ln), ln
+    assert "wall" in text and "jit" in text
+
+
+@pytest.mark.parametrize("name", ["q1", "q5"])
+def test_analyzed_run_matches_oracle_with_sane_stats(runner, oracle, name):
+    """Oracle-parity under instrumentation: the same query run with
+    operator-level collection returns identical results, and its stats
+    satisfy the sanity invariants."""
+    engine_sql, oracle_sql, ordered = QUERIES[name]
+    runner.session.set("collect_operator_stats", True)
+    try:
+        got = runner.execute(engine_sql)
+    finally:
+        runner.session.properties.pop("collect_operator_stats", None)
+    expected = oracle.execute(oracle_sql or engine_sql).fetchall()
+    assert_same(got.rows, expected, ordered)
+
+    snap = runner.last_query_stats
+    ops = snap["operators"]
+    assert ops, snap
+    by_rows = {o["name"]: o for o in ops}
+    assert "TableScanNode" in by_rows and "OutputNode" in by_rows
+    for o in ops:
+        assert o["wall_ms"] >= 0.0, o
+        assert o["output_rows"] >= 0 and o["pages"] >= 0, o
+        if o["output_rows"] > 0:
+            assert o["output_bytes"] > 0, o
+        # input rows derive from child outputs: children emit at least
+        # what this operator consumed
+        assert o["input_rows"] >= 0, o
+    assert snap["output_rows"] == len(got.rows)
+    assert snap["output_bytes"] > 0
+    assert snap["execution_s"] >= 0.0 and snap["planning_s"] >= 0.0
+
+
+def test_plain_explain_still_static(runner):
+    text = runner.execute(
+        "EXPLAIN SELECT count(*) FROM nation").only_value()
+    assert "TableScan" in text and "output:" not in text
+
+
+# ----------------------------------------------------- query-level stats
+
+
+def test_query_stats_always_collected(runner):
+    out = runner.execute("SELECT n_name FROM nation ORDER BY n_name")
+    snap = runner.last_query_stats
+    assert snap["output_rows"] == len(out.rows) == 25
+    assert snap["output_bytes"] > 0
+    assert snap["planning_s"] > 0.0 and snap["execution_s"] > 0.0
+    assert snap["jit_hits"] + snap["jit_misses"] > 0
+    # no operator stats unless opted in (fused chains stay fused)
+    assert "operators" not in snap
+
+
+def test_output_bytes_count_live_rows_not_padding(runner):
+    """Pages are capacity-padded; the byte counters must scale to live
+    rows or a 2-row selective result reports the full page capacity."""
+    out = runner.execute(
+        "SELECT l_orderkey, l_extendedprice FROM lineitem "
+        "WHERE l_orderkey = 1")
+    n = len(out.rows)
+    assert 0 < n < 16
+    nbytes = runner.last_query_stats["output_bytes"]
+    # 2 BIGINT-ish columns: within a couple orders of magnitude of
+    # 16B/row, nowhere near the 64Ki-row page capacity
+    assert 0 < nbytes <= n * 16 * 64, nbytes
+
+
+def test_tracker_carries_cpu_rows_bytes(runner):
+    from trino_tpu.exec.query_tracker import TRACKER
+    # unique text: the tracker keeps the last N queries suite-wide, so a
+    # same-text query from another module must not alias this lookup
+    sql = "SELECT count(*) AS obs_probe FROM orders"
+    runner.execute(sql)
+    rows = runner.execute(
+        "SELECT cpu_time_ms, rows, bytes FROM system.runtime.queries "
+        f"WHERE query = '{sql}' AND state = 'FINISHED'").rows
+    assert rows
+    cpu_ms, nrows, nbytes = rows[-1]
+    assert cpu_ms >= 0 and nrows == 1 and nbytes > 0
+    info = next(q for q in TRACKER.list() if q.query == sql)
+    assert info.stats is not None and info.stats["output_rows"] == 1
+
+
+# ------------------------------------------------------------ trace spans
+
+
+def test_trace_span_dump(runner):
+    from trino_tpu.exec.query_tracker import TRACKER
+    sql = "SELECT max(o_totalprice) AS obs_span FROM orders"
+    runner.execute(sql)
+    info = next(q for q in TRACKER.list() if q.query == sql)
+    trace = info.trace
+    assert trace is not None and trace["kind"] == "query"
+    kinds = {c["kind"] for c in trace["children"]}
+    names = {c["name"] for c in trace["children"]}
+    assert {"planning", "execution"} <= names and "phase" in kinds
+    json.dumps(trace)     # structured dump must be JSON-serializable
+
+
+def test_distributed_trace_has_fragment_spans():
+    from trino_tpu.exec.distributed import DistributedQueryRunner
+    from trino_tpu.exec.query_tracker import TRACKER
+    r = DistributedQueryRunner.tpch("tiny")
+    sql = "SELECT count(*) AS obs_dist FROM lineitem"
+    out = r.execute(sql)
+    assert out.rows == [(60050,)]
+    info = next(q for q in TRACKER.list()
+                if q.query == sql and q.state == "FINISHED")
+
+    def walk(span):
+        yield span
+        for c in span.get("children", []):
+            yield from walk(c)
+
+    kinds = {s["kind"] for s in walk(info.trace)}
+    assert "fragment" in kinds and "exchange" in kinds, info.trace
+
+
+# -------------------------------------------------------- event listeners
+
+
+class _Recorder(EventListener):
+    def __init__(self):
+        self.created, self.completed, self.failed = [], [], []
+
+    def query_created(self, event):
+        self.created.append(event)
+
+    def query_completed(self, event):
+        self.completed.append(event)
+
+    def query_failed(self, event):
+        self.failed.append(event)
+
+
+def test_event_listener_lifecycle(runner):
+    rec = register_listener(_Recorder())
+    try:
+        out = runner.execute("SELECT count(*) FROM customer")
+    finally:
+        unregister_listener(rec)
+    assert any(e.query == "SELECT count(*) FROM customer"
+               for e in rec.created)
+    done = [e for e in rec.completed
+            if e.query == "SELECT count(*) FROM customer"]
+    assert len(done) == 1 and done[0].state == "FINISHED"
+    assert done[0].rows == len(out.rows) == 1
+    assert done[0].stats is not None
+    assert done[0].stats["output_bytes"] > 0
+    assert done[0].trace is not None and done[0].wall_ms is not None
+
+
+def test_event_listener_observes_injected_failure(runner):
+    """A fault-injected failure reaches listeners as query_failed with
+    the stats payload attached (acceptance criterion)."""
+    rec = register_listener(_Recorder())
+    runner.session.set("retry_policy", "NONE")
+    runner.session.set("fault_injection_rate", 1.0)
+    runner.session.set("fault_injection_sites", "fragment")
+    try:
+        with pytest.raises(Exception):
+            runner.execute("SELECT sum(l_quantity) FROM lineitem")
+    finally:
+        unregister_listener(rec)
+        for prop in ("retry_policy", "fault_injection_rate",
+                     "fault_injection_sites"):
+            runner.session.properties.pop(prop, None)
+    failed = [e for e in rec.failed
+              if e.query == "SELECT sum(l_quantity) FROM lineitem"]
+    assert failed, rec.failed
+    ev = failed[-1]
+    assert ev.state == "FAILED" and ev.error_name is not None
+    assert ev.stats is not None and ev.stats["faults_injected"] >= 1
+    assert ev.faults_injected >= 1
+
+
+def test_operator_stats_survive_query_retry(runner):
+    """A QUERY-level retry re-plans; operator slots must describe the
+    surviving attempt only (no duplicate nodes from dead plans)."""
+    runner.session.set("collect_operator_stats", True)
+    runner.session.set("retry_policy", "QUERY")
+    runner.session.set("retry_attempts", 3)
+    # seed 4 @ rate 0.5 arms attempt 1 and spares attempt 2 (replayable)
+    runner.session.set("fault_injection_rate", 0.5)
+    runner.session.set("fault_injection_seed", 4)
+    runner.session.set("fault_injection_sites", "fragment")
+    try:
+        out = runner.execute("SELECT count(*) FROM part")
+    finally:
+        for prop in ("collect_operator_stats", "retry_policy",
+                     "retry_attempts", "fault_injection_rate",
+                     "fault_injection_seed", "fault_injection_sites"):
+            runner.session.properties.pop(prop, None)
+    assert out.rows == [(2000,)]
+    snap = runner.last_query_stats
+    assert snap["retries"] >= 1
+    names = [o["name"] for o in snap["operators"]]
+    assert names.count("OutputNode") == 1
+    assert names.count("TableScanNode") == 1
+
+
+def test_created_event_carries_resource_group(runner):
+    rec = register_listener(_Recorder())
+    runner.session.set("resource_group", "obs.created")
+    try:
+        runner.execute("SELECT 1")
+    finally:
+        unregister_listener(rec)
+        runner.session.properties.pop("resource_group", None)
+    ev = [e for e in rec.created if e.query == "SELECT 1"][-1]
+    assert ev.resource_group == "obs.created"
+
+
+def test_session_properties_coerce_header_strings(runner):
+    """Wire-delivered values are strings; a boolean property set to
+    'false' must read False (bool('false') is True), and garbage fails
+    at SET time."""
+    from trino_tpu.errors import InvalidSessionPropertyError
+    s = runner.session
+    try:
+        s.set("spill_enabled", "false")
+        assert s.get("spill_enabled") is False
+        s.set("collect_operator_stats", "TRUE")
+        assert s.get("collect_operator_stats") is True
+        s.set("retry_attempts", "7")
+        assert s.get("retry_attempts") == 7
+        s.set("fault_injection_rate", "0.25")
+        assert s.get("fault_injection_rate") == 0.25
+        with pytest.raises(InvalidSessionPropertyError):
+            s.set("spill_enabled", "maybe")
+        with pytest.raises(InvalidSessionPropertyError):
+            s.set("retry_attempts", "many")
+    finally:
+        for prop in ("spill_enabled", "collect_operator_stats",
+                     "retry_attempts", "fault_injection_rate"):
+            s.properties.pop(prop, None)
+
+
+def test_broken_listener_does_not_fail_queries(runner):
+    class Broken(EventListener):
+        def query_completed(self, event):
+            raise RuntimeError("listener bug")
+
+    broken = register_listener(Broken())
+    try:
+        assert runner.execute("SELECT 1").rows == [(1,)]
+    finally:
+        unregister_listener(broken)
+
+
+# ---------------------------------------------------------------- metrics
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? (-?[0-9.eE+]+|\+Inf|NaN)$")
+
+
+def test_metrics_registry_renders_prometheus_text(runner):
+    from trino_tpu.obs.metrics import REGISTRY
+    runner.execute("SELECT count(*) FROM region")
+    text = REGISTRY.render()
+    families = set()
+    for line in text.strip().splitlines():
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            families.add(line.split()[2])
+            continue
+        assert _PROM_LINE.match(line), line
+    # query, pool, resource-group, and jit-cache series (acceptance)
+    assert "trino_tpu_queries_total" in families
+    assert "trino_tpu_query_wall_seconds" in families
+    assert "trino_tpu_pool_reserved_bytes" in families
+    assert "trino_tpu_jit_cache_kernels" in families
+    assert 'state="FINISHED"' in text
+    assert "trino_tpu_query_wall_seconds_bucket" in text
+
+
+def test_labeled_counter_has_no_phantom_unlabeled_series():
+    """A labeled family must not expose an unlabeled zero sample that
+    vanishes after the first real increment (reads as a counter reset)."""
+    from trino_tpu.obs.metrics import MetricsRegistry
+    reg = MetricsRegistry()
+    c = reg.counter("x_total", "labeled family", labeled=True)
+    assert list(c.samples()) == []
+    text = reg.render()
+    assert "# TYPE x_total counter" in text and "\nx_total " not in text
+    c.inc(state="FINISHED")
+    assert 'x_total{state="FINISHED"} 1' in reg.render()
+    # label-less families still exist from birth
+    u = reg.counter("y_total", "plain family")
+    assert ("y_total", (), 0.0) in list(u.samples())
+
+
+def test_system_runtime_metrics_table(runner):
+    rows = runner.execute(
+        "SELECT name, kind, value FROM system.runtime.metrics").rows
+    names = {r[0] for r in rows}
+    assert "trino_tpu_pool_reserved_bytes" in names
+    assert "trino_tpu_queries_total" in names
+    kinds = {r[1] for r in rows}
+    assert {"counter", "gauge", "histogram"} <= kinds
+    assert all(isinstance(r[2], float) for r in rows)
+
+
+def test_server_metrics_endpoint():
+    from trino_tpu.server import TrinoServer
+    srv = TrinoServer(LocalQueryRunner.tpch("tiny")).start()
+    try:
+        # drive one query through the wire so group/query series exist
+        req = urllib.request.Request(
+            f"{srv.base_uri}/v1/statement",
+            data=b"SELECT count(*) FROM nation", method="POST")
+        req.add_header("X-Trino-User", "test")
+        with urllib.request.urlopen(req) as resp:
+            payload = json.loads(resp.read())
+        while "nextUri" in payload:
+            with urllib.request.urlopen(payload["nextUri"]) as resp:
+                payload = json.loads(resp.read())
+        # collector stats ride the wire (StatementStats fields)
+        assert payload["stats"]["processedBytes"] > 0
+        assert payload["stats"]["cpuTimeMillis"] >= 0
+        with urllib.request.urlopen(f"{srv.base_uri}/v1/metrics") as resp:
+            assert resp.status == 200
+            ctype = resp.headers["Content-Type"]
+            body = resp.read().decode()
+        assert ctype.startswith("text/plain") and "version=0.0.4" in ctype
+        assert "trino_tpu_queries_total" in body
+        assert "trino_tpu_resource_group_queued" in body
+        assert 'group="global"' in body
+        assert "trino_tpu_jit_cache_hits" in body
+        for line in body.strip().splitlines():
+            if not line.startswith("#"):
+                assert _PROM_LINE.match(line), line
+    finally:
+        srv.stop()
+
+
+def test_leak_warning_names_query(runner):
+    """The reservation-leak warning text carries the query id (so a log
+    line is actionable without the surrounding context)."""
+    import trino_tpu.exec.local_planner as lp
+    from trino_tpu.exec.query_tracker import TRACKER
+    orig = lp.LocalExecutionPlanner._free_collected
+    lp.LocalExecutionPlanner._free_collected = lambda self, page: None
+    try:
+        runner.execute("SELECT s_name FROM supplier ORDER BY s_acctbal")
+    finally:
+        lp.LocalExecutionPlanner._free_collected = orig
+    info = next(q for q in TRACKER.list()
+                if "s_acctbal" in (q.query or "") and q.leaked_bytes)
+    assert any(info.query_id in w for w in info.warnings), info.warnings

@@ -153,3 +153,119 @@ def test_soft_memory_limit_blocks_admission(monkeypatch):
     finally:
         ctx.close()
         mgr.finish(g, "q1")
+
+
+def test_json_file_config(tmp_path):
+    """`resource_groups.path`: the JSON tree a deployment ships builds
+    the same groups `configure` does in code, reference field names
+    (camelCase, DataSize strings) included."""
+    cfg = {
+        "groups": [
+            {"name": "global", "hardConcurrencyLimit": 8,
+             "maxQueued": 50, "softMemoryLimit": "512MB",
+             "subgroups": [
+                 {"name": "adhoc", "hard_concurrency": 2,
+                  "scheduling_weight": 1},
+                 {"name": "etl", "hard_concurrency": 4,
+                  "scheduling_weight": 3,
+                  "soft_memory_limit": "1GB"},
+             ]},
+        ],
+    }
+    path = tmp_path / "resource_groups.json"
+    path.write_text(__import__("json").dumps(cfg))
+    mgr = ResourceGroupManager.from_file(str(path))
+    by_name = {g.name: g for g in mgr.groups()}
+    assert by_name["global"].hard_concurrency == 8
+    assert by_name["global"].max_queued == 50
+    assert by_name["global"].soft_memory_limit_bytes == 512 << 20
+    assert by_name["global.adhoc"].hard_concurrency == 2
+    assert by_name["global.etl"].weight == 3
+    assert by_name["global.etl"].soft_memory_limit_bytes == 1 << 30
+    assert by_name["global.etl"].parent is by_name["global"]
+    # a top-level JSON array (no "groups" wrapper) also loads
+    bare = tmp_path / "bare.json"
+    bare.write_text('[{"name": "solo", "maxQueued": 2}]')
+    solo = {g.name: g for g in
+            ResourceGroupManager.from_file(str(bare)).groups()}
+    assert solo["solo"].max_queued == 2
+    # limits from the file actually gate admission
+    mgr2 = ResourceGroupManager.from_file(str(path))
+    mgr2.configure("global.tiny", max_queued=1)
+    assert mgr2.submit("global.tiny", "q1", "q1")
+    assert not mgr2.submit("global.tiny", "q2", "q2")
+
+
+def test_parse_data_size_units_and_percent():
+    from trino_tpu.exec.resource_groups import parse_data_size
+    assert parse_data_size("512MB") == 512 << 20
+    assert parse_data_size("512KB") == 512 << 10      # case-insensitive
+    assert parse_data_size("1.5gb") == int(1.5 * (1 << 30))
+    assert parse_data_size(4096) == 4096
+    assert parse_data_size("8192") == 8192
+    # reference configs use percentages of the pool
+    assert parse_data_size("10%", percent_of=1000) == 100
+    assert parse_data_size("10%", percent_of=None) is None
+
+
+def test_reference_root_groups_shape_loads(tmp_path):
+    """The reference's actual file shape (rootGroups/subGroups) loads,
+    and a typo'd wrapper key is an ERROR, not zero groups."""
+    import json
+
+    import pytest
+    path = tmp_path / "ref.json"
+    path.write_text(json.dumps(
+        {"rootGroups": [{"name": "global", "hardConcurrencyLimit": 5,
+                         "subGroups": [{"name": "bi", "maxQueued": 9}]}]}))
+    by_name = {g.name: g for g in
+               ResourceGroupManager.from_file(str(path)).groups()}
+    assert by_name["global"].hard_concurrency == 5
+    assert by_name["global.bi"].max_queued == 9
+    bad = tmp_path / "typo.json"
+    bad.write_text(json.dumps({"grops": []}))
+    with pytest.raises(ValueError, match="rootGroups"):
+        ResourceGroupManager.from_file(str(bad))
+    # typo'd per-group limits error too (a misspelled cap must not
+    # silently leave the group at permissive defaults) ...
+    badkey = tmp_path / "badkey.json"
+    badkey.write_text(json.dumps(
+        {"groups": [{"name": "g", "maxQueue": 5}]}))
+    with pytest.raises(ValueError, match="resource group 'g'.*maxQueue"):
+        ResourceGroupManager.from_file(str(badkey))
+    # ... while reference keys for unimplemented features are tolerated
+    tol = tmp_path / "tolerated.json"
+    tol.write_text(json.dumps(
+        {"rootGroups": [{"name": "g", "schedulingPolicy": "weighted",
+                         "jmxExport": True, "maxQueued": 7}]}))
+    got = {g.name: g for g in
+           ResourceGroupManager.from_file(str(tol)).groups()}
+    assert got["g"].max_queued == 7
+
+
+def test_bad_group_config_names_offender(tmp_path):
+    import json
+
+    import pytest
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps(
+        {"groups": [{"name": "g", "softMemoryLimit": "lots"}]}))
+    with pytest.raises(ValueError, match="resource group 'g'.*softMemory"):
+        ResourceGroupManager.from_file(str(path))
+
+
+def test_server_resource_groups_path(tmp_path):
+    from trino_tpu.exec import LocalQueryRunner
+    from trino_tpu.server import TrinoServer
+    path = tmp_path / "groups.json"
+    path.write_text(__import__("json").dumps(
+        {"groups": [{"name": "interactive", "hardConcurrencyLimit": 1,
+                     "maxQueued": 3}]}))
+    srv = TrinoServer(LocalQueryRunner.tpch("tiny"),
+                      resource_groups_path=str(path)).start()
+    try:
+        by_name = {g.name: g for g in srv.groups.groups()}
+        assert by_name["interactive"].hard_concurrency == 1
+        assert by_name["interactive"].max_queued == 3
+    finally:
+        srv.stop()

@@ -410,3 +410,19 @@ def test_queue_full_admission(server):
     # the default group still admits
     _, _, rows, _, _ = run_query(server, "SELECT 7")
     assert rows == [[7]]
+
+
+def test_bad_session_value_fails_unknown_name_tolerated(server):
+    # unknown property names from newer clients are ignored
+    payload, _, rows, _, _ = run_query(
+        server, "SELECT 1", {"X-Trino-Session": "not_a_real_prop=1"})
+    assert rows == [[1]] and "error" not in payload
+    # a KNOWN property with a malformed value fails the query visibly
+    payload, _, _, _, _ = run_query(
+        server, "SELECT 1", {"X-Trino-Session": "retry_attempts=abc"})
+    assert payload["error"]["errorName"] == "INVALID_SESSION_PROPERTY"
+    # ... and terminates its tracker entry (no phantom QUEUED row)
+    from trino_tpu.exec.query_tracker import TRACKER
+    info = next(q for q in TRACKER.list() if q.query_id == payload["id"])
+    assert info.state == "FAILED"
+    assert info.error_name == "INVALID_SESSION_PROPERTY"

@@ -25,7 +25,8 @@ TABLES: Dict[str, tuple] = {
     "queries": (
         ("query_id", T.VarcharType()), ("state", T.VarcharType()),
         ("user", T.VarcharType()), ("query", T.VarcharType()),
-        ("rows", T.BIGINT), ("wall_ms", T.BIGINT),
+        ("rows", T.BIGINT), ("bytes", T.BIGINT),
+        ("wall_ms", T.BIGINT), ("cpu_time_ms", T.BIGINT),
         ("error", T.VarcharType()), ("error_name", T.VarcharType()),
         ("retries", T.BIGINT), ("faults_injected", T.BIGINT),
         ("resource_group", T.VarcharType()),
@@ -49,6 +50,11 @@ TABLES: Dict[str, tuple] = {
         ("soft_memory_limit_bytes", T.BIGINT),
         ("scheduling_weight", T.BIGINT),
         ("memory_usage_bytes", T.BIGINT)),
+    # the process metrics registry (obs/metrics.py) as a table: the same
+    # samples GET /v1/metrics exposes, SQL-queryable
+    "metrics": (
+        ("name", T.VarcharType()), ("kind", T.VarcharType()),
+        ("labels", T.VarcharType()), ("value", T.DOUBLE)),
 }
 
 
@@ -56,7 +62,9 @@ def _rows_for(table: str) -> List[tuple]:
     from trino_tpu.exec.query_tracker import TRACKER
     if table == "queries":
         return [(q.query_id, q.state, q.user, q.query, q.rows,
-                 q.wall_ms if q.wall_ms is not None else 0, q.error,
+                 q.output_bytes,
+                 q.wall_ms if q.wall_ms is not None else 0,
+                 q.cpu_time_ms, q.error,
                  q.error_name, q.retries, q.faults_injected,
                  q.resource_group, q.pool_reserved_bytes,
                  max(q.pool_peak_bytes,
@@ -95,6 +103,9 @@ def _rows_for(table: str) -> List[tuple]:
                  g.soft_memory_limit_bytes is not None else 0,
                  g.weight, g.memory_usage())
                 for g in list_all_groups()]
+    if table == "metrics":
+        from trino_tpu.obs.metrics import REGISTRY
+        return REGISTRY.samples()
     raise KeyError(table)
 
 

@@ -246,14 +246,24 @@ class DistributedQueryRunner(LocalQueryRunner):
 
     def _execute_query(self, query: t.Query) -> MaterializedResult:
         plan = self._plan_distributed(query)
-        frag = fragment_plan(plan)
-        # children schedule (and retry) independently BEFORE the root's
-        # retry scope opens: a root attempt failure re-runs only the root
-        # fragment against the already-materialized exchange inputs
-        exchange_inputs = self._schedule_children(frag)
-        return self._retry_task(
-            "fragment-root",
-            lambda: self._root_attempt(frag, plan, exchange_inputs))
+        with self._phase("execution"):
+            frag = fragment_plan(plan)
+            # children schedule (and retry) independently BEFORE the
+            # root's retry scope opens: a root attempt failure re-runs
+            # only the root fragment against the already-materialized
+            # exchange inputs
+            exchange_inputs = self._schedule_children(frag)
+            with self._frag_span(frag, "fragment-root"):
+                return self._retry_task(
+                    "fragment-root",
+                    lambda: self._root_attempt(frag, plan, exchange_inputs))
+
+    def _frag_span(self, frag: PlanFragment, name: str):
+        """A fragment trace span covering the fragment's retry scope
+        (query -> fragment in the span tree); no-op without a collector."""
+        from trino_tpu.obs.stats import maybe_span
+        return maybe_span(self._collector, name, kind="fragment",
+                          partitioning=frag.partitioning)
 
     def _root_attempt(self, frag: PlanFragment, plan: OutputNode,
                       exchange_inputs) -> MaterializedResult:
@@ -262,16 +272,20 @@ class DistributedQueryRunner(LocalQueryRunner):
             self.metadata, self.session, 0, self.mesh.n, exchange_inputs)
         executor.faults = self._faults
         executor.deadline = self._deadline
+        executor.collector = self._collector
         if self._memory is not None:
             executor.memory = self._memory   # query-level shared ledger
         root_stream = executor.execute(frag.root)
         types = [s.type for s in plan.symbols]
         rows = []
+        nbytes = 0
+        from trino_tpu.exec.memory import live_page_bytes
         for page in root_stream.iter_pages():
             self._check_deadline()      # page-batch cancellation point
             n = int(page.num_rows)
             if n == 0:
                 continue
+            nbytes += live_page_bytes(page, n)
             cols = page.to_host(n)
             from trino_tpu.exec.runner import _to_python
             for i in range(n):
@@ -279,12 +293,16 @@ class DistributedQueryRunner(LocalQueryRunner):
                                   for j in range(len(cols))))
         if self._faults is not None:
             self._faults.site("fragment", "root")
+        if self._collector is not None:
+            self._collector.add_output(len(rows), nbytes)
         return MaterializedResult(list(plan.column_names), types, rows)
 
     def _plan_distributed(self, query: t.Statement) -> OutputNode:
         from trino_tpu.planner import LogicalPlanner
-        plan = LogicalPlanner(self.metadata, self.session).plan(query)
-        return optimize(plan, self.metadata, self.session, distributed=True)
+        with self._phase("planning"):
+            plan = LogicalPlanner(self.metadata, self.session).plan(query)
+            return optimize(plan, self.metadata, self.session,
+                            distributed=True)
 
     # --------------------------------------------------------- scheduling
 
@@ -301,10 +319,19 @@ class DistributedQueryRunner(LocalQueryRunner):
             # collective failure (or injected fault) re-applies the
             # idempotent collective against the child's buffered output —
             # the task-output-buffer re-fetch of the reference's retry
-            exchange_inputs[child.fragment_id] = self._retry_task(
-                f"exchange-{child.fragment_id}",
-                lambda p=child_pages, r=remote: self._apply_exchange(p, r))
+            with self._exchange_span(child, remote):
+                exchange_inputs[child.fragment_id] = self._retry_task(
+                    f"exchange-{child.fragment_id}",
+                    lambda p=child_pages, r=remote:
+                        self._apply_exchange(p, r))
         return exchange_inputs
+
+    def _exchange_span(self, child: PlanFragment, remote):
+        from trino_tpu.obs.stats import maybe_span
+        return maybe_span(
+            self._collector, f"exchange-{child.fragment_id}",
+            kind="exchange",
+            exchange_kind=str(remote.kind).rsplit(".", 1)[-1])
 
     def _run_fragment_to_pages(self, frag: PlanFragment
                                ) -> List[Optional[Page]]:
@@ -314,9 +341,10 @@ class DistributedQueryRunner(LocalQueryRunner):
         unit): retryable failures re-run THIS fragment only — its children
         have already completed their own scopes."""
         exchange_inputs = self._schedule_children(frag)
-        return self._retry_task(
-            f"fragment-{frag.fragment_id}",
-            lambda: self._fragment_attempt(frag, exchange_inputs))
+        with self._frag_span(frag, f"fragment-{frag.fragment_id}"):
+            return self._retry_task(
+                f"fragment-{frag.fragment_id}",
+                lambda: self._fragment_attempt(frag, exchange_inputs))
 
     def _fragment_attempt(self, frag: PlanFragment, exchange_inputs
                           ) -> List[Optional[Page]]:
@@ -339,6 +367,7 @@ class DistributedQueryRunner(LocalQueryRunner):
                 exchange_inputs, device=self.mesh.device_of(shard))
             executor.faults = self._faults
             executor.deadline = self._deadline
+            executor.collector = self._collector
             if self._memory is not None:
                 executor.memory = self._memory  # shards share the ledger
             dispatched.append(

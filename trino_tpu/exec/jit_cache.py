@@ -31,6 +31,20 @@ _LOCK = threading.RLock()   # reentrant: a build() may consult the cache
 # on-disk persistent compilation cache (no re-trace cost beyond reload).
 _MAX_KERNELS = 512
 
+# process-lifetime hit/miss counters (exported by obs/metrics.py), plus a
+# per-thread observer slot: the runner installs its query's
+# QueryStatsCollector for the duration of execute(), so hits/misses
+# attribute to the query whose executor thread triggered them (server
+# concurrency runs each query on its own thread)
+_STATS = {"hits": 0, "misses": 0}
+_TLS = threading.local()
+
+
+def set_observer(observer) -> None:
+    """Install/clear (None) this thread's per-query jit observer — an
+    object with jit_hit(key)/jit_miss(key)."""
+    _TLS.observer = observer
+
 
 def cached_kernel(key: Hashable, build: Callable[[], Callable]) -> Callable:
     """Return the jitted kernel for `key`, building+jitting it on first use.
@@ -45,13 +59,27 @@ def cached_kernel(key: Hashable, build: Callable[[], Callable]) -> Callable:
             while len(_CACHE) >= _MAX_KERNELS:
                 _CACHE.popitem(last=False)
             _CACHE[key] = fn
+            _STATS["misses"] += 1
+            miss = True
         else:
             _CACHE.move_to_end(key)
-        return fn
+            _STATS["hits"] += 1
+            miss = False
+    observer = getattr(_TLS, "observer", None)
+    if observer is not None:
+        (observer.jit_miss if miss else observer.jit_hit)(key)
+    return fn
 
 
 def cache_info() -> int:
     return len(_CACHE)
+
+
+def stats() -> dict:
+    """Snapshot for metrics: resident kernels + lifetime hits/misses."""
+    with _LOCK:
+        return {"size": len(_CACHE), "hits": _STATS["hits"],
+                "misses": _STATS["misses"]}
 
 
 def clear():  # for tests

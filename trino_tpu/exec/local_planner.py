@@ -139,18 +139,6 @@ def compose_chain(pending, tail_key=None, tail_builder=None):
     return cached_kernel(key, build)
 
 
-@dataclasses.dataclass
-class NodeStats:
-    """Per-plan-node runtime counters (operator/OperatorStats.java analog:
-    output rows/pages + inclusive wall time; exclusive time is derived at
-    render by subtracting child time)."""
-
-    name: str
-    rows: int = 0
-    pages: int = 0
-    wall_s: float = 0.0
-
-
 class LocalExecutionPlanner:
     """Single-process executor over one device (LocalQueryRunner's engine)."""
 
@@ -158,8 +146,11 @@ class LocalExecutionPlanner:
         self.metadata = metadata
         self.session = session
         self.page_capacity = int(session.get("page_capacity"))
-        # id(plan node) -> NodeStats, populated only under EXPLAIN ANALYZE
-        self.node_stats: Optional[Dict[int, NodeStats]] = None
+        # the query's QueryStatsCollector (obs/stats.py), installed by the
+        # owning runner; operator-level instrumentation wraps node
+        # boundaries only when collector.operator_level is on (it forces
+        # fused chains apart — see obs/stats.py module docstring)
+        self.collector = None
         from trino_tpu.exec.memory import QueryMemoryContext
         self.memory = QueryMemoryContext(
             int(session.get("query_max_memory")))
@@ -179,6 +170,12 @@ class LocalExecutionPlanner:
         if self.faults is not None:
             self.faults.site(site, detail)
 
+    def _record_spill(self, nbytes: int) -> None:
+        """Spill-byte accounting at the host-partition flush sites
+        (QueryStats.spilledDataSize analog)."""
+        if self.collector is not None:
+            self.collector.add_spill(nbytes)
+
     # ------------------------------------------------------------ dispatch
 
     def execute(self, node: PlanNode) -> PageStream:
@@ -187,19 +184,24 @@ class LocalExecutionPlanner:
         if method is None:
             raise ExecutionError(f"no executor for {name}")
         stream = method(node)
-        if self.node_stats is None:
+        if self.collector is None or not self.collector.operator_level:
             return stream
         return self._instrument(node, stream)
 
     def _instrument(self, node: PlanNode, stream: PageStream) -> PageStream:
-        """EXPLAIN ANALYZE wrapper: count rows/pages and inclusive wall time
-        at every node boundary. Forces the pending chain at each node (the
-        per-operator observability the reference pays for with
-        OperationTimer), so fused-chain timings split into their operators;
-        the row-count read syncs the device once per page."""
+        """Operator-level stats wrapper (EXPLAIN ANALYZE /
+        collect_operator_stats): count rows/pages/bytes and inclusive wall
+        time at every node boundary. Forces the pending chain at each node
+        (the per-operator observability the reference pays for with
+        OperationTimer), so fused-chain timings split into their
+        operators; the row-count read syncs the device once per page, and
+        when the collector fences, `block_until_ready` pins asynchronously
+        dispatched device time on the operator that launched it."""
         import time as _time
-        st = NodeStats(type(node).__name__)
-        self.node_stats[id(node)] = st
+
+        from trino_tpu.exec.memory import live_page_bytes
+        st = self.collector.register(node)
+        fence = self.collector.fence
 
         def gen():
             it = stream.iter_pages()
@@ -210,9 +212,13 @@ class LocalExecutionPlanner:
                 except StopIteration:
                     st.wall_s += _time.perf_counter() - t0
                     return
-                st.rows += int(page.num_rows)
+                if fence:
+                    jax.block_until_ready(page)
+                n = int(page.num_rows)
+                st.output_rows += n
                 st.wall_s += _time.perf_counter() - t0
                 st.pages += 1
+                st.output_bytes += live_page_bytes(page, n)
                 yield page
         return PageStream(gen(), stream.symbols)
 
@@ -612,6 +618,7 @@ class LocalExecutionPlanner:
             def spill(combined):
                 nonlocal store, part_op
                 self._fault_site("spill", "agg")
+                self._record_spill(page_bytes(combined))
                 if store is None:
                     store = HostPartitionStore(npart)
                     part_op = cached_kernel(
@@ -737,6 +744,7 @@ class LocalExecutionPlanner:
                 buf, buf_bytes = [], 0
                 if merged is None:
                     return
+                self._record_spill(page_bytes(merged))
                 if bounds is None:
                     store = HostPartitionStore(npart)
                     nf = k0.resolved_nulls_first()
@@ -1047,6 +1055,9 @@ class LocalExecutionPlanner:
             vals = np.asarray(next(it))
             valid = None if c.valid is None else np.asarray(next(it))
             host_cols.append((vals, valid, c.type, c.dictionary))
+        self._record_spill(sum(
+            v.nbytes + (m.nbytes if m is not None else 0)
+            for v, m, _, _ in host_cols))
         self._free_collected(build_page)
         # dense spilled builds (surrogate keys, the common >threshold
         # case): ONE int32 row table on device — ~4B/slot instead of

@@ -86,6 +86,15 @@ def page_bytes(page) -> int:
     return sum(col.nbytes for col in page.columns)
 
 
+def live_page_bytes(page, rows: int) -> int:
+    """Data bytes of the LIVE rows of a Page: pages are capacity-padded
+    (Page.filter keeps its input capacity), so raw Column.nbytes measures
+    padding too — stats counters must scale to the live row count or a
+    2-row selective result reports megabytes."""
+    cap = max(int(page.capacity), 1)
+    return page_bytes(page) * int(rows) // cap
+
+
 class NodeMemoryPool:
     """Process-wide reservation pool all queries share (MemoryPool.java +
     ClusterMemoryManager collapsed to the single-node case).

@@ -59,6 +59,15 @@ class QueryInfo:
     pool_peak_bytes: int = 0
     memory_kills: int = 0        # times the low-memory killer chose us
     leaked_bytes: int = 0        # nonzero ledger at successful end
+    # observability rollup (obs/stats.py): cumulative device-inclusive
+    # execution time, output bytes, and the full snapshot + span dump the
+    # runner stamps before the terminal transition
+    cpu_time_ms: int = 0
+    output_bytes: int = 0
+    stats: Optional[dict] = dataclasses.field(
+        default=None, repr=False, compare=False)
+    trace: Optional[dict] = dataclasses.field(
+        default=None, repr=False, compare=False)
     warnings: List[str] = dataclasses.field(default_factory=list)
     # the live memory context while executing (None before/after): lets
     # system.runtime.queries read the current pool reservation
@@ -97,7 +106,8 @@ class QueryTracker:
         self._keep = keep
 
     def begin(self, sql: str, user: str = "user",
-              query_id: Optional[str] = None) -> QueryInfo:
+              query_id: Optional[str] = None,
+              resource_group: Optional[str] = None) -> QueryInfo:
         with self._lock:
             if query_id is not None and query_id in self._queries:
                 # the HTTP server pre-registers at submit (QUEUED); the
@@ -105,7 +115,8 @@ class QueryTracker:
                 # double-counting the query
                 return self._queries[query_id]
             qid = query_id or f"{time.strftime('%Y%m%d')}_{next(self._seq):06d}"
-            info = QueryInfo(qid, QUEUED, user, sql, time.monotonic())
+            info = QueryInfo(qid, QUEUED, user, sql, time.monotonic(),
+                             resource_group=resource_group)
             self._queries[qid] = info
             # bound the registry (QueryTracker prunes expired queries)
             while len(self._queries) > self._keep:
@@ -114,7 +125,11 @@ class QueryTracker:
                 if done is None:
                     break
                 del self._queries[done]
-            return info
+        # fire OUTSIDE the registry lock (QueryMonitor.queryCreatedEvent:
+        # listeners may themselves consult the tracker)
+        from trino_tpu.obs.listeners import fire_query_created
+        fire_query_created(info)
+        return info
 
     def running(self, info: QueryInfo) -> None:
         with info.lock:
@@ -128,6 +143,8 @@ class QueryTracker:
             info.rows = rows
             info.ended = time.monotonic()
             info.state = FINISHED
+        from trino_tpu.obs.listeners import fire_query_completed
+        fire_query_completed(info)
 
     def fail(self, info: QueryInfo, error: str,
              error_name: Optional[str] = None) -> None:
@@ -137,6 +154,8 @@ class QueryTracker:
             info.error_name = error_name
             info.ended = time.monotonic()
             info.state = FAILED
+        from trino_tpu.obs.listeners import fire_query_failed
+        fire_query_failed(info)
 
     def cancel(self, info: QueryInfo,
                reason: str = "Query was canceled by user") -> None:
@@ -148,6 +167,8 @@ class QueryTracker:
             info.error_name = "USER_CANCELED"
             info.ended = time.monotonic()
             info.state = CANCELED
+        from trino_tpu.obs.listeners import fire_query_failed
+        fire_query_failed(info)
 
     def list(self) -> List[QueryInfo]:
         with self._lock:

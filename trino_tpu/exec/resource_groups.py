@@ -144,6 +144,95 @@ class ResourceGroupManager:
             self._cond.notify_all()
             return g
 
+    # -------------------------------------------------- file-based config
+
+    def configure_from_dict(self, tree) -> None:
+        """Build the group tree a FileResourceGroupConfigurationManager
+        JSON describes: `{"groups"|"rootGroups": [{"name",
+        "hard_concurrency"|"hardConcurrencyLimit",
+        "max_queued"|"maxQueued",
+        "scheduling_weight"|"schedulingWeight", "soft_memory_limit"|
+        "softMemoryLimit" (bytes, a '512MB'-style size, or '10%' of the
+        node pool), "subgroups"|"subGroups": [...]}, ...]}` — the same
+        tree `configure` builds in code, with the reference's camelCase
+        field names accepted so its documented examples load unmodified.
+        A top-level bare list also works; anything else is an error (a
+        typo'd wrapper key must not silently configure ZERO groups on a
+        server the operator believes is limited)."""
+        if isinstance(tree, list):
+            groups = tree
+        else:
+            groups = tree.get("groups", tree.get("rootGroups"))
+            if groups is None:
+                raise ValueError(
+                    "resource group config needs a top-level 'groups' or "
+                    f"'rootGroups' list (got keys: {sorted(tree)})")
+        for spec in groups:
+            self._configure_group_spec(spec, prefix="")
+
+    def _configure_group_spec(self, spec: dict, prefix: str) -> None:
+        name = str(spec.get("name", "")).strip()
+        if not name:
+            raise ValueError("resource group spec without a name")
+        full = f"{prefix}.{name}" if prefix else name
+        known = {"name", "subgroups", "subGroups",
+                 "hard_concurrency", "hardConcurrencyLimit",
+                 "max_queued", "maxQueued",
+                 "weight", "scheduling_weight", "schedulingWeight",
+                 "soft_memory_limit", "softMemoryLimit",
+                 "soft_memory_limit_bytes",
+                 # reference keys with no engine counterpart yet —
+                 # tolerated (valid config, unimplemented feature), NOT
+                 # typos: scheduling here is always weighted-fair and
+                 # metrics export is always on
+                 "schedulingPolicy", "scheduling_policy", "jmxExport"}
+        unknown = sorted(set(spec) - known)
+        if unknown:
+            # same strictness as the wrapper key: a typo'd limit must not
+            # silently leave the group at permissive defaults
+            raise ValueError(
+                f"resource group {full!r}: unknown config keys {unknown}")
+        config = {}
+        for key, aliases in (
+                ("hard_concurrency", ("hardConcurrencyLimit",)),
+                ("max_queued", ("maxQueued",)),
+                ("weight", ("scheduling_weight", "schedulingWeight"))):
+            for k in (key,) + aliases:
+                if k in spec:
+                    try:
+                        config[key] = int(spec[k])
+                    except (TypeError, ValueError) as e:
+                        raise ValueError(
+                            f"resource group {full!r}: bad {k} value "
+                            f"{spec[k]!r}: {e}") from e
+                    break
+        for k in ("soft_memory_limit", "softMemoryLimit",
+                  "soft_memory_limit_bytes"):
+            if k in spec:
+                from trino_tpu.exec.memory import NODE_POOL
+                try:
+                    config["soft_memory_limit_bytes"] = parse_data_size(
+                        spec[k], percent_of=NODE_POOL.limit)
+                except (TypeError, ValueError) as e:
+                    raise ValueError(
+                        f"resource group {full!r}: bad {k} value "
+                        f"{spec[k]!r}: {e}") from e
+                break
+        self.configure(full, **config)
+        for sub in spec.get("subgroups", spec.get("subGroups", [])):
+            self._configure_group_spec(sub, prefix=full)
+
+    @classmethod
+    def from_file(cls, path: str, **manager_kwargs) -> "ResourceGroupManager":
+        """Manager preconfigured from a JSON file (the server's
+        `resource_groups.path` option)."""
+        import json
+        with open(path) as f:
+            tree = json.load(f)
+        mgr = cls(**manager_kwargs)
+        mgr.configure_from_dict(tree)
+        return mgr
+
     @staticmethod
     def _configure_locked(g: ResourceGroup, **config) -> None:
         for key in ("hard_concurrency", "max_queued", "weight"):
@@ -242,6 +331,32 @@ class ResourceGroupManager:
             if best is None or key < best_key:
                 best, best_key = g, key
         return best
+
+
+def parse_data_size(value, percent_of: Optional[int] = None
+                    ) -> Optional[int]:
+    """'512MB' / '1.5GB' / '10%' / bare bytes -> int bytes (io.airlift
+    DataSize grammar plus the percentage form the reference's
+    softMemoryLimit examples use; units match case-insensitively). A
+    percentage resolves against `percent_of` (the node pool limit); with
+    no bound to take a percentage of, it means "no limit" (None)."""
+    if value is None:
+        return None
+    if isinstance(value, (int, float)):
+        return int(value)
+    text = str(value).strip()
+    if text.endswith("%"):
+        fraction = float(text[:-1].strip()) / 100.0
+        if percent_of is None:
+            return None
+        return int(percent_of * fraction)
+    units = {"b": 1, "kb": 1 << 10, "mb": 1 << 20, "gb": 1 << 30,
+             "tb": 1 << 40, "pb": 1 << 50}
+    lowered = text.lower()
+    for unit in sorted(units, key=len, reverse=True):
+        if lowered.endswith(unit):
+            return int(float(text[:-len(unit)].strip()) * units[unit])
+    return int(float(text))
 
 
 def list_all_groups() -> List[ResourceGroup]:

@@ -86,8 +86,12 @@ class LocalQueryRunner:
         self._faults = None
         self._memory = None
         self._retries = 0
+        # the per-query QueryStatsCollector (obs/stats.py): phases,
+        # output rows/bytes, jit hit/miss, spill bytes, operator stats
+        self._collector = None
         # cumulative counters across the runner's lifetime (bench.py
         # emits these alongside timings) + the last query's snapshot
+        # (the collector's full snapshot dict after each execute)
         self.stats = {"retries": 0, "faults_injected": 0}
         self.last_query_stats = {"retries": 0, "faults_injected": 0}
 
@@ -107,6 +111,7 @@ class LocalQueryRunner:
         clone._faults = None
         clone._memory = None
         clone._retries = 0
+        clone._collector = None
         clone.stats = {"retries": 0, "faults_injected": 0}
         clone.last_query_stats = {"retries": 0, "faults_injected": 0}
         return clone
@@ -146,15 +151,31 @@ class LocalQueryRunner:
         from trino_tpu.exec.faults import FaultInjector
         from trino_tpu.exec.memory import (NODE_POOL, QueryMemoryContext,
                                            degrade_to_spill)
+        from trino_tpu.exec import jit_cache
         from trino_tpu.exec.query_tracker import TRACKER
-        info = TRACKER.begin(sql, user=self.session.user, query_id=query_id)
+        from trino_tpu.obs.stats import QueryStatsCollector
+        try:
+            group = str(self.session.get("resource_group"))
+        except Exception:
+            group = None
+        info = TRACKER.begin(sql, user=self.session.user,
+                             query_id=query_id, resource_group=group)
         self._retries = 0
+        # the query's stats pipeline: always-on query-level collection;
+        # operator-level instrumentation is opt-in (session property) or
+        # forced by EXPLAIN ANALYZE. The jit-cache observer is
+        # thread-local, so concurrent queries attribute their own
+        # hits/misses (each runs on its own executor thread)
+        self._collector = QueryStatsCollector(info.query_id)
+        jit_cache.set_observer(self._collector)
         TRACKER.running(info)
         try:
             # fault-tolerance setup INSIDE the try: a malformed session
             # property value must fail the tracker entry (terminal state,
             # prunable), not leave a phantom RUNNING row
             try:
+                self._collector.operator_level = bool(
+                    self.session.get("collect_operator_stats"))
                 self._deadline = QueryDeadline.from_session(
                     self.session, queued_at=queued_at,
                     wall_cap_s=wall_cap_s, cancel_event=cancel_event)
@@ -203,6 +224,11 @@ class LocalQueryRunner:
                         raise
                     self._retries += 1
                     self._memory.reset_attempt()
+                    # a QUERY-level re-run RE-PLANS: the failed attempt's
+                    # node objects die, so id()-keyed operator slots would
+                    # duplicate (or, after id reuse, misattribute) — the
+                    # rendered stats are the surviving attempt's
+                    self._collector.operators.clear()
                     self._backoff(attempt)
         except BaseException as e:
             # BaseException too: a KeyboardInterrupt/SystemExit escaping
@@ -218,6 +244,7 @@ class LocalQueryRunner:
             raise
         finally:
             self._deadline = None
+            jit_cache.set_observer(None)
         self._finish_query_stats(info)
         self._close_memory(info, failed=False)
         TRACKER.finish(info, len(result.rows))
@@ -239,8 +266,8 @@ class LocalQueryRunner:
         if leaked and not failed:
             info.leaked_bytes = leaked
             info.warnings.append(
-                f"reservation leak: query ended with {_fmt_bytes(leaked)} "
-                f"still reserved (tags: "
+                f"reservation leak: query {info.query_id} ended with "
+                f"{_fmt_bytes(leaked)} still reserved (tags: "
                 f"{ {k: v for k, v in ctx.by_tag.items() if v} })")
             NODE_POOL.record_leak(leaked)
         self._memory = None
@@ -257,8 +284,22 @@ class LocalQueryRunner:
         faults = self._faults.injected if self._faults else 0
         info.retries = self._retries
         info.faults_injected = faults
-        self.last_query_stats = {"retries": self._retries,
-                                 "faults_injected": faults}
+        col = self._collector
+        if col is not None:
+            # stamp the rollup BEFORE the terminal tracker transition:
+            # event listeners receive info.stats/info.trace with the
+            # completed/failed event (QueryMonitor orders the same way)
+            col.retries = self._retries
+            col.faults_injected = faults
+            col.finish()
+            info.cpu_time_ms = int(col.execution_s * 1000)
+            info.output_bytes = col.output_bytes
+            info.stats = col.snapshot()
+            info.trace = col.trace_json()
+            self.last_query_stats = info.stats
+        else:
+            self.last_query_stats = {"retries": self._retries,
+                                     "faults_injected": faults}
         self.stats["retries"] += self._retries
         self.stats["faults_injected"] += faults
         if self._faults is not None:
@@ -399,9 +440,15 @@ class LocalQueryRunner:
         raise SemanticError(
             f"unsupported statement: {type(stmt).__name__}")
 
+    def _phase(self, name: str):
+        """The collector's phase scope, or a no-op outside execute()."""
+        from trino_tpu.obs.stats import maybe_phase
+        return maybe_phase(self._collector, name)
+
     def _plan(self, query: t.Statement) -> OutputNode:
-        plan = LogicalPlanner(self.metadata, self.session).plan(query)
-        return optimize(plan, self.metadata, self.session)
+        with self._phase("planning"):
+            plan = LogicalPlanner(self.metadata, self.session).plan(query)
+            return optimize(plan, self.metadata, self.session)
 
     def _execute_query(self, query: t.Query) -> MaterializedResult:
         plan = self._plan(query)
@@ -414,11 +461,12 @@ class LocalQueryRunner:
         # Write plans are exempt: re-running a TableWriterNode would
         # double-write (the reference's FTE requires connector support
         # for write retry — this engine's memory connector has none)
-        if _contains_writer(plan):
-            self._check_deadline()
-            return self._run_plan_attempt(plan, chaos=False)
-        return self._retry_task("local-plan",
-                                lambda: self._run_plan_attempt(plan))
+        with self._phase("execution"):
+            if _contains_writer(plan):
+                self._check_deadline()
+                return self._run_plan_attempt(plan, chaos=False)
+            return self._retry_task("local-plan",
+                                    lambda: self._run_plan_attempt(plan))
 
     def _run_plan_attempt(self, plan: OutputNode,
                           chaos: bool = True) -> MaterializedResult:
@@ -426,16 +474,20 @@ class LocalQueryRunner:
         executor = LocalExecutionPlanner(self.metadata, self.session)
         executor.faults = self._faults if chaos else None
         executor.deadline = self._deadline
+        executor.collector = self._collector
         if self._memory is not None:
             executor.memory = self._memory   # query-level shared ledger
         stream = executor.execute(plan)
         types = [s.type for s in plan.symbols]
         rows: List[Tuple[Any, ...]] = []
+        nbytes = 0
+        from trino_tpu.exec.memory import live_page_bytes
         for page in stream.iter_pages():
             self._check_deadline()      # page-batch cancellation point
             n = int(page.num_rows)
             if n == 0:
                 continue
+            nbytes += live_page_bytes(page, n)
             cols = page.to_host(n)
             for i in range(n):
                 rows.append(tuple(
@@ -443,6 +495,8 @@ class LocalQueryRunner:
                     for j in range(len(cols))))
         if chaos and self._faults is not None:
             self._faults.site("fragment", "local-plan")
+        if self._collector is not None:
+            self._collector.add_output(len(rows), nbytes)
         return MaterializedResult(list(plan.column_names), types, rows)
 
     # --------------------------------------------------------------- DDL
@@ -530,33 +584,35 @@ class LocalQueryRunner:
         return MaterializedResult(["Query Plan"], [T.VARCHAR], [(text,)])
 
     def _explain_analyze(self, plan: OutputNode) -> MaterializedResult:
-        """EXPLAIN ANALYZE: run the query with per-node instrumentation and
-        render the plan annotated with output rows + wall time
-        (operator/ExplainAnalyzeOperator.java + OperatorStats.java)."""
+        """EXPLAIN ANALYZE: run the query with per-node instrumentation
+        (operator-level collection + device fencing forced on the query's
+        collector) and render the plan annotated with each node's rows,
+        bytes, and wall time (operator/ExplainAnalyzeOperator.java +
+        OperatorStats.java via obs/stats.py)."""
         import time
+        from trino_tpu.obs.stats import (QueryStatsCollector, maybe_phase,
+                                         render_analyzed_plan)
+        col = self._collector
+        if col is None:
+            # direct call outside execute(): a FRESH collector per call —
+            # persisting it would let a second call's plan reuse the
+            # first's id()-keyed operator slots after interpreter id reuse
+            col = QueryStatsCollector("explain-analyze")
+        col.operator_level = True
+        col.fence = True
         executor = LocalExecutionPlanner(self.metadata, self.session)
-        executor.node_stats = {}
+        executor.collector = col
+        executor.deadline = self._deadline
+        if self._memory is not None:
+            executor.memory = self._memory
         t0 = time.perf_counter()
         n_out = 0
-        for page in executor.execute(plan).iter_pages():
-            n_out += int(page.num_rows)
+        with maybe_phase(col, "execution"):
+            for page in executor.execute(plan).iter_pages():
+                self._check_deadline()
+                n_out += int(page.num_rows)
         total = time.perf_counter() - t0
-        stats = executor.node_stats
-
-        def annotate(node):
-            st = stats.get(id(node))
-            if st is None:
-                return ""
-            child_wall = sum(stats[id(s)].wall_s for s in node.sources
-                             if id(s) in stats)
-            own = max(st.wall_s - child_wall, 0.0)
-            return (f"output: {st.rows} rows ({st.pages} pages), "
-                    f"time: {own * 1000:.2f}ms "
-                    f"({st.wall_s * 1000:.2f}ms cumulative)")
-
-        text = format_plan(plan, annotate=annotate)
-        text += (f"\n\nQuery: {n_out} rows, "
-                 f"wall {total * 1000:.2f}ms (single device)")
+        text = render_analyzed_plan(plan, col, n_out, total)
         return MaterializedResult(["Query Plan"], [T.VARCHAR], [(text,)])
 
     def _show_tables(self, stmt: t.ShowTables) -> MaterializedResult:

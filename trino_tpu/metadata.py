@@ -72,6 +72,11 @@ SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     # pool bytes before failing retryable (CLUSTER_OUT_OF_MEMORY).
     "resource_group": "global",
     "cluster_memory_wait_ms": 2000,
+    # observability (obs/stats.py): per-operator stats collection for
+    # EVERY query on the session (EXPLAIN ANALYZE forces it regardless).
+    # Off by default: instrumenting node boundaries splits fused kernel
+    # chains and syncs the device once per page per operator.
+    "collect_operator_stats": False,
 }
 
 
@@ -106,7 +111,38 @@ class Session:
             from trino_tpu.errors import InvalidSessionPropertyError
             raise InvalidSessionPropertyError(
                 f"unknown session property: {prop}")
-        self.properties[prop] = value
+        self.properties[prop] = _coerce_property(prop, value)
+
+
+def _coerce_property(prop: str, value: Any) -> Any:
+    """Coerce a session-property value to its default's type
+    (SessionPropertyManager.decodeProperty analog): values arrive as raw
+    strings over the X-Trino-Session header, and storing `"false"` for a
+    boolean property would read truthy everywhere (`bool("false")` is
+    True). A malformed value raises InvalidSessionPropertyError at SET
+    time, not mid-query."""
+    from trino_tpu.errors import InvalidSessionPropertyError
+    default = SESSION_PROPERTY_DEFAULTS[prop]
+    try:
+        if isinstance(default, bool):
+            if isinstance(value, str):
+                lowered = value.strip().lower()
+                if lowered in ("true", "1", "on", "yes"):
+                    return True
+                if lowered in ("false", "0", "off", "no"):
+                    return False
+                raise ValueError(f"not a boolean: {value!r}")
+            return bool(value)
+        if isinstance(default, int):
+            return int(value)
+        if isinstance(default, float):
+            return float(value)
+        if isinstance(default, str):
+            return str(value)
+        return value
+    except (TypeError, ValueError) as e:
+        raise InvalidSessionPropertyError(
+            f"invalid value for session property {prop}: {e}") from e
 
 
 @dataclasses.dataclass(frozen=True)

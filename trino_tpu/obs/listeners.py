@@ -1,0 +1,153 @@
+"""EventListener registry: query lifecycle events with stats payloads.
+
+Reference parity: core/trino-spi eventlistener/ — EventListener.java's
+queryCreated/queryCompleted SPI, dispatched by QueryMonitor.java at
+state-machine transitions, with the loaded listeners configured through
+EventListenerManager. Here listeners register in-process; the query
+tracker (exec/query_tracker.py) fires `query_created` when a query
+registers, `query_completed` when it FINISHes, and `query_failed` when
+it FAILs or is CANCELED, each carrying the query's final stats snapshot
+and trace dump when the runner recorded them.
+
+Metric side-effects are NOT a listener: the fire_* functions update the
+process metrics registry unconditionally, so unregistering every
+listener cannot silence /v1/metrics. Listener exceptions are swallowed
+(logged) — a broken plugin must not fail queries (the reference wraps
+every listener call the same way).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger("trino_tpu.obs")
+
+
+@dataclasses.dataclass
+class QueryEvent:
+    """The payload all three events share (QueryCreatedEvent /
+    QueryCompletedEvent collapse onto one shape: a created event simply
+    has no terminal fields yet)."""
+
+    query_id: str
+    state: str
+    user: str
+    query: str
+    wall_ms: Optional[int] = None
+    cpu_time_ms: int = 0
+    rows: int = 0
+    output_bytes: int = 0
+    retries: int = 0
+    faults_injected: int = 0
+    resource_group: Optional[str] = None
+    peak_memory_bytes: int = 0
+    error: Optional[str] = None
+    error_name: Optional[str] = None
+    stats: Optional[Dict[str, Any]] = None    # QueryStatsCollector.snapshot()
+    trace: Optional[Dict[str, Any]] = None    # structured span dump
+
+
+class EventListener:
+    """Base listener (EventListener.java): override any subset."""
+
+    def query_created(self, event: QueryEvent) -> None:
+        pass
+
+    def query_completed(self, event: QueryEvent) -> None:
+        pass
+
+    def query_failed(self, event: QueryEvent) -> None:
+        pass
+
+
+class LoggingEventListener(EventListener):
+    """The default implementation: lifecycle lines on the
+    `trino_tpu.obs` logger (the reference ships an event logger the same
+    way; operators replace it with their own sink)."""
+
+    def query_created(self, event: QueryEvent) -> None:
+        log.debug("query created %s user=%s", event.query_id, event.user)
+
+    def query_completed(self, event: QueryEvent) -> None:
+        log.info("query completed %s rows=%d wall_ms=%s cpu_ms=%d "
+                 "bytes=%d", event.query_id, event.rows, event.wall_ms,
+                 event.cpu_time_ms, event.output_bytes)
+
+    def query_failed(self, event: QueryEvent) -> None:
+        log.info("query failed %s state=%s error=%s: %s", event.query_id,
+                 event.state, event.error_name, event.error)
+
+
+_LOCK = threading.Lock()
+_LISTENERS: List[EventListener] = [LoggingEventListener()]
+
+
+def register_listener(listener: EventListener) -> EventListener:
+    with _LOCK:
+        if listener not in _LISTENERS:
+            _LISTENERS.append(listener)
+    return listener
+
+
+def unregister_listener(listener: EventListener) -> None:
+    with _LOCK:
+        if listener in _LISTENERS:
+            _LISTENERS.remove(listener)
+
+
+def listeners() -> List[EventListener]:
+    with _LOCK:
+        return list(_LISTENERS)
+
+
+def event_from_info(info) -> QueryEvent:
+    """Build the payload from a QueryInfo (exec/query_tracker.py)."""
+    return QueryEvent(
+        query_id=info.query_id, state=info.state, user=info.user,
+        query=info.query, wall_ms=info.wall_ms,
+        cpu_time_ms=info.cpu_time_ms, rows=info.rows,
+        output_bytes=info.output_bytes, retries=info.retries,
+        faults_injected=info.faults_injected,
+        resource_group=info.resource_group,
+        peak_memory_bytes=info.pool_peak_bytes,
+        error=info.error, error_name=info.error_name,
+        stats=info.stats, trace=info.trace)
+
+
+def _dispatch(method: str, event: QueryEvent) -> None:
+    for listener in listeners():
+        try:
+            getattr(listener, method)(event)
+        except Exception:   # noqa: BLE001 — a plugin must not fail queries
+            log.exception("event listener %r failed on %s",
+                          type(listener).__name__, method)
+
+
+def fire_query_created(info) -> None:
+    _dispatch("query_created", event_from_info(info))
+
+
+def _record_terminal_metrics(info) -> None:
+    from trino_tpu.obs import metrics as m
+    m.QUERIES_TOTAL.inc(state=info.state)
+    m.QUERY_ROWS_TOTAL.inc(info.rows)
+    m.QUERY_BYTES_TOTAL.inc(info.output_bytes)
+    m.QUERY_RETRIES_TOTAL.inc(info.retries)
+    m.FAULTS_INJECTED_TOTAL.inc(info.faults_injected)
+    if info.stats:
+        m.SPILLED_BYTES_TOTAL.inc(info.stats.get("spilled_bytes", 0))
+    if info.wall_ms is not None:
+        m.QUERY_WALL_SECONDS.observe(info.wall_ms / 1000.0)
+
+
+def fire_query_completed(info) -> None:
+    _record_terminal_metrics(info)
+    _dispatch("query_completed", event_from_info(info))
+
+
+def fire_query_failed(info) -> None:
+    _record_terminal_metrics(info)
+    _dispatch("query_failed", event_from_info(info))

@@ -1,0 +1,300 @@
+"""Process-wide metrics registry with Prometheus text rendering.
+
+Reference parity: the reference exports engine counters through JMX
+MBeans (io.airlift.stats CounterStat/DistributionStat on QueryManager,
+MemoryPool, resource groups) and publishes them as OpenMetrics via the
+jmx-prometheus agent every production deployment runs. Here the registry
+is native: counters/histograms are fed by query lifecycle events
+(obs/listeners.py), and gauges SAMPLE live engine state at scrape time —
+the query tracker, the node memory pool, every live resource-group tree,
+and the jit kernel cache — so `GET /v1/metrics` and
+`system.runtime.metrics` always reflect the current process without any
+background collection thread.
+
+Naming follows Prometheus conventions: `trino_tpu_` prefix, `_total`
+suffix on monotonic counters, base units (bytes, seconds).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+LabelSet = Tuple[Tuple[str, str], ...]
+
+# query wall-clock histogram buckets (seconds): spans compile-dominated
+# millisecond queries to SF100 multi-minute rungs
+WALL_BUCKETS = (0.005, 0.025, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0, 600.0)
+
+
+def _labels(kw: Dict[str, Any]) -> LabelSet:
+    return tuple(sorted((str(k), str(v)) for k, v in kw.items()))
+
+
+def _render_labels(labels: LabelSet) -> str:
+    if not labels:
+        return ""
+    body = ",".join(
+        '%s="%s"' % (k, v.replace("\\", "\\\\").replace('"', '\\"')
+                     .replace("\n", "\\n"))
+        for k, v in labels)
+    return "{" + body + "}"
+
+
+class Counter:
+    """Monotonic counter family (one value per label set). `labeled`
+    families never fabricate an unlabeled zero sample: a placeholder
+    series that vanishes after the first real labeled increment reads as
+    a counter reset to anything monitoring it."""
+
+    def __init__(self, registry: "MetricsRegistry", name: str, help: str,
+                 labeled: bool = False):
+        self.name = name
+        self.help = help
+        self.labeled = labeled
+        self._registry = registry
+        self._values: Dict[LabelSet, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount == 0:
+            return
+        key = _labels(labels)
+        with self._registry._lock:
+            self._values[key] = self._values.get(key, 0.0) + float(amount)
+
+    def samples(self) -> Iterable[Tuple[str, LabelSet, float]]:
+        with self._registry._lock:
+            items = list(self._values.items())
+        if not items:
+            if self.labeled:
+                return              # family header only, no samples yet
+            items = [((), 0.0)]     # label-less family exists from birth
+        for key, value in items:
+            yield self.name, key, value
+
+
+class Histogram:
+    """Cumulative-bucket histogram family (Prometheus semantics)."""
+
+    def __init__(self, registry: "MetricsRegistry", name: str, help: str,
+                 buckets: Tuple[float, ...] = WALL_BUCKETS):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(sorted(buckets))
+        self._registry = registry
+        self._counts: Dict[LabelSet, List[int]] = {}
+        self._sums: Dict[LabelSet, float] = {}
+        self._totals: Dict[LabelSet, int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = _labels(labels)
+        with self._registry._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + float(value)
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def samples(self) -> Iterable[Tuple[str, LabelSet, float]]:
+        with self._registry._lock:
+            keys = list(self._counts) or [()]
+            counts = {k: list(v) for k, v in self._counts.items()}
+            sums, totals = dict(self._sums), dict(self._totals)
+        for key in keys:
+            cum = counts.get(key, [0] * len(self.buckets))
+            for b, c in zip(self.buckets, cum):
+                yield (self.name + "_bucket",
+                       key + (("le", _fmt_float(b)),), float(c))
+            yield (self.name + "_bucket", key + (("le", "+Inf"),),
+                   float(totals.get(key, 0)))
+            yield self.name + "_sum", key, sums.get(key, 0.0)
+            yield self.name + "_count", key, float(totals.get(key, 0))
+
+
+def _fmt_float(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    out = repr(float(v))
+    return out[:-2] if out.endswith(".0") else out
+
+
+class MetricsRegistry:
+    """Instrument + gauge-callback registry; render() is the scrape."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._counters: Dict[str, Counter] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        # each callback yields (name, help, value, labels_dict) gauge
+        # samples from live engine state at scrape time
+        self._gauge_callbacks: List[Callable[[], Iterable[tuple]]] = []
+
+    def counter(self, name: str, help: str,
+                labeled: bool = False) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(self, name, help,
+                                                   labeled)
+            return c
+
+    def histogram(self, name: str, help: str,
+                  buckets: Tuple[float, ...] = WALL_BUCKETS) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(self, name, help,
+                                                       buckets)
+            return h
+
+    def register_gauges(self, callback: Callable[[], Iterable[tuple]]
+                        ) -> None:
+        with self._lock:
+            if callback not in self._gauge_callbacks:
+                self._gauge_callbacks.append(callback)
+
+    # ---------------------------------------------------------- scrape
+
+    def _gauge_samples(self) -> List[Tuple[str, str, LabelSet, float]]:
+        out = []
+        with self._lock:
+            callbacks = list(self._gauge_callbacks)
+        for cb in callbacks:
+            try:
+                for name, help, value, labels in cb():
+                    out.append((name, help, _labels(labels), float(value)))
+            except Exception:   # a broken sampler must not fail the scrape
+                continue
+        return out
+
+    def render(self) -> str:
+        """The Prometheus text exposition (format 0.0.4): families
+        grouped under one HELP/TYPE header each."""
+        lines: List[str] = []
+        with self._lock:
+            counters = list(self._counters.values())
+            histograms = list(self._histograms.values())
+        for c in sorted(counters, key=lambda c: c.name):
+            lines.append(f"# HELP {c.name} {c.help}")
+            lines.append(f"# TYPE {c.name} counter")
+            for name, labels, value in c.samples():
+                lines.append(f"{name}{_render_labels(labels)} "
+                             f"{_fmt_value(value)}")
+        for h in sorted(histograms, key=lambda h: h.name):
+            lines.append(f"# HELP {h.name} {h.help}")
+            lines.append(f"# TYPE {h.name} histogram")
+            for name, labels, value in h.samples():
+                lines.append(f"{name}{_render_labels(labels)} "
+                             f"{_fmt_value(value)}")
+        gauges = self._gauge_samples()
+        seen_header = set()
+        for name, help, labels, value in sorted(gauges):
+            if name not in seen_header:
+                seen_header.add(name)
+                lines.append(f"# HELP {name} {help}")
+                lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name}{_render_labels(labels)} "
+                         f"{_fmt_value(value)}")
+        return "\n".join(lines) + "\n"
+
+    def samples(self) -> List[Tuple[str, str, str, float]]:
+        """(name, kind, labels, value) rows for system.runtime.metrics —
+        the same data render() exposes, shaped for a table scan."""
+        rows: List[Tuple[str, str, str, float]] = []
+        with self._lock:
+            counters = list(self._counters.values())
+            histograms = list(self._histograms.values())
+        for c in counters:
+            for name, labels, value in c.samples():
+                rows.append((name, "counter", _render_labels(labels)[1:-1],
+                             value))
+        for h in histograms:
+            for name, labels, value in h.samples():
+                rows.append((name, "histogram", _render_labels(labels)[1:-1],
+                             value))
+        for name, _help, labels, value in self._gauge_samples():
+            rows.append((name, "gauge", _render_labels(labels)[1:-1], value))
+        return sorted(rows)
+
+
+def _fmt_value(v: float) -> str:
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+# the process-wide registry (singleton scope, like TRACKER / NODE_POOL)
+REGISTRY = MetricsRegistry()
+
+# counter/histogram families fed by query lifecycle events
+# (obs/listeners.py fires these on every tracker transition)
+QUERIES_TOTAL = REGISTRY.counter(
+    "trino_tpu_queries_total",
+    "Queries reaching a terminal state, by state.", labeled=True)
+QUERY_ROWS_TOTAL = REGISTRY.counter(
+    "trino_tpu_query_rows_total", "Result rows returned by queries.")
+QUERY_BYTES_TOTAL = REGISTRY.counter(
+    "trino_tpu_query_bytes_total", "Output bytes produced by queries.")
+QUERY_RETRIES_TOTAL = REGISTRY.counter(
+    "trino_tpu_query_retries_total",
+    "Task/query retry attempts across all queries.")
+FAULTS_INJECTED_TOTAL = REGISTRY.counter(
+    "trino_tpu_faults_injected_total",
+    "Chaos faults injected across all queries.")
+SPILLED_BYTES_TOTAL = REGISTRY.counter(
+    "trino_tpu_query_spilled_bytes_total",
+    "Bytes spilled to host partitions across all queries.")
+QUERY_WALL_SECONDS = REGISTRY.histogram(
+    "trino_tpu_query_wall_seconds",
+    "Query wall-clock duration from start to terminal state.")
+
+
+def _engine_gauges():
+    """Live engine state sampled at scrape time: tracker states, node
+    memory pool, resource groups, jit kernel cache."""
+    from trino_tpu.exec.query_tracker import TRACKER
+    states: Dict[str, int] = {}
+    for q in TRACKER.list():
+        states[q.state] = states.get(q.state, 0) + 1
+    for state, n in sorted(states.items()):
+        yield ("trino_tpu_queries", "Tracked queries by lifecycle state.",
+               n, {"state": state})
+
+    from trino_tpu.exec.memory import NODE_POOL
+    pool = "Node memory pool "
+    yield ("trino_tpu_pool_limit_bytes", pool + "reservable budget.",
+           NODE_POOL.limit or 0, {})
+    yield ("trino_tpu_pool_reserved_bytes", pool + "current reservation.",
+           NODE_POOL.reserved, {})
+    yield ("trino_tpu_pool_peak_bytes", pool + "peak reservation.",
+           NODE_POOL.peak, {})
+    yield ("trino_tpu_pool_kills", pool + "low-memory-killer victims.",
+           NODE_POOL.kills, {})
+    yield ("trino_tpu_pool_leaks", pool + "reservation leaks at query end.",
+           NODE_POOL.leaks, {})
+    yield ("trino_tpu_pool_leaked_bytes", pool + "bytes leaked total.",
+           NODE_POOL.leaked_bytes, {})
+
+    from trino_tpu.exec.resource_groups import list_all_groups
+    for g in list_all_groups():
+        labels = {"group": g.name}
+        yield ("trino_tpu_resource_group_queued",
+               "Queued queries per resource group.", g.queued, labels)
+        yield ("trino_tpu_resource_group_running",
+               "Running queries per resource group.", len(g.running),
+               labels)
+
+    from trino_tpu.exec import jit_cache
+    js = jit_cache.stats()
+    yield ("trino_tpu_jit_cache_kernels",
+           "Compiled kernels resident in the jit cache.", js["size"], {})
+    yield ("trino_tpu_jit_cache_hits",
+           "Jit cache hits since process start.", js["hits"], {})
+    yield ("trino_tpu_jit_cache_misses",
+           "Jit cache misses (kernel builds) since process start.",
+           js["misses"], {})
+
+
+REGISTRY.register_gauges(_engine_gauges)

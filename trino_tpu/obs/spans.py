@@ -1,0 +1,57 @@
+"""Lightweight trace spans: query -> fragment -> operator.
+
+Reference parity: the reference engine emits OpenTelemetry spans from
+`Trace`-annotated scopes (io.opentelemetry wiring in trino-main's
+ServerMainModule); here a span is a plain host-side record — name, kind,
+monotonic start/end, attributes, children — cheap enough to record on
+every query, and the structured JSON dump replaces the OTLP exporter
+(QueryInfo.trace / the event payload carry it per query).
+
+Spans are built single-threaded by the owning query's executor thread
+(the same contract as FaultInjector); readers only see the dump taken at
+query end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    kind: str = "internal"     # query | phase | fragment | exchange | operator
+    start_s: float = dataclasses.field(default_factory=time.perf_counter)
+    end_s: Optional[float] = None
+    attrs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    children: List["Span"] = dataclasses.field(default_factory=list)
+
+    def finish(self) -> "Span":
+        if self.end_s is None:
+            self.end_s = time.perf_counter()
+        return self
+
+    @property
+    def wall_s(self) -> float:
+        end = self.end_s if self.end_s is not None else time.perf_counter()
+        return max(0.0, end - self.start_s)
+
+    def to_json(self) -> Dict[str, Any]:
+        """Structured dump; times are relative to the span's own start so
+        the tree is self-contained (monotonic origins don't travel)."""
+        return self._to_json(self.start_s)
+
+    def _to_json(self, origin: float) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "name": self.name,
+            "kind": self.kind,
+            "start_ms": round((self.start_s - origin) * 1000, 3),
+            "wall_ms": round(self.wall_s * 1000, 3),
+        }
+        if self.attrs:
+            out["attrs"] = dict(self.attrs)
+        if self.children:
+            out["children"] = [c._to_json(origin) for c in self.children]
+        return out

@@ -1,0 +1,250 @@
+"""Per-query stats pipeline: QueryStatsCollector + OperatorStats.
+
+Reference parity: execution/QueryStats.java (query-level rollup: planning
+vs execution wall, raw input/output, spilled bytes) +
+operator/OperatorStats.java (per-operator wall time, positions, bytes,
+rolled up by PlanNodeStatsSummarizer for EXPLAIN ANALYZE). The collector
+is created once per query by the runner and threaded through the local
+planner, the distributed scheduler, and the jit cache, so every surface —
+EXPLAIN ANALYZE, system.runtime.queries, event listeners, bench.py —
+reports the SAME numbers.
+
+Two collection levels, because per-operator instrumentation is not free
+on this engine: wrapping a node boundary forces the pending fused-kernel
+chain at that node (the composed scan->filter->project program splits
+into per-operator programs) and reading a page's row count syncs the
+device. Query-level collection (phases, output rows/bytes, jit cache
+hits/misses, spill bytes) is therefore ALWAYS on, while operator-level
+collection turns on per query via the `collect_operator_stats` session
+property or EXPLAIN ANALYZE. Under EXPLAIN ANALYZE `fence` additionally
+`block_until_ready`s every page at the node boundary, so asynchronously
+dispatched device time is attributed to the operator that launched it
+instead of hiding in whichever downstream read happens to sync first
+(the OperationTimer discipline, TPU edition).
+
+Threading contract: one collector belongs to one query, mutated by that
+query's executor thread only (distributed shards dispatch sequentially
+on it); cross-thread readers consume the immutable snapshot() taken at
+query end.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from trino_tpu.obs.spans import Span
+
+
+@dataclasses.dataclass
+class OperatorStats:
+    """One plan node's runtime counters (OperatorStats.java analog):
+    output rows/pages/bytes + inclusive wall time; exclusive time and
+    input rows derive from the child links at render/snapshot time."""
+
+    node_id: int
+    name: str
+    output_rows: int = 0
+    pages: int = 0
+    output_bytes: int = 0
+    wall_s: float = 0.0
+    source_ids: Tuple[int, ...] = ()
+
+
+class QueryStatsCollector:
+    def __init__(self, query_id: str = "", operator_level: bool = False,
+                 fence: bool = False):
+        self.query_id = query_id
+        self.operator_level = bool(operator_level)
+        self.fence = bool(fence)
+        self.root = Span(query_id or "query", kind="query")
+        self._stack: List[Span] = [self.root]
+        self.phases: Dict[str, float] = {}
+        self.operators: Dict[int, OperatorStats] = {}
+        self.output_rows = 0
+        self.output_bytes = 0
+        self.spilled_bytes = 0
+        self.jit_hits = 0
+        self.jit_misses = 0
+        self.retries = 0
+        self.faults_injected = 0
+
+    # ----------------------------------------------------------- spans
+
+    @contextlib.contextmanager
+    def span(self, name: str, kind: str = "internal", **attrs):
+        s = Span(name, kind=kind, attrs=attrs)
+        self._stack[-1].children.append(s)
+        self._stack.append(s)
+        try:
+            yield s
+        finally:
+            s.finish()
+            self._stack.pop()
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        """A named query phase (planning/execution): a span plus an
+        accumulated wall bucket — retries re-enter the same bucket."""
+        with self.span(name, kind="phase") as s:
+            try:
+                yield s
+            finally:
+                s.finish()
+                self.phases[name] = self.phases.get(name, 0.0) + s.wall_s
+
+    # ------------------------------------------------------- operators
+
+    def register(self, node) -> OperatorStats:
+        """Stats slot for a plan node (the SAME node object re-executed —
+        a task retry, a shared subtree, a per-shard task — accumulates
+        into one slot; a QUERY-level re-run re-plans, so the runner
+        clears `operators` between attempts to keep id() keys valid)."""
+        st = self.operators.get(id(node))
+        if st is None:
+            st = OperatorStats(
+                id(node), type(node).__name__,
+                source_ids=tuple(id(s) for s in node.sources))
+            self.operators[id(node)] = st
+        return st
+
+    def input_rows(self, st: OperatorStats) -> int:
+        return sum(self.operators[s].output_rows
+                   for s in st.source_ids if s in self.operators)
+
+    # -------------------------------------------------------- counters
+
+    def add_output(self, rows: int, nbytes: int) -> None:
+        self.output_rows += int(rows)
+        self.output_bytes += int(nbytes)
+
+    def add_spill(self, nbytes: int) -> None:
+        self.spilled_bytes += int(nbytes)
+
+    def jit_hit(self, key=None) -> None:
+        self.jit_hits += 1
+
+    def jit_miss(self, key=None) -> None:
+        self.jit_misses += 1
+
+    # -------------------------------------------------------- finish
+
+    def finish(self) -> None:
+        self.root.finish()
+
+    @property
+    def execution_s(self) -> float:
+        return self.phases.get("execution", 0.0)
+
+    @property
+    def planning_s(self) -> float:
+        return self.phases.get("planning", 0.0)
+
+    def operator_rows(self) -> List[Dict[str, Any]]:
+        out = []
+        for st in self.operators.values():
+            out.append({
+                "name": st.name,
+                "input_rows": self.input_rows(st),
+                "output_rows": st.output_rows,
+                "output_bytes": st.output_bytes,
+                "pages": st.pages,
+                "wall_ms": round(st.wall_s * 1000, 3),
+            })
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The immutable query-end rollup (QueryStats.java wire shape):
+        what QueryInfo.stats, event payloads, and bench.py carry."""
+        snap: Dict[str, Any] = {
+            "query_id": self.query_id,
+            "wall_s": round(self.root.wall_s, 6),
+            "planning_s": round(self.planning_s, 6),
+            "execution_s": round(self.execution_s, 6),
+            "output_rows": self.output_rows,
+            "output_bytes": self.output_bytes,
+            "spilled_bytes": self.spilled_bytes,
+            "jit_hits": self.jit_hits,
+            "jit_misses": self.jit_misses,
+            "retries": self.retries,
+            "faults_injected": self.faults_injected,
+        }
+        if self.operators:
+            snap["operators"] = self.operator_rows()
+        return snap
+
+    def trace_json(self) -> Dict[str, Any]:
+        """The per-query structured span dump (query -> phases ->
+        fragments/exchanges), with operator spans synthesized from the
+        collected OperatorStats when operator-level collection ran (a
+        streaming operator has no contiguous lifetime, so its 'span' is
+        its inclusive wall, parented under the query root)."""
+        dump = self.root.to_json()
+        if self.operators:
+            origin = self.root.start_s
+            ops = []
+            for st in self.operators.values():
+                op = Span(st.name, kind="operator", start_s=origin,
+                          attrs={"output_rows": st.output_rows,
+                                 "output_bytes": st.output_bytes,
+                                 "pages": st.pages})
+                op.end_s = origin + st.wall_s
+                ops.append(op._to_json(origin))
+            dump.setdefault("children", []).extend(ops)
+        return dump
+
+
+def maybe_span(collector: Optional[QueryStatsCollector], name: str,
+               kind: str = "internal", **attrs):
+    """Span scope that degrades to a no-op without a collector (the
+    execution paths run with collector=None outside runner.execute)."""
+    if collector is None:
+        return contextlib.nullcontext()
+    return collector.span(name, kind=kind, **attrs)
+
+
+def maybe_phase(collector: Optional[QueryStatsCollector], name: str):
+    if collector is None:
+        return contextlib.nullcontext()
+    return collector.phase(name)
+
+
+def render_analyzed_plan(plan, collector: QueryStatsCollector,
+                         total_rows: int, total_wall_s: float,
+                         label: str = "single device") -> str:
+    """EXPLAIN ANALYZE text: the executed plan annotated with each node's
+    rows, bytes, and wall time (PlanPrinter.textDistributedPlan with
+    operator stats). Exclusive time subtracts the children's inclusive
+    walls, clamped at zero (a fused child can complete inside its
+    parent's read)."""
+    from trino_tpu.planner.nodes import format_plan
+
+    def annotate(node):
+        st = collector.operators.get(id(node))
+        if st is None:
+            return ""
+        child_wall = sum(collector.operators[s].wall_s
+                         for s in st.source_ids
+                         if s in collector.operators)
+        own = max(st.wall_s - child_wall, 0.0)
+        return (f"output: {st.output_rows} rows ({st.pages} pages, "
+                f"{_fmt_bytes(st.output_bytes)}), "
+                f"time: {own * 1000:.2f}ms "
+                f"({st.wall_s * 1000:.2f}ms cumulative)")
+
+    text = format_plan(plan, annotate=annotate)
+    text += (f"\n\nQuery: {total_rows} rows, "
+             f"wall {total_wall_s * 1000:.2f}ms ({label}), "
+             f"planning {collector.planning_s * 1000:.2f}ms, "
+             f"jit {collector.jit_hits} hits / "
+             f"{collector.jit_misses} misses")
+    if collector.spilled_bytes:
+        text += f", spilled {_fmt_bytes(collector.spilled_bytes)}"
+    return text
+
+
+def _fmt_bytes(n: int) -> str:
+    from trino_tpu.exec.memory import _fmt_bytes as fmt
+    return fmt(int(n))

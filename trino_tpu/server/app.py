@@ -94,15 +94,22 @@ class TrinoServer:
                  max_queued: int = 200, keep: int = 200,
                  query_timeout_s: Optional[float] = None,
                  max_running: int = 4,
-                 resource_groups: Optional[ResourceGroupManager] = None):
+                 resource_groups: Optional[ResourceGroupManager] = None,
+                 resource_groups_path: Optional[str] = None):
         self.runner = runner
         self.keep = keep
         self.query_timeout_s = query_timeout_s
         self.max_running = max(1, int(max_running))
         # the group tree this server dispatches through; callers may hand
-        # in a preconfigured manager (group limits/weights). max_queued
-        # stays the SERVER-WIDE admission bound (round-5 contract) on top
-        # of per-group budgets
+        # in a preconfigured manager (group limits/weights) or a JSON
+        # config file (`resource_groups.path` — the file-based
+        # ResourceGroupConfigurationManager analog). max_queued stays the
+        # SERVER-WIDE admission bound (round-5 contract) on top of
+        # per-group budgets
+        if resource_groups is None and resource_groups_path is not None:
+            resource_groups = ResourceGroupManager.from_file(
+                resource_groups_path, default_max_queued=max_queued,
+                max_total_queued=max_queued)
         self.groups = resource_groups or ResourceGroupManager(
             default_max_queued=max_queued, max_total_queued=max_queued)
         self._lock = threading.Lock()
@@ -162,10 +169,10 @@ class TrinoServer:
                 overrides[k.strip()] = unquote(v.strip())
         return overrides
 
-    def _group_for(self, q: _Query) -> str:
+    def _group_for(self, headers: dict) -> str:
         """The query's resource group: the `resource_group` key of the
         client's X-Trino-Session header, else the base session default."""
-        group = self._session_overrides(q.headers).get("resource_group")
+        group = self._session_overrides(headers).get("resource_group")
         if group:
             return group
         try:
@@ -185,8 +192,11 @@ class TrinoServer:
         q = _Query(qid, uuid.uuid4().hex[:12], sql,
                    {k.lower(): v for k, v in headers.items()})
         user = q.headers.get("x-trino-user", "user")
-        q.info = TRACKER.begin(sql, user=user, query_id=qid)
-        q.info.resource_group = group = self._group_for(q)
+        # resolve the group BEFORE registering: the query_created event
+        # fires from begin() and must carry the resource group
+        group = self._group_for(q.headers)
+        q.info = TRACKER.begin(sql, user=user, query_id=qid,
+                               resource_group=group)
         with self._lock:
             self._queries[qid] = q
             self._prune_locked()
@@ -241,8 +251,28 @@ class TrinoServer:
                 except BaseException as e:  # noqa: BLE001 — keep draining
                     q.error = protocol.error_from_exception(e)
                     q.state = "FAILED"
+                    self._fail_tracker(q, e)
             finally:
                 self.groups.finish(group, q.query_id)
+
+    @staticmethod
+    def _fail_tracker(q: _Query, exc: BaseException) -> None:
+        """Transition the pre-registered tracker entry when a failure
+        happens OUTSIDE runner.execute() (e.g. a malformed session
+        property raising at set() time): without this the entry stays
+        QUEUED forever — a phantom row in system.runtime.queries that
+        pruning (terminal-only) never removes, and no query_failed
+        event/metrics ever fire."""
+        from trino_tpu.errors import classify
+        from trino_tpu.exec.query_tracker import TERMINAL, TRACKER
+        info = q.info
+        if info is None or info.state in TERMINAL:
+            return
+        try:
+            TRACKER.fail(info, f"{type(exc).__name__}: {exc}",
+                         error_name=classify(exc).name)
+        except ValueError:
+            pass    # lost the race to a concurrent terminal transition
 
     def _execute(self, q: _Query) -> None:
         headers = q.headers
@@ -260,11 +290,15 @@ class TrinoServer:
                 session.catalog = catalog
             if schema:
                 session.schema = schema
+            from trino_tpu.metadata import SESSION_PROPERTY_DEFAULTS
             for k, v in self._session_overrides(headers).items():
-                try:
-                    session.set(k, v)
-                except Exception:
-                    pass
+                if k not in SESSION_PROPERTY_DEFAULTS:
+                    continue    # tolerate properties this engine lacks
+                # a KNOWN property with a malformed value fails the query
+                # (set() coerces to the default's type at SET time) — the
+                # pre-coercion contract, where the raw string failed at
+                # execute(), kept the same visibility
+                session.set(k, v)
             # the runner builds the query's deadline AFTER the session
             # overrides apply (so header-sent limits bind), from the
             # submit time (query_max_run_time counts queueing) capped
@@ -291,6 +325,10 @@ class TrinoServer:
             q.cancelled = True         # surfaces as CANCELED, not FAILED
         except Exception as e:  # surface as QueryError, not HTTP 500
             q.error = protocol.error_from_exception(e)
+            # failures BEFORE runner.execute() (session-override coercion)
+            # must still terminate the tracker entry; inside execute() the
+            # runner already transitioned it (this is then a no-op)
+            self._fail_tracker(q, e)
 
     # ------------------------------------------------------------ paging
 
@@ -339,12 +377,18 @@ class TrinoServer:
         chunk = res.rows[lo:hi]
         data = protocol.encode_rows(chunk, res.column_types)
         has_more = hi < len(res.rows)
+        spilled = 0
+        if info is not None and info.stats:
+            spilled = int(info.stats.get("spilled_bytes", 0))
         return protocol.query_results(
             q.query_id, self.base_uri, columns=cols, data=data,
             next_uri=self._page_uri(q, token + 1) if has_more else None,
             state="RUNNING" if has_more else "FINISHED",
             update_type=q.update_type, rows=len(res.rows),
             elapsed_ms=q.elapsed_ms, peak_memory_bytes=peak,
+            cpu_time_ms=info.cpu_time_ms if info is not None else None,
+            processed_bytes=info.output_bytes if info is not None else 0,
+            spilled_bytes=spilled,
             warnings=self._warnings_for(q))
 
     # ----------------------------------------------------------- handler
@@ -393,6 +437,19 @@ class TrinoServer:
                     elapsed_ms=q.elapsed_ms), q)
 
             def do_GET(self):
+                if self.path.rstrip("/") == "/v1/metrics":
+                    # Prometheus scrape endpoint (the jmx-prometheus
+                    # agent surface of a reference deployment, native)
+                    from trino_tpu.obs.metrics import REGISTRY
+                    body = REGISTRY.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 q, token = self._resolve()
                 if q is None:
                     return

@@ -103,8 +103,13 @@ def warning_json(message: str, code: int = 1,
 
 def stats_json(state: str, *, queued: bool = False, done: bool = False,
                rows: int = 0, elapsed_ms: int = 0,
-               peak_memory_bytes: int = 0) -> Dict[str, Any]:
-    """StatementStats.java — the CLI renders progress from these fields."""
+               peak_memory_bytes: int = 0,
+               cpu_time_ms: Optional[int] = None,
+               processed_bytes: int = 0,
+               spilled_bytes: int = 0) -> Dict[str, Any]:
+    """StatementStats.java — the CLI renders progress from these fields.
+    cpu/bytes/spill come from the query's stats collector (obs/stats.py)
+    when the server has them; cpuTimeMillis falls back to elapsed."""
     return {
         "state": state,
         "queued": queued,
@@ -114,15 +119,15 @@ def stats_json(state: str, *, queued: bool = False, done: bool = False,
         "queuedSplits": 1 if queued else 0,
         "runningSplits": 0,
         "completedSplits": 0 if queued else 1,
-        "cpuTimeMillis": elapsed_ms,
+        "cpuTimeMillis": elapsed_ms if cpu_time_ms is None else cpu_time_ms,
         "wallTimeMillis": elapsed_ms,
         "queuedTimeMillis": 0,
         "elapsedTimeMillis": elapsed_ms,
         "processedRows": rows,
-        "processedBytes": 0,
+        "processedBytes": processed_bytes,
         "physicalInputBytes": 0,
         "peakMemoryBytes": peak_memory_bytes,
-        "spilledBytes": 0,
+        "spilledBytes": spilled_bytes,
     }
 
 
@@ -136,6 +141,9 @@ def query_results(query_id: str, base_uri: str, *,
                   rows: int = 0,
                   elapsed_ms: int = 0,
                   peak_memory_bytes: int = 0,
+                  cpu_time_ms: Optional[int] = None,
+                  processed_bytes: int = 0,
+                  spilled_bytes: int = 0,
                   warnings: Optional[List[Dict[str, Any]]] = None
                   ) -> Dict[str, Any]:
     out: Dict[str, Any] = {
@@ -144,7 +152,10 @@ def query_results(query_id: str, base_uri: str, *,
         "stats": stats_json(state, queued=(state == "QUEUED"),
                             done=next_uri is None, rows=rows,
                             elapsed_ms=elapsed_ms,
-                            peak_memory_bytes=peak_memory_bytes),
+                            peak_memory_bytes=peak_memory_bytes,
+                            cpu_time_ms=cpu_time_ms,
+                            processed_bytes=processed_bytes,
+                            spilled_bytes=spilled_bytes),
         "warnings": warnings or [],
     }
     if next_uri is not None:
