@@ -18,11 +18,18 @@ _jax.config.update("jax_enable_x64", True)
 
 def enable_persistent_cache(directory: str = None) -> None:
     """Point XLA's persistent compilation cache at `directory` (default:
-    `.jax_cache` beside the package). Query kernels are expensive to compile
-    and keyed purely by program; caching them on disk makes repeat runs —
-    test suites, bench rounds, restarted sessions — skip recompilation."""
+    $TRINO_TPU_COMPILATION_CACHE_DIR, else `.jax_cache` beside the
+    package). Query kernels are expensive to compile and keyed purely by
+    program; caching them on disk makes repeat runs — test suites, bench
+    rounds, restarted sessions — skip recompilation. With literal hoisting
+    (expr/hoist.py) kernels are literal-free, so one disk entry serves
+    every literal variant of a query shape across processes; the
+    in-process jit-cache LRU sits above this, holding loaded executables
+    (an LRU eviction costs a re-trace + disk load, not a recompile)."""
     import os as _os
     if directory is None:
+        directory = _os.environ.get("TRINO_TPU_COMPILATION_CACHE_DIR")
+    if not directory:
         directory = _os.path.join(
             _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
             ".jax_cache")

@@ -8,17 +8,38 @@ compilation is a jitted page kernel; the cache key is the lowered expression
 tree / operator spec (frozen dataclasses, structurally hashable), and
 jax.jit's own trace cache handles per-(capacity, dtype, dictionary) retraces
 beneath each entry. Executing the same query shape twice must not re-trace.
+
+Parameterized kernels (round 8): expr/hoist.py rewrites trace-shape-
+irrelevant literals into Param slots before keys are built, so the key is
+the literal-free CANONICAL tree and the literal values ride into the jitted
+kernel as traced scalar operands (`params`). A hit whose parameter values
+differ from the previous call of the same canonical key is a *param hit* —
+sharing that per-literal keying could not have expressed (each distinct
+literal set would have been its own key: a compile on first sight, a
+separate resident kernel after). Counted separately so bench/metrics can
+see the parameterized workload; note it counts value CHANGES against the
+last call, not distinct literal sets, so alternating parameters re-count.
+
+Interaction with the on-disk persistent XLA cache
+(trino_tpu.enable_persistent_cache / TRINO_TPU_COMPILATION_CACHE_DIR): this
+LRU caches *loaded executables + traces in-process*; the persistent cache
+stores *compiled XLA binaries on disk*, keyed by the traced program. An LRU
+eviction (or a process restart) therefore costs a re-trace plus a disk
+load, not a recompile — and because hoisted kernels are literal-free, one
+disk entry serves every literal variant of a shape across processes.
 """
 
 from __future__ import annotations
 
 import collections
 import threading
-from typing import Any, Callable, Dict, Hashable
+from typing import Any, Callable, Dict, Hashable, Optional, Tuple
 
 import jax
+import numpy as np
 
-_CACHE: "collections.OrderedDict[Hashable, Callable]" = \
+# key -> [jitted kernel, last-seen flattened param signature or None]
+_CACHE: "collections.OrderedDict[Hashable, list]" = \
     collections.OrderedDict()
 # concurrent queries (the server's executor pool) share this cache; the
 # lock guards the LRU structure only — jitted kernels themselves are
@@ -31,43 +52,76 @@ _LOCK = threading.RLock()   # reentrant: a build() may consult the cache
 # on-disk persistent compilation cache (no re-trace cost beyond reload).
 _MAX_KERNELS = 512
 
-# process-lifetime hit/miss counters (exported by obs/metrics.py), plus a
-# per-thread observer slot: the runner installs its query's
-# QueryStatsCollector for the duration of execute(), so hits/misses
-# attribute to the query whose executor thread triggered them (server
-# concurrency runs each query on its own thread)
-_STATS = {"hits": 0, "misses": 0}
+# process-lifetime hit/miss/param-hit/eviction counters (exported by
+# obs/metrics.py), plus a per-thread observer slot: the runner installs its
+# query's QueryStatsCollector for the duration of execute(), so
+# hits/misses attribute to the query whose executor thread triggered them
+# (server concurrency runs each query on its own thread)
+_STATS = {"hits": 0, "misses": 0, "param_hits": 0, "evictions": 0}
 _TLS = threading.local()
 
 
 def set_observer(observer) -> None:
     """Install/clear (None) this thread's per-query jit observer — an
-    object with jit_hit(key)/jit_miss(key)."""
+    object with jit_hit(key)/jit_miss(key) and optionally
+    jit_param_hit(key)."""
     _TLS.observer = observer
 
 
-def cached_kernel(key: Hashable, build: Callable[[], Callable]) -> Callable:
+def _param_signature(params) -> Tuple:
+    """Flatten a (possibly nested) tuple of 0-d scalar arrays into a
+    comparable value signature. Used only to tell `jit_param_hit` (same
+    canonical key, new literal values) apart from a plain `jit_hit`."""
+    out = []
+
+    def visit(p):
+        if isinstance(p, (tuple, list)):
+            for x in p:
+                visit(x)
+        else:
+            a = np.asarray(p)
+            out.append((a.dtype.str, a.item()))
+    visit(params)
+    return tuple(out)
+
+
+def cached_kernel(key: Hashable, build: Callable[[], Callable],
+                  params: Optional[Any] = None) -> Callable:
     """Return the jitted kernel for `key`, building+jitting it on first use.
 
     `build()` must construct the kernel purely from information encoded in
     `key` (no capture of per-query state), so a cache hit is always correct.
+    `params`, when given, is the runtime literal tuple the caller will pass
+    to the kernel — used ONLY for hit attribution (param-hit vs plain hit),
+    never for keying: the whole point is that the key excludes it.
     """
+    sig = None if params is None else _param_signature(params)
+    param_hit = False
     with _LOCK:
-        fn = _CACHE.get(key)
-        if fn is None:
+        entry = _CACHE.get(key)
+        if entry is None:
             fn = jax.jit(build())
             while len(_CACHE) >= _MAX_KERNELS:
                 _CACHE.popitem(last=False)
-            _CACHE[key] = fn
+                _STATS["evictions"] += 1
+            _CACHE[key] = [fn, sig]
             _STATS["misses"] += 1
             miss = True
         else:
             _CACHE.move_to_end(key)
+            fn = entry[0]
             _STATS["hits"] += 1
             miss = False
+            if sig is not None:
+                param_hit = entry[1] is not None and entry[1] != sig
+                entry[1] = sig
+                if param_hit:
+                    _STATS["param_hits"] += 1
     observer = getattr(_TLS, "observer", None)
     if observer is not None:
         (observer.jit_miss if miss else observer.jit_hit)(key)
+        if param_hit and hasattr(observer, "jit_param_hit"):
+            observer.jit_param_hit(key)
     return fn
 
 
@@ -76,10 +130,14 @@ def cache_info() -> int:
 
 
 def stats() -> dict:
-    """Snapshot for metrics: resident kernels + lifetime hits/misses."""
+    """Snapshot for metrics: resident kernels + lifetime hits/misses/
+    param-hits (hit on a canonical key with changed literal values) /
+    evictions."""
     with _LOCK:
         return {"size": len(_CACHE), "hits": _STATS["hits"],
-                "misses": _STATS["misses"]}
+                "misses": _STATS["misses"],
+                "param_hits": _STATS["param_hits"],
+                "evictions": _STATS["evictions"]}
 
 
 def clear():  # for tests

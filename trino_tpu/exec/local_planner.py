@@ -90,20 +90,26 @@ class PageStream:
 
     WorkProcessor-style (operator/WorkProcessor.java:31): streaming operators
     (filter/project/column-select) don't dispatch device work themselves —
-    they append (cache_key, kernel_builder) entries to `pending`. Consumers
-    drain via iter_pages(), which compiles ONE composed kernel for the whole
-    chain (cached), so a scan->filter->project pipeline is a single XLA
-    program per page, and blocking operators can fuse the chain into their
-    own kernel (ScanFilterAndProjectOperator's fusion, compile-once).
+    they append (cache_key, kernel_builder, params) entries to `pending`.
+    Consumers drain via iter_pages(), which compiles ONE composed kernel for
+    the whole chain (cached), so a scan->filter->project pipeline is a single
+    XLA program per page, and blocking operators can fuse the chain into
+    their own kernel (ScanFilterAndProjectOperator's fusion, compile-once).
+
+    `params` per entry is the op's hoisted-literal tuple (expr/hoist.py):
+    keys carry the literal-free canonical expression, and the values flow
+    into the composed kernel as traced scalar operands — so every literal
+    variant of a chain shape shares one XLA executable. Builders therefore
+    return fn(page, params), with params=() for literal-free ops.
     """
 
     pages: Iterator[Page]
     symbols: Tuple[Symbol, ...]
-    pending: Tuple[Tuple[object, object], ...] = ()
+    pending: Tuple[Tuple[object, object, tuple], ...] = ()
 
-    def with_op(self, key, builder) -> "PageStream":
+    def with_op(self, key, builder, params=()) -> "PageStream":
         return PageStream(self.pages, self.symbols,
-                          self.pending + ((key, builder),))
+                          self.pending + ((key, builder, tuple(params)),))
 
     def iter_pages(self) -> Iterator[Page]:
         fn = compose_chain(self.pending)
@@ -115,28 +121,43 @@ class PageStream:
 
 
 def chain_keys(pending) -> Tuple:
-    return tuple(k for k, _ in pending)
+    return tuple(e[0] for e in pending)
+
+
+def chain_params(pending) -> Tuple:
+    """Per-op runtime literal tuples, positionally aligned with
+    chain_keys — the traced argument the composed kernel receives."""
+    return tuple(tuple(e[2]) for e in pending)
 
 
 def compose_chain(pending, tail_key=None, tail_builder=None):
     """One cached jitted kernel running every pending transform (+ optional
-    tail op, e.g. a partial aggregation) in a single device program."""
+    tail op, e.g. a partial aggregation) in a single device program. The
+    cache key holds only canonical (literal-free) op keys; hoisted literal
+    values are passed per call, so `fn(page)` for a new literal variant of
+    a warm chain dispatches the existing executable."""
     if not pending and tail_builder is None:
         return None
     key = ("chain",) + chain_keys(pending) + \
         ((tail_key,) if tail_key is not None else ())
+    param_groups = chain_params(pending)
 
     def build():
-        fns = [b() for _, b in pending]
-        if tail_builder is not None:
-            fns.append(tail_builder())
+        fns = [e[1]() for e in pending]
+        tail = tail_builder() if tail_builder is not None else None
 
-        def run(page):
-            for f in fns:
-                page = f(page)
+        def run(page, groups):
+            for f, g in zip(fns, groups):
+                page = f(page, g)
+            if tail is not None:
+                page = tail(page)
             return page
         return run
-    return cached_kernel(key, build)
+    kernel = cached_kernel(key, build, params=param_groups)
+
+    def call(page):
+        return kernel(page, param_groups)
+    return call
 
 
 class LocalExecutionPlanner:
@@ -146,6 +167,10 @@ class LocalExecutionPlanner:
         self.metadata = metadata
         self.session = session
         self.page_capacity = int(session.get("page_capacity"))
+        # parameterized kernel compilation (expr/hoist.py): on by default;
+        # `SET SESSION hoist_literals = false` pins a misbehaving shape
+        # back to per-literal compilation for debugging
+        self._hoist_on = bool(session.get("hoist_literals"))
         # the query's QueryStatsCollector (obs/stats.py), installed by the
         # owning runner; operator-level instrumentation wraps node
         # boundaries only when collector.operator_level is on (it forces
@@ -175,6 +200,23 @@ class LocalExecutionPlanner:
         (QueryStats.spilledDataSize analog)."""
         if self.collector is not None:
             self.collector.add_spill(nbytes)
+
+    # ------------------------------------------------- literal hoisting
+
+    def _hoist(self, expr):
+        """Canonicalize one lowered expression: (literal-free tree,
+        runtime values tuple). Identity when hoisting is disabled."""
+        if expr is None or not self._hoist_on:
+            return expr, ()
+        from trino_tpu.expr.hoist import hoist_literals
+        return hoist_literals(expr)
+
+    def _hoist_seq(self, exprs):
+        """Canonicalize a projection list with one shared values tuple."""
+        if not self._hoist_on:
+            return tuple(exprs), ()
+        from trino_tpu.expr.hoist import hoist_literal_seq
+        return hoist_literal_seq(exprs)
 
     # ------------------------------------------------------------ dispatch
 
@@ -298,24 +340,26 @@ class LocalExecutionPlanner:
             return self._exec_semijoin_filter(node)
         src = self.execute(node.source)
         lay, typ = _layout(src.symbols)
-        pred = lower_expr(node.predicate, lay, typ)
+        pred, prm = self._hoist(lower_expr(node.predicate, lay, typ))
         return PageStream(
             src.pages, src.symbols,
             src.pending + ((("filter", pred),
-                            lambda: lambda p, f=compile_filter(pred):
-                            p.filter(f(p))),))
+                            lambda: lambda p, g, f=compile_filter(pred):
+                            p.filter(f(p, g)), prm),))
 
     def _exec_ProjectNode(self, node: ProjectNode) -> PageStream:
         src = self.execute(node.source)
         lay, typ = _layout(src.symbols)
-        exprs = tuple(lower_expr(e, lay, typ) for _, e in node.assignments)
+        exprs, prm = self._hoist_seq(
+            tuple(lower_expr(e, lay, typ) for _, e in node.assignments))
 
         def builder():
             fns = [compile_expression(e) for e in exprs]
-            return lambda page: Page(tuple(fn(page) for fn in fns),
-                                     page.num_rows)
+            return lambda page, g: Page(tuple(fn(page, g) for fn in fns),
+                                        page.num_rows)
         return PageStream(src.pages, tuple(s for s, _ in node.assignments),
-                          src.pending + ((("project", exprs), builder),))
+                          src.pending + ((("project", exprs), builder,
+                                          prm),))
 
     def _exec_LimitNode(self, node: LimitNode) -> PageStream:
         src = self.execute(node.source)
@@ -850,14 +894,17 @@ class LocalExecutionPlanner:
 
         # residual non-equi filter evaluated over joined layout — valid for
         # INNER only (LEFT would wrongly drop null-extended rows; planner
-        # rejects such plans)
+        # rejects such plans). Hoisted like chain predicates: the kernel
+        # keys below carry the canonical tree, the values ride per call.
         post_pred = None
+        post_params = ()
         if node.filter is not None:
             if join_kind != JoinType.INNER:
                 raise ExecutionError(
                     "non-inner join with residual filter not supported")
             lay, typ = _layout(out_symbols)
-            post_pred = lower_expr(node.filter, lay, typ)
+            post_pred, post_params = self._hoist(
+                lower_expr(node.filter, lay, typ))
 
         def join_op(cap: int, dense: bool = False):
             def build():
@@ -866,16 +913,18 @@ class LocalExecutionPlanner:
                                dense=dense, probe_out=probe_keep,
                                build_out=build_keep)
                 if post_pred is None:
-                    return lambda p, b: op(p, b)
+                    return lambda p, b, g: op(p, b)
                 post_filter = compile_filter(post_pred)
 
-                def run(p, b):
+                def run(p, b, g):
                     out, total = op(p, b)
-                    return out.filter(post_filter(out)), total
+                    return out.filter(post_filter(out, g)), total
                 return run
-            return cached_kernel(
+            kernel = cached_kernel(
                 ("join", tuple(probe_keys), tuple(build_keys), join_kind,
-                 cap, post_pred, dense, probe_keep, build_keep), build)
+                 cap, post_pred, dense, probe_keep, build_keep), build,
+                params=post_params)
+            return lambda p, b: kernel(p, b, post_params)
 
         n_probe_cols = len(probe_keep)
 
@@ -892,15 +941,17 @@ class LocalExecutionPlanner:
                 at = attach_build(n_probe_cols, build_out=build_keep)
                 fn = None if post_pred is None else compile_filter(post_pred)
 
-                def run(pre, prepared):
+                def run(pre, prepared, g):
                     out = at(pre, prepared)
                     if fn is not None:
-                        out = out.filter(fn(out))
+                        out = out.filter(fn(out, g))
                     return out
                 return run
-            attach_op = cached_kernel(
+            attach_kernel = cached_kernel(
                 ("uattach", n_probe_cols, post_pred, build_keep),
-                build_attach)
+                build_attach, params=post_params)
+            attach_op = lambda pre, prepared: attach_kernel(  # noqa: E731
+                pre, prepared, post_params)
             return probe_op, attach_op
 
         def gen():
@@ -927,7 +978,8 @@ class LocalExecutionPlanner:
                         "join_spill_threshold_bytes")):
                 yield from self._run_spilled_inner(
                     aligned, build_page, probe_keys, build_keys,
-                    post_pred, probe_keep, build_keep, join_op)
+                    post_pred, post_params, probe_keep, build_keep,
+                    join_op)
                 return
             try:
                 prepared, max_run, dense = self._prepare_with_dense(
@@ -966,7 +1018,7 @@ class LocalExecutionPlanner:
         return PageStream(gen(), out_symbols)
 
     def _run_spilled_inner(self, probe_stream, build_page,
-                           probe_keys, build_keys, post_pred,
+                           probe_keys, build_keys, post_pred, post_params,
                            probe_keep, build_keep,
                            fallback_join_op) -> Iterator[Page]:
         """Spill-mode INNER join (HashBuilderOperator spill states +
@@ -1084,7 +1136,7 @@ class LocalExecutionPlanner:
                                              probe_out=probe_out_full))
         self.memory.reserve(held_bytes, "join-spill-keys")
         post_filter = None if post_pred is None else \
-            compile_filter(post_pred)
+            compile_filter(post_pred)   # called with post_params below
         drop_extra = None
         if extra_p:
             drop_extra = tuple(range(len(probe_keep))) + tuple(
@@ -1111,7 +1163,7 @@ class LocalExecutionPlanner:
                     if drop_extra is not None:
                         out = out.select_columns(drop_extra)
                     if post_filter is not None:
-                        out = out.filter(post_filter(out))
+                        out = out.filter(post_filter(out, post_params))
                     yield out
         finally:
             self.memory.free(held_bytes, "join-spill-keys")
@@ -1398,8 +1450,9 @@ class LocalExecutionPlanner:
         build_page = self._collect(build_stream)
         jt = JoinType.SEMI if mode == "semi" else JoinType.ANTI
         rest_pred = combine(rest)
-        rest_lowered = None if rest_pred is None else \
-            lower_expr(rest_pred, probe_lay, probe_typ)
+        rest_lowered, rest_params = self._hoist(
+            None if rest_pred is None else
+            lower_expr(rest_pred, probe_lay, probe_typ))
 
         def semi_op(cap: int):
             def build():
@@ -1409,10 +1462,10 @@ class LocalExecutionPlanner:
                 fn = None if rest_lowered is None \
                     else compile_filter(rest_lowered)
 
-                def run(p, b):
+                def run(p, b, g):
                     out, total = op(p, b)
                     if fn is not None:
-                        out = out.filter(fn(out))
+                        out = out.filter(fn(out, g))
                     # surviving rows all share one match value (semi: True,
                     # anti: False); emit it so pages carry EXACTLY the
                     # node's declared outputs — downstream operators lower
@@ -1423,9 +1476,11 @@ class LocalExecutionPlanner:
                         None, T.BOOLEAN, None)
                     return Page(out.columns + (mcol,), out.num_rows), total
                 return run
-            return cached_kernel(
+            kernel = cached_kernel(
                 ("semijoin", tuple(probe_keys), tuple(build_keys), jt,
-                 cap, rest_lowered, semi.null_aware), build)
+                 cap, rest_lowered, semi.null_aware), build,
+                params=rest_params)
+            return lambda p, b: kernel(p, b, rest_params)
 
         def gen():
             bp = build_page
@@ -1758,9 +1813,9 @@ def _reorder_stream(src: PageStream, symbols: Tuple[Symbol, ...]
     return PageStream(
         src.pages, symbols,
         src.pending + ((("select", order),
-                        lambda: lambda p: Page(
+                        lambda: lambda p, g: Page(
                             tuple(p.columns[c] for c in order),
-                            p.num_rows)),))
+                            p.num_rows), ()),))
 
 
 

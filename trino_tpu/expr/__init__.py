@@ -7,5 +7,6 @@ expression; XLA fuses it with the surrounding operator kernels under jit.
 """
 
 from trino_tpu.expr.ir import (
-    Call, InputRef, Literal, RowExpression, SpecialForm, SpecialKind)
+    Call, InputRef, Literal, Param, RowExpression, SpecialForm, SpecialKind)
 from trino_tpu.expr.compiler import compile_expression, compile_filter
+from trino_tpu.expr.hoist import hoist_literal_seq, hoist_literals

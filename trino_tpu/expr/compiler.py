@@ -29,10 +29,47 @@ import numpy as np
 from trino_tpu import types as T
 from trino_tpu.expr import functions as F
 from trino_tpu.expr.ir import (
-    Call, InputRef, Literal, RowExpression, SpecialForm, SpecialKind)
+    Call, InputRef, Literal, Param, RowExpression, SpecialForm, SpecialKind)
 from trino_tpu.page import Column, Dictionary, Page
 
 _COMPARISONS = {"eq", "ne", "lt", "le", "gt", "ge"}
+
+# ---------------------------------------------------------------------------
+# Literal-hoisting whitelist (expr/hoist.py consults this table).
+#
+# Call sites below REQUIRE `isinstance(arg, Literal)` at trace time because
+# the literal's VALUE determines trace shape or feeds host-side dictionary
+# work: LIKE/regex patterns compile per-pool boolean tables, string-function
+# literals parameterize host dictionary transforms, date/format units pick
+# the kernel, list lengths size planes. Hoisting one of these into a traced
+# Param would either fail loudly (the isinstance checks) or silently bake a
+# stale table into a shared kernel — so the hoister leaves the annotated
+# argument positions (or, for "all", the entire call) untouched. Every entry
+# names the evaluator that owns the constraint, so correctness is auditable
+# next to the code that enforces it.
+#
+#   name -> frozenset of arg positions that must stay Literal, or "all"
+#   (skip the whole call — no hoisting anywhere beneath it).
+STATIC_LITERAL_ARGS = {
+    # _like: pattern + escape build a host like-table over the dictionary
+    "like": frozenset({1, 2}),
+    # _date_unit_call: the unit string selects the arithmetic at trace time
+    "date_trunc": frozenset({0}),
+    "date_diff": frozenset({0}),
+    "date_add": frozenset({0}),
+    # _format_datetime: the pattern formats the whole day domain host-side
+    "format_datetime": frozenset({1}),
+    "date_format": frozenset({1}),
+}
+# _string_transform/_string_scalar/_concat_ws (_column_and_literals): every
+# literal argument parameterizes a memoized host-side dictionary table, and
+# the column argument's subtree is evaluated inside that machinery — keep
+# the entire call static.
+for _name in ("lower", "upper", "trim", "ltrim", "rtrim", "substr",
+              "substring", "concat", "replace", "reverse", "lpad", "rpad",
+              "split_part", "regexp_replace", "regexp_extract", "concat_ws",
+              "length", "codepoint", "strpos", "regexp_like", "starts_with"):
+    STATIC_LITERAL_ARGS[_name] = "all"
 
 
 def _vand(a: Optional[jnp.ndarray], b: Optional[jnp.ndarray]):
@@ -62,15 +99,20 @@ def _lit_column(lit: Literal) -> Column:
     return Column(jnp.asarray(value, dtype=typ.dtype), None, typ, None)
 
 
-def _eval(expr: RowExpression, page: Page) -> Column:
+def _eval(expr: RowExpression, page: Page, params=()) -> Column:
     if isinstance(expr, InputRef):
         return page.columns[expr.index]
     if isinstance(expr, Literal):
         return _lit_column(expr)
+    if isinstance(expr, Param):
+        # hoisted literal: a traced 0-d scalar operand (expr/hoist.py
+        # guarantees numeric/temporal, non-null, so valid=None and no
+        # dictionary — the same Column shape _lit_column builds)
+        return Column(jnp.asarray(params[expr.index]), None, expr.type, None)
     if isinstance(expr, Call):
-        return _eval_call(expr, page)
+        return _eval_call(expr, page, params)
     if isinstance(expr, SpecialForm):
-        return _eval_special(expr, page)
+        return _eval_special(expr, page, params)
     raise TypeError(f"unknown expression node: {expr!r}")
 
 
@@ -78,33 +120,33 @@ def _string_side(args) -> bool:
     return any(T.is_string(a.type) for a in args)
 
 
-def _eval_call(expr: Call, page: Page) -> Column:
+def _eval_call(expr: Call, page: Page, params=()) -> Column:
     name = expr.name
     # --- dictionary-folded string paths -----------------------------------
     if name in _COMPARISONS and _string_side(expr.args):
-        return _string_comparison(name, expr.args, page, expr.type)
+        return _string_comparison(name, expr.args, page, expr.type, params)
     if name == "like":
-        return _like(expr, page)
+        return _like(expr, page, params)
     if name in ("lower", "upper", "trim", "ltrim", "rtrim", "substr",
                 "substring", "concat", "replace", "reverse", "lpad", "rpad",
                 "split_part", "regexp_replace", "regexp_extract",
                 "concat_ws"):
-        return _string_transform(expr, page)
+        return _string_transform(expr, page, params)
     if name in ("length", "codepoint", "strpos", "regexp_like",
                 "starts_with"):
-        return _string_scalar(expr, page)
+        return _string_scalar(expr, page, params)
     if name in ("date_trunc", "date_diff", "date_add"):
-        return _date_unit_call(expr, page)
+        return _date_unit_call(expr, page, params)
     if name == "try_cast":
-        return _try_cast(expr, page)
+        return _try_cast(expr, page, params)
     if name in ("array_ctor", "cardinality", "element_at",
                 "map_element_at", "contains"):
-        return _array_call(expr, page)
+        return _array_call(expr, page, params)
     if name in ("format_datetime", "date_format"):
-        return _format_datetime(expr, page)
+        return _format_datetime(expr, page, params)
     # --- generic null-propagating scalar ----------------------------------
     impl = F.lookup(name)
-    args = [_eval(a, page) for a in expr.args]
+    args = [_eval(a, page, params) for a in expr.args]
     values = impl(expr.type, [a.type for a in expr.args],
                   *[a.values for a in args])
     valid = None
@@ -119,7 +161,8 @@ def _literal_str(expr: RowExpression) -> Optional[str]:
     return None
 
 
-def _string_comparison(name: str, args, page: Page, out_type) -> Column:
+def _string_comparison(name: str, args, page: Page, out_type,
+                       params=()) -> Column:
     a_lit, b_lit = _literal_str(args[0]), _literal_str(args[1])
     if a_lit is not None and b_lit is not None:
         # constant fold
@@ -132,8 +175,9 @@ def _string_comparison(name: str, args, page: Page, out_type) -> Column:
         # normalize literal to the right: lit <op> col == col <flip op> lit
         flip = {"eq": "eq", "ne": "ne", "lt": "gt", "le": "ge",
                 "gt": "lt", "ge": "le"}[name]
-        return _string_comparison(flip, (args[1], args[0]), page, out_type)
-    col = _eval(args[0], page)
+        return _string_comparison(flip, (args[1], args[0]), page, out_type,
+                                  params)
+    col = _eval(args[0], page, params)
     if b_lit is not None:
         d = col.dictionary
         if d is None:
@@ -155,7 +199,7 @@ def _string_comparison(name: str, args, page: Page, out_type) -> Column:
             vals = codes >= d.lower_bound(b_lit)
         return Column(vals, col.valid, out_type, None)
     # column vs column: only valid when both sides share one dictionary
-    other = _eval(args[1], page)
+    other = _eval(args[1], page, params)
     if col.dictionary is not other.dictionary:
         raise NotImplementedError(
             "string column comparison across distinct dictionaries")
@@ -164,8 +208,8 @@ def _string_comparison(name: str, args, page: Page, out_type) -> Column:
     return Column(vals, _vand(col.valid, other.valid), out_type, None)
 
 
-def _like(expr: Call, page: Page) -> Column:
-    col = _eval(expr.args[0], page)
+def _like(expr: Call, page: Page, params=()) -> Column:
+    col = _eval(expr.args[0], page, params)
     pattern = _literal_str(expr.args[1])
     if pattern is None or col.dictionary is None:
         raise NotImplementedError("LIKE requires literal pattern + dictionary")
@@ -177,10 +221,12 @@ def _like(expr: Call, page: Page) -> Column:
     return Column(vals, col.valid, expr.type, None)
 
 
-def _column_and_literals(expr: Call, page: Page):
+def _column_and_literals(expr: Call, page: Page, params=()):
     """First non-literal arg is THE column; every other arg must be a
-    literal. Returns (column, call(s) -> py fn applied with the column's
-    string substituted at its ORIGINAL argument position, memo key)."""
+    literal (STATIC_LITERAL_ARGS marks these calls "all", so the hoister
+    never rewrites them to Params). Returns (column, call(s) -> py fn
+    applied with the column's string substituted at its ORIGINAL argument
+    position, memo key)."""
     col_i = None
     for i, a in enumerate(expr.args):
         if not isinstance(a, Literal):
@@ -190,7 +236,7 @@ def _column_and_literals(expr: Call, page: Page):
             col_i = i
     if col_i is None:
         col_i = 0   # all-literal: fold through the first arg's singleton
-    col = _eval(expr.args[col_i], page)
+    col = _eval(expr.args[col_i], page, params)
     lit_by_pos = {i: a.value for i, a in enumerate(expr.args) if i != col_i}
 
     def call(fn, s):
@@ -201,14 +247,14 @@ def _column_and_literals(expr: Call, page: Page):
     return col, call, key
 
 
-def _string_transform(expr: Call, page: Page) -> Column:
+def _string_transform(expr: Call, page: Page, params=()) -> Column:
     """str->str functions as dictionary remap (host transform, device
     gather). NULL-producing transforms (split_part past the last field,
     regexp_extract without a match) carry a per-pool-value ok-table."""
     name = expr.name
     if name == "concat_ws":
-        return _concat_ws(expr, page)
-    col, call, akey = _column_and_literals(expr, page)
+        return _concat_ws(expr, page, params)
+    col, call, akey = _column_and_literals(expr, page, params)
     if col.dictionary is None:
         raise NotImplementedError(f"{name} requires dictionary-encoded input")
     py = _PY_STRING_FNS[name]
@@ -226,7 +272,7 @@ def _string_transform(expr: Call, page: Page) -> Column:
     return Column(codes, col.valid, expr.type, nd)
 
 
-def _concat_ws(expr: Call, page: Page) -> Column:
+def _concat_ws(expr: Call, page: Page, params=()) -> Column:
     """concat_ws(sep, v1, v2, ...): Trino skips NULL value arguments and
     returns NULL only for a NULL separator (StringFunctions.java concatWs)
     — unlike the generic AND-of-valid-masks path."""
@@ -251,7 +297,7 @@ def _concat_ws(expr: Call, page: Page) -> Column:
         joined = sep.join(str(v) for v in lits.values() if v is not None)
         d = Dictionary(np.asarray([joined], dtype=object))
         return Column(jnp.zeros((), dtype=jnp.int32), None, expr.type, d)
-    col = _eval(expr.args[col_i], page)
+    col = _eval(expr.args[col_i], page, params)
     if col.dictionary is None:
         raise NotImplementedError("concat_ws requires dictionary input")
 
@@ -286,11 +332,11 @@ _STRING_SCALAR_FNS = {
 }
 
 
-def _string_scalar(expr: Call, page: Page) -> Column:
+def _string_scalar(expr: Call, page: Page, params=()) -> Column:
     """str -> number/bool functions as a memoized per-pool host table +
     device gather (the joni/re2j per-row regex replacement)."""
     name = expr.name
-    col, call, akey = _column_and_literals(expr, page)
+    col, call, akey = _column_and_literals(expr, page, params)
     if col.dictionary is None:
         raise NotImplementedError(f"{name} requires dictionary-encoded input")
     fn, dtype = _STRING_SCALAR_FNS[name]
@@ -306,7 +352,7 @@ _DATE_UNITS_TS = {"second": 1_000_000, "minute": 60_000_000,
                   "millisecond": 1_000}
 
 
-def _date_unit_call(expr: Call, page: Page) -> Column:
+def _date_unit_call(expr: Call, page: Page, params=()) -> Column:
     """date_trunc / date_diff / date_add with a literal unit
     (DateTimeFunctions.java parity for DATE; micros arithmetic for the
     sub-day TIMESTAMP units)."""
@@ -314,7 +360,7 @@ def _date_unit_call(expr: Call, page: Page) -> Column:
     if not isinstance(unit_arg, Literal):
         raise NotImplementedError(f"{expr.name} unit must be a literal")
     unit = str(unit_arg.value).lower()
-    rest = [_eval(a, page) for a in expr.args[1:]]
+    rest = [_eval(a, page, params) for a in expr.args[1:]]
     valid = None
     for a in rest:
         valid = _vand(valid, a.valid)
@@ -362,14 +408,14 @@ def _date_unit_call(expr: Call, page: Page) -> Column:
     return Column(vals, valid, expr.type, None)
 
 
-def _try_cast(expr: Call, page: Page) -> Column:
+def _try_cast(expr: Call, page: Page, params=()) -> Column:
     """TRY_CAST: NULL instead of failure. Non-string sources delegate to
     the saturating cast kernel (which cannot raise per-row); varchar
     sources parse the dictionary pool host-side into a value table + an
     ok-mask table."""
     target = expr.type
     src_t = expr.args[0].type
-    col = _eval(expr.args[0], page)
+    col = _eval(expr.args[0], page, params)
     if not T.is_string(src_t):
         values = F.lookup("cast")(target, [src_t], col.values)
         ok = _numeric_cast_ok(col.values, src_t, target)
@@ -503,7 +549,7 @@ def _mysql_to_strftime(pattern: str) -> str:
     return "".join(out)
 
 
-def _format_datetime(expr: Call, page: Page) -> Column:
+def _format_datetime(expr: Call, page: Page, params=()) -> Column:
     """format_datetime/date_format with a literal pattern over DATE (and
     day-resolution TIMESTAMP) columns: the whole 1900-2100 day domain
     formats ONCE into a memoized dictionary + code table, so the device
@@ -513,7 +559,7 @@ def _format_datetime(expr: Call, page: Page) -> Column:
     pat = expr.args[1]
     if not isinstance(pat, Literal):
         raise NotImplementedError(f"{expr.name} pattern must be a literal")
-    col = _eval(expr.args[0], page)
+    col = _eval(expr.args[0], page, params)
     src_t = expr.args[0].type
     values = col.values
     if isinstance(src_t, T.TimestampType):
@@ -549,14 +595,14 @@ def _format_datetime(expr: Call, page: Page) -> Column:
     return Column(codes.astype(jnp.int32), col.valid, expr.type, d)
 
 
-def _array_call(expr: Call, page: Page) -> Column:
+def _array_call(expr: Call, page: Page, params=()) -> Column:
     """ARRAY scalar surface over the list layout (values [cap, L] +
     lengths; spi/block/ArrayBlock re-cut for static shapes). Element
     NULLs are not represented (documented deviation)."""
     name = expr.name
     cap = page.capacity
     if name == "array_ctor":
-        args = [_broadcast(_eval(a, page), cap) for a in expr.args]
+        args = [_broadcast(_eval(a, page, params), cap) for a in expr.args]
         dicts = [a.dictionary for a in args if a.dictionary is not None]
         dictionary = None
         if dicts:
@@ -583,7 +629,7 @@ def _array_call(expr: Call, page: Page) -> Column:
             valid = _vand(valid, a.valid)
         return Column(values, valid, expr.type, dictionary,
                       lengths=lengths)
-    arr = _eval(expr.args[0], page)
+    arr = _eval(expr.args[0], page, params)
     if arr.lengths is None:
         raise NotImplementedError(f"{name} over a non-list column")
     L = arr.values.shape[1]
@@ -593,7 +639,7 @@ def _array_call(expr: Call, page: Page) -> Column:
         return Column(arr.lengths.astype(jnp.int64), arr.valid,
                       expr.type, None)
     if name == "element_at":
-        i = _broadcast(_eval(expr.args[1], page), cap)
+        i = _broadcast(_eval(expr.args[1], page, params), cap)
         iv = i.values.astype(jnp.int32)
         idx = jnp.where(iv < 0, arr.lengths + iv, iv - 1)
         inb = (iv != 0) & (idx >= 0) & (idx < arr.lengths)
@@ -603,7 +649,7 @@ def _array_call(expr: Call, page: Page) -> Column:
         valid = _vand(_vand(arr.valid, i.valid), inb)
         return Column(vals, valid, expr.type, arr.dictionary)
     if name in ("contains", "map_element_at"):
-        x = _broadcast(_eval(expr.args[1], page), cap)
+        x = _broadcast(_eval(expr.args[1], page, params), cap)
         xv = x.values
         if arr.dictionary is not None:
             if x.dictionary is arr.dictionary:
@@ -737,24 +783,26 @@ _PY_STRING_FNS = {
 _NULLABLE_STRING_FNS = {"split_part", "regexp_extract"}
 
 
-def _eval_special(expr: SpecialForm, page: Page) -> Column:
+def _eval_special(expr: SpecialForm, page: Page, params=()) -> Column:
     kind = expr.kind
     if kind is SpecialKind.AND:
-        return _kleene_and([_eval(a, page) for a in expr.args], expr.type)
+        return _kleene_and([_eval(a, page, params) for a in expr.args],
+                           expr.type)
     if kind is SpecialKind.OR:
-        return _kleene_or([_eval(a, page) for a in expr.args], expr.type)
+        return _kleene_or([_eval(a, page, params) for a in expr.args],
+                          expr.type)
     if kind is SpecialKind.NOT:
-        a = _eval(expr.args[0], page)
+        a = _eval(expr.args[0], page, params)
         return Column(~a.values, a.valid, expr.type, None)
     if kind is SpecialKind.IS_NULL:
-        a = _eval(expr.args[0], page)
+        a = _eval(expr.args[0], page, params)
         if a.valid is None:
             vals = jnp.zeros(jnp.shape(a.values), dtype=jnp.bool_)
         else:
             vals = ~a.valid
         return Column(vals, None, expr.type, None)
     if kind is SpecialKind.COALESCE:
-        args = [_eval(a, page) for a in expr.args]
+        args = [_eval(a, page, params) for a in expr.args]
         dicts = {id(a.dictionary) for a in args if a.dictionary is not None}
         if len(dicts) > 1:
             raise NotImplementedError("COALESCE over distinct dictionaries")
@@ -770,29 +818,30 @@ def _eval_special(expr: SpecialForm, page: Page) -> Column:
             out = Column(values, valid, expr.type, dictionary)
         return out
     if kind is SpecialKind.IF:
-        return _if_merge(_eval(expr.args[0], page),
-                         _eval(expr.args[1], page),
-                         _eval(expr.args[2], page), expr.type)
+        return _if_merge(_eval(expr.args[0], page, params),
+                         _eval(expr.args[1], page, params),
+                         _eval(expr.args[2], page, params), expr.type)
     if kind is SpecialKind.SWITCH:
         # [c1, v1, c2, v2, ..., default] — fold right into nested IFs so CASE
         # shares IF's null/dictionary semantics exactly
         args = list(expr.args)
-        out = _eval(args[-1], page)
+        out = _eval(args[-1], page, params)
         pairs = list(zip(args[:-1:2], args[1:-1:2]))
         for cond_e, val_e in reversed(pairs):
-            out = _if_merge(_eval(cond_e, page), _eval(val_e, page), out,
+            out = _if_merge(_eval(cond_e, page, params),
+                            _eval(val_e, page, params), out,
                             expr.type)
         return out
     if kind is SpecialKind.IN:
         needle = expr.args[0]
         eqs = [Call("eq", (needle, v), T.BOOLEAN) for v in expr.args[1:]]
-        return _kleene_or([_eval(e, page) for e in eqs], expr.type)
+        return _kleene_or([_eval(e, page, params) for e in eqs], expr.type)
     if kind is SpecialKind.BETWEEN:
         value, low, high = expr.args
         conj = SpecialForm(SpecialKind.AND, (
             Call("ge", (value, low), T.BOOLEAN),
             Call("le", (value, high), T.BOOLEAN)), T.BOOLEAN)
-        return _eval(conj, page)
+        return _eval(conj, page, params)
     raise TypeError(f"unknown special form: {kind}")
 
 
@@ -873,20 +922,23 @@ def _broadcast(col: Column, capacity: int) -> Column:
     return col
 
 
-def compile_expression(expr: RowExpression) -> Callable[[Page], Column]:
-    """Build fn(page) -> Column of per-row results (project channel)."""
+def compile_expression(expr: RowExpression) -> Callable[..., Column]:
+    """Build fn(page, params=()) -> Column of per-row results (project
+    channel). `params` is the positional scalar tuple Param leaves index
+    into — () for unhoisted trees."""
 
-    def fn(page: Page) -> Column:
-        return _broadcast(_eval(expr, page), page.capacity)
+    def fn(page: Page, params=()) -> Column:
+        return _broadcast(_eval(expr, page, params), page.capacity)
 
     return fn
 
 
-def compile_filter(expr: RowExpression) -> Callable[[Page], jnp.ndarray]:
-    """Build fn(page) -> bool mask; SQL WHERE: null counts as false."""
+def compile_filter(expr: RowExpression) -> Callable[..., jnp.ndarray]:
+    """Build fn(page, params=()) -> bool mask; SQL WHERE: null counts as
+    false."""
 
-    def fn(page: Page) -> jnp.ndarray:
-        col = _broadcast(_eval(expr, page), page.capacity)
+    def fn(page: Page, params=()) -> jnp.ndarray:
+        col = _broadcast(_eval(expr, page, params), page.capacity)
         mask = col.values
         if col.valid is not None:
             mask = mask & col.valid

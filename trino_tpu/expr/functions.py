@@ -293,14 +293,18 @@ def _round_digits(out_type, arg_types, a, d):
         rounded = jnp.where(a >= 0, mag, -mag).astype(jnp.int64)
         return jnp.where(keep >= scale, a, rounded)
     if jnp.issubdtype(jnp.result_type(a), jnp.integer):
-        if d >= 0:
-            return a
         # Trino round(123, -1) = 120, half away from zero in integer space;
-        # divide magnitudes so // (floor) acts as truncation toward zero
-        p = 10 ** (-d)
+        # divide magnitudes so // (floor) acts as truncation toward zero.
+        # Stay in jnp throughout: d arrives as a traced scalar (hoisted
+        # literal, or a plain constant under the chain kernel's trace), so
+        # Python `if d >= 0` control flow would fail at trace time
+        keep = jnp.asarray(d).astype(jnp.int64)
+        p = jnp.power(jnp.int64(10),
+                      jnp.clip(-keep, 0, 17)).astype(jnp.int64)
         half = p // 2
         mag = (jnp.abs(a) + half) // p * p
-        return jnp.where(a >= 0, mag, -mag).astype(a.dtype)
+        rounded = jnp.where(a >= 0, mag, -mag).astype(a.dtype)
+        return jnp.where(keep >= 0, a, rounded)
     f = 10.0 ** d
     scaled = a * f
     return jnp.where(scaled >= 0, jnp.floor(scaled + 0.5),

@@ -1,0 +1,114 @@
+"""Literal hoisting: canonicalize lowered expressions for kernel sharing.
+
+Reference parity: sql/gen/PageFunctionCompiler.java:101 — the reference
+rewrites constants out of the expression tree before keying its generated
+bytecode cache, so `l_quantity < 24` and `l_quantity < 25` share one
+compiled PageProcessor and the constant arrives through a session slot.
+Here the unit of compilation is an XLA executable, and on TPU compilation
+dominates cold latency — so the same move matters more: this pass rewrites
+trace-shape-irrelevant Literals into positional `Param` leaves, the
+jit-cache key becomes the literal-free canonical tree (+ parameter dtypes,
+carried by the Param nodes themselves), and the values flow into the
+jitted kernel as a runtime scalar tuple (traced operands, not baked
+constants). Second-and-later literal variants of a query shape then run
+with ZERO XLA compiles.
+
+What hoists: non-null numeric, decimal (scaled-int), date, timestamp, and
+interval literals — comparison/arithmetic constants, IN-list members,
+BETWEEN bounds, CASE outputs.
+
+What stays static (and why, per call site): see
+expr/compiler.py STATIC_LITERAL_ARGS — LIKE/regex patterns and every
+string-function literal feed host-side per-dictionary tables; date/format
+unit strings select the kernel at trace time. Globally static here:
+string literals (comparisons fold against the column's dictionary codes
+at trace time), NULL literals (validity structure differs), and booleans
+(worthless to parameterize, often trace-shaping). Plan-level counts
+(LIMIT/TopN, GROUPING set indices, window frame offsets) never pass
+through this pass at all — they are operator-spec fields, not expression
+leaves, and they size capacities or planes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.expr.ir import (Call, Literal, Param, RowExpression,
+                               SpecialForm)
+
+
+def hoistable(lit: Literal) -> bool:
+    """True when this literal's value can become a traced scalar operand
+    without changing the trace: non-null, non-string (dictionary folds are
+    host-side), non-boolean."""
+    if lit.value is None:
+        return False
+    t = lit.type
+    if T.is_string(t):
+        return False
+    if isinstance(t, T.BooleanType):
+        return False
+    return True
+
+
+def param_value(lit: Literal) -> np.ndarray:
+    """The runtime scalar for a hoisted literal: a 0-d numpy array of the
+    type's device dtype, mirroring expr/compiler._lit_column exactly so
+    the parameterized trace is operand-for-operand identical to the
+    constant-embedding one. An explicit dtype (never a weak Python
+    scalar) keeps jit's trace cache keyed stably across variants."""
+    value = lit.value
+    if isinstance(lit.type, T.DecimalType):
+        value = int(value)   # scaled-int, same as _lit_column
+    return np.asarray(value, dtype=lit.type.dtype)
+
+
+def hoist_literals(expr: RowExpression
+                   ) -> Tuple[RowExpression, Tuple[np.ndarray, ...]]:
+    """Canonicalize one lowered expression: (literal-free tree, values).
+
+    Param indices are assigned in depth-first visitation order, so the
+    canonical tree of any two literal variants of one shape is identical
+    and their values tuples align positionally.
+    """
+    values: List[np.ndarray] = []
+    out = _walk(expr, values)
+    return out, tuple(values)
+
+
+def hoist_literal_seq(exprs: Sequence[RowExpression]
+                      ) -> Tuple[Tuple[RowExpression, ...],
+                                 Tuple[np.ndarray, ...]]:
+    """Canonicalize a projection list with ONE shared params tuple:
+    indices run on across expressions, so the whole operator passes a
+    single values tuple to its compiled kernel."""
+    values: List[np.ndarray] = []
+    outs = tuple(_walk(e, values) for e in exprs)
+    return outs, tuple(values)
+
+
+def _walk(e: RowExpression, values: List[np.ndarray]) -> RowExpression:
+    from trino_tpu.expr.compiler import STATIC_LITERAL_ARGS
+    if isinstance(e, Literal):
+        if not hoistable(e):
+            return e
+        values.append(param_value(e))
+        return Param(len(values) - 1, e.type)
+    if isinstance(e, Call):
+        static = STATIC_LITERAL_ARGS.get(e.name)
+        if static == "all":
+            # the whole call (column subtree included) evaluates inside
+            # host-side dictionary machinery that requires Literal args —
+            # leave it byte-identical
+            return e
+        args = tuple(a if (static is not None and i in static)
+                     else _walk(a, values)
+                     for i, a in enumerate(e.args))
+        return Call(e.name, args, e.type)
+    if isinstance(e, SpecialForm):
+        return SpecialForm(e.kind,
+                           tuple(_walk(a, values) for a in e.args), e.type)
+    return e   # InputRef / SymbolRef / already-canonical Param

@@ -60,6 +60,26 @@ class Literal(RowExpression):
 
 
 @dataclasses.dataclass(frozen=True)
+class Param(RowExpression):
+    """Positional runtime parameter slot (parameterized kernel compilation).
+
+    Produced by expr/hoist.py: trace-shape-irrelevant Literals in lowered
+    expressions are rewritten to Param leaves so the jit-cache key — the
+    canonical literal-free tree — is shared by every literal variant of a
+    query shape. The value arrives at kernel call time as element `index`
+    of the op's params tuple (a traced 0-d scalar of `type.dtype`), so
+    `l_quantity < 24` and `l_quantity < 25` run one XLA executable.
+    Reference parity: PageFunctionCompiler.java rewriting constants out of
+    the expression tree before keying its bytecode cache."""
+
+    index: int
+    type: T.Type
+
+    def __str__(self):
+        return f"?{self.index}"
+
+
+@dataclasses.dataclass(frozen=True)
 class Call(RowExpression):
     """Scalar function call resolved to a registry name, e.g. 'add:bigint'."""
 

@@ -72,6 +72,13 @@ SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     # pool bytes before failing retryable (CLUSTER_OUT_OF_MEMORY).
     "resource_group": "global",
     "cluster_memory_wait_ms": 2000,
+    # parameterized kernel compilation (expr/hoist.py): hoist numeric/
+    # date/decimal literals out of lowered expressions into runtime
+    # parameter slots so literal variants of one query shape share a
+    # single XLA executable (jit-cache key = canonical literal-free
+    # tree). Default on; set false to pin a misbehaving shape back to
+    # per-literal compilation for debugging.
+    "hoist_literals": True,
     # observability (obs/stats.py): per-operator stats collection for
     # EVERY query on the session (EXPLAIN ANALYZE forces it regardless).
     # Off by default: instrumenting node boundaries splits fused kernel

@@ -295,6 +295,15 @@ def _engine_gauges():
     yield ("trino_tpu_jit_cache_misses",
            "Jit cache misses (kernel builds) since process start.",
            js["misses"], {})
+    yield ("trino_tpu_jit_cache_param_hits",
+           "Hits on a canonical (literal-hoisted) key whose parameter "
+           "values changed since that key's previous call — kernel "
+           "sharing per-literal keying could not have expressed.",
+           js["param_hits"], {})
+    yield ("trino_tpu_jit_cache_evictions_total",
+           "Kernels evicted from the in-process LRU since process start "
+           "(evicted shapes reload from the persistent XLA cache).",
+           js["evictions"], {})
 
 
 REGISTRY.register_gauges(_engine_gauges)

@@ -68,6 +68,10 @@ class QueryStatsCollector:
         self.spilled_bytes = 0
         self.jit_hits = 0
         self.jit_misses = 0
+        # hits on a canonical key whose literal parameter values differ
+        # from that key's previous call — kernel sharing that per-literal
+        # keying could not have expressed
+        self.jit_param_hits = 0
         self.retries = 0
         self.faults_injected = 0
 
@@ -129,6 +133,9 @@ class QueryStatsCollector:
     def jit_miss(self, key=None) -> None:
         self.jit_misses += 1
 
+    def jit_param_hit(self, key=None) -> None:
+        self.jit_param_hits += 1
+
     # -------------------------------------------------------- finish
 
     def finish(self) -> None:
@@ -168,6 +175,7 @@ class QueryStatsCollector:
             "spilled_bytes": self.spilled_bytes,
             "jit_hits": self.jit_hits,
             "jit_misses": self.jit_misses,
+            "jit_param_hits": self.jit_param_hits,
             "retries": self.retries,
             "faults_injected": self.faults_injected,
         }
@@ -239,7 +247,8 @@ def render_analyzed_plan(plan, collector: QueryStatsCollector,
              f"wall {total_wall_s * 1000:.2f}ms ({label}), "
              f"planning {collector.planning_s * 1000:.2f}ms, "
              f"jit {collector.jit_hits} hits / "
-             f"{collector.jit_misses} misses")
+             f"{collector.jit_misses} misses / "
+             f"{collector.jit_param_hits} param hits")
     if collector.spilled_bytes:
         text += f", spilled {_fmt_bytes(collector.spilled_bytes)}"
     return text

@@ -5,6 +5,11 @@ FilterAndProjectOperator.java with their compiled PageProcessor
 (operator/project/PageProcessor.java). Here: compile_filter/compile_expression
 produce traced jnp, and XLA fuses predicate, compaction, and projections into
 one kernel under the fragment's jit.
+
+Parameterized compilation: expressions may carry `Param` leaves
+(expr/hoist.py) indexing one shared runtime values tuple for the whole
+fused op — hoist the filter and projections together with
+hoist_literal_seq so their indices align, then pass that tuple per call.
 """
 
 from __future__ import annotations
@@ -19,15 +24,19 @@ from trino_tpu.page import Page
 def filter_project(
     filter_expr: Optional[RowExpression],
     projections: Sequence[RowExpression],
-) -> Callable[[Page], Page]:
-    """Build op: keep rows passing filter_expr, emit one column per projection."""
+    params: tuple = (),
+) -> Callable[..., Page]:
+    """Build op: keep rows passing filter_expr, emit one column per
+    projection. `params` is the default hoisted-literal tuple; callers
+    running literal variants of the same compiled op pass theirs per
+    call: op(page, variant_params)."""
     filter_fn = compile_filter(filter_expr) if filter_expr is not None else None
     project_fns = [compile_expression(p) for p in projections]
 
-    def op(page: Page) -> Page:
+    def op(page: Page, call_params: tuple = params) -> Page:
         if filter_fn is not None:
-            page = page.filter(filter_fn(page))
-        cols = tuple(fn(page) for fn in project_fns)
+            page = page.filter(filter_fn(page, call_params))
+        cols = tuple(fn(page, call_params) for fn in project_fns)
         return Page(cols, page.num_rows)
 
     return op

@@ -95,8 +95,22 @@ class TrinoServer:
                  query_timeout_s: Optional[float] = None,
                  max_running: int = 4,
                  resource_groups: Optional[ResourceGroupManager] = None,
-                 resource_groups_path: Optional[str] = None):
+                 resource_groups_path: Optional[str] = None,
+                 compilation_cache_dir: Optional[str] = None):
         self.runner = runner
+        # cross-process compile reuse: point XLA's on-disk cache at the
+        # given directory (or $TRINO_TPU_COMPILATION_CACHE_DIR) so a cold
+        # server start reloads compiled executables instead of recompiling
+        # — with literal hoisting the cached programs are literal-free, so
+        # the disk entries cover every parameter variant of a shape. The
+        # in-process jit-cache LRU (exec/jit_cache.py) layers above this.
+        import os as _os
+        if compilation_cache_dir is None:
+            compilation_cache_dir = _os.environ.get(
+                "TRINO_TPU_COMPILATION_CACHE_DIR")
+        if compilation_cache_dir:
+            import trino_tpu
+            trino_tpu.enable_persistent_cache(compilation_cache_dir)
         self.keep = keep
         self.query_timeout_s = query_timeout_s
         self.max_running = max(1, int(max_running))
