@@ -97,6 +97,32 @@ ORDER BY revenue DESC, o_orderdate LIMIT 10
 
 Q3_VARIANT = Q3.replace("DATE '1995-03-15'", "DATE '1995-03-08'")
 
+# prepared-statement probes (round 10): the measured query PREPAREd with
+# its hoistable constants as `?` markers, EXECUTEd twice with different
+# USING values. The second EXECUTE is the statement-reuse fast path —
+# plan cache hit + parameter binding into warm kernels — measured against
+# re-submitting the identical query as plain SQL (which re-plans).
+# (name, prepare_sql, warm USING, perturbed USING, plain-SQL resubmit)
+PREPARED = {
+    "tpch_q6_sf1": (
+        "bench_q6",
+        Q6.replace("DATE '1994-01-01'", "?")
+          .replace("0.06", "?").replace("l_quantity < 24",
+                                        "l_quantity < ?"),
+        "DATE '1994-01-01', DATE '1994-01-01', 0.06, 0.06, 24",
+        "DATE '1995-01-01', DATE '1995-01-01', 0.07, 0.07, 25",
+        Q6_VARIANT),
+    "tpch_q1_sf1": (
+        "bench_q1",
+        Q1.replace("INTERVAL '90' DAY", "?"),
+        "INTERVAL '90' DAY", "INTERVAL '60' DAY", Q1_VARIANT),
+    "tpch_q3_sf10": (
+        "bench_q3",
+        Q3.replace("DATE '1995-03-15'", "?"),
+        "DATE '1995-03-15', DATE '1995-03-15'",
+        "DATE '1995-03-08', DATE '1995-03-08'", Q3_VARIANT),
+}
+
 JOIN_MICRO = """
 SELECT count(*) FROM lineitem, orders WHERE l_orderkey = o_orderkey
 """
@@ -235,7 +261,11 @@ def run_rung(tag: str) -> None:
                               runner.stats["faults_injected"],
                           "breakdown": breakdown}),
               flush=True)
-    except Exception as e:  # noqa: BLE001 — the rung must report, not die
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the rung must report,
+        # not die: even a SystemExit from backend init becomes a parsed
+        # error line (the parent merges it as {tag}_error)
         print(json.dumps(
             {"error": f"{type(e).__name__}: {str(e)[:160]}"}), flush=True)
 
@@ -286,7 +316,8 @@ def _run_rung_subprocess(extra: dict, tag: str, base: float) -> None:
         extra[f"{tag}_error"] = f"rung result parse: {type(e).__name__}: {e}"
 
 
-def _time_query(runner, sql, iters=3, breakdown=None, variant=None):
+def _time_query(runner, sql, iters=3, breakdown=None, variant=None,
+                prepared=None):
     t0 = time.perf_counter()
     rows = runner.execute(sql).rows  # warm-up (compile) run, untimed
     cold = time.perf_counter() - t0
@@ -302,7 +333,47 @@ def _time_query(runner, sql, iters=3, breakdown=None, variant=None):
         breakdown.update(_breakdown(runner, cold, warm, cold_stats))
         if variant is not None:
             breakdown.update(_literal_variant(runner, variant))
+        if prepared is not None:
+            breakdown.update(_prepared_variant(runner, prepared))
     return warm
+
+
+def _prepared_variant(runner, spec):
+    """The statement-reuse proof: EXECUTE with perturbed USING values
+    (cached plan + warm kernels — what the second-and-later dashboard
+    query pays) vs re-submitting the identical statement as plain SQL
+    (full parse->plan->optimize per run). prepared_plan_cache_hits >= 1
+    and prepared_jit_misses == 0 mean the fast path engaged."""
+    name, prepare_sql, warm_using, perturbed_using, resubmit_sql = spec
+    try:
+        runner.execute(f"PREPARE {name} FROM {prepare_sql}")
+        runner.execute(f"EXECUTE {name} USING {warm_using}")
+        t0 = time.perf_counter()
+        runner.execute(f"EXECUTE {name} USING {perturbed_using}")
+        execute_wall = time.perf_counter() - t0
+        stats = runner.last_query_stats
+        # resubmit baseline: plan cache OFF, else the earlier variant run
+        # already cached this exact statement's plan and the "full
+        # re-plan" baseline would itself be a cache hit
+        runner.session.properties["plan_cache_enabled"] = False
+        try:
+            t0 = time.perf_counter()
+            runner.execute(resubmit_sql)
+            resubmit_wall = time.perf_counter() - t0
+        finally:
+            runner.session.properties.pop("plan_cache_enabled", None)
+        return {
+            "prepared_execute_wall_s": round(execute_wall, 4),
+            "prepared_resubmit_wall_s": round(resubmit_wall, 4),
+            "prepared_plan_cache_hits":
+                int(stats.get("plan_cache_hits", 0)),
+            "prepared_jit_misses": int(stats.get("jit_misses", 0)),
+            "prepared_jit_param_hits":
+                int(stats.get("jit_param_hits", 0)),
+        }
+    except Exception as e:  # noqa: BLE001 — a probe failure costs a key,
+        return {"prepared_error":            # not the rung
+                f"{type(e).__name__}: {str(e)[:120]}"}
 
 
 def _literal_variant(runner, variant_sql):
@@ -329,6 +400,7 @@ def _stats_breakdown(stats):
         "execution_s": round(stats.get("execution_s", 0.0), 4),
         "jit_misses": int(stats.get("jit_misses", 0)),
         "jit_param_hits": int(stats.get("jit_param_hits", 0)),
+        "plan_cache_hits": int(stats.get("plan_cache_hits", 0)),
         "output_rows": int(stats.get("output_rows", 0)),
         "output_bytes": int(stats.get("output_bytes", 0)),
         "spilled_bytes": int(stats.get("spilled_bytes", 0)),
@@ -368,8 +440,10 @@ def main():
 
         sf1 = LocalQueryRunner.tpch("sf1")
         bd6, bd1, bd3 = {}, {}, {}
-        q6 = _time_query(sf1, Q6, breakdown=bd6, variant=Q6_VARIANT)
-        q1 = _time_query(sf1, Q1, breakdown=bd1, variant=Q1_VARIANT)
+        q6 = _time_query(sf1, Q6, breakdown=bd6, variant=Q6_VARIANT,
+                         prepared=PREPARED["tpch_q6_sf1"])
+        q1 = _time_query(sf1, Q1, breakdown=bd1, variant=Q1_VARIANT,
+                         prepared=PREPARED["tpch_q1_sf1"])
         extra["tpch_q6_sf1_breakdown"] = bd6
         extra["tpch_q1_sf1_wall_s"] = round(q1, 4)
         extra["tpch_q1_sf1_vs_baseline"] = round(BASE_Q1_SF1_S / q1, 3)
@@ -384,7 +458,8 @@ def main():
         sf1.session.properties.pop("collect_operator_stats", None)
 
         sf10 = LocalQueryRunner.tpch("sf10")
-        q3 = _time_query(sf10, Q3, breakdown=bd3, variant=Q3_VARIANT)
+        q3 = _time_query(sf10, Q3, breakdown=bd3, variant=Q3_VARIANT,
+                         prepared=PREPARED["tpch_q3_sf10"])
         extra["tpch_q3_sf10_wall_s"] = round(q3, 4)
         extra["tpch_q3_sf10_vs_baseline"] = round(BASE_Q3_SF10_S / q3, 3)
         extra["tpch_q3_sf10_breakdown"] = bd3
@@ -407,12 +482,17 @@ def main():
         extra["retries"] = sf1.stats["retries"] + sf10.stats["retries"]
         extra["faults_injected"] = (sf1.stats["faults_injected"]
                                     + sf10.stats["faults_injected"])
-    except (KeyboardInterrupt, SystemExit) as e:
+    except KeyboardInterrupt as e:
         # still emit the JSON line, but PROPAGATE: an interrupted bench
         # must not exit rc=0 looking green to a gating harness
         error = f"{type(e).__name__}: {str(e)[:300]}"
         interrupted = e
-    except Exception as e:  # noqa: BLE001 — the JSON line must print
+    except BaseException as e:  # noqa: BLE001 — the JSON line must print
+        # BaseException, not Exception: a backend-init failure that
+        # raises SystemExit (or any exotic non-Exception) used to leave
+        # rc=1 with nothing parseable — a silent hole in the perf
+        # trajectory. The error rides in the JSON line and the process
+        # exits 0; the harness reads `error`, not the return code.
         error = f"{type(e).__name__}: {str(e)[:300]}"
         interrupted = None
     else:
@@ -431,6 +511,8 @@ def main():
     print(json.dumps(payload), flush=True)
     if interrupted is not None:
         raise interrupted
+    if error is not None:
+        sys.exit(0)   # explicit: the JSON line IS the report
 
 
 if __name__ == "__main__":

@@ -1,0 +1,628 @@
+"""Prepared-statement fast path: plan cache + EXECUTE ... USING binding.
+
+The contract under test (Trino PREPARE/EXECUTE protocol + the statement
+reuse layer, round 9): a prepared Query plans ONCE with value-free
+parameter slots; every EXECUTE ... USING re-execution — any values, same
+types — hits the plan cache (zero planning) and binds its values into
+the SAME warm kernels literal hoisting compiled (zero XLA compiles),
+while staying row-identical to the literal-substituted statement the
+sqlite oracle verifies. Padded IN-list kernels extend the sharing to
+membership lists: every list length within a power-of-two pad bucket
+dispatches one executable.
+"""
+
+import json
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.exec import LocalQueryRunner, jit_cache
+from trino_tpu.exec import plan_cache as pc
+from trino_tpu.expr.functions import days_from_civil
+from trino_tpu.sql.analyzer import SemanticError
+
+from oracle import assert_same, load_tpch_sqlite
+
+SF = 0.01
+
+
+def d(text: str) -> int:
+    y, m, dd = text.split("-")
+    return days_from_civil(int(y), int(m), int(dd))
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch("tiny")
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    conn = load_tpch_sqlite(SF)
+    yield conn
+    conn.close()
+
+
+Q6_PREPARED = """
+SELECT sum(l_extendedprice * l_discount) AS revenue
+FROM lineitem
+WHERE l_shipdate >= ? AND l_shipdate < ? + INTERVAL '1' YEAR
+  AND l_discount BETWEEN ? - 0.01 AND ? + 0.01
+  AND l_quantity < ?
+"""
+
+
+def _oracle_q6(oracle, year: int, disc_lo: int, disc_hi: int, qty: int):
+    return oracle.execute(f"""
+        SELECT sum(l_extendedprice * l_discount) FROM lineitem
+        WHERE l_shipdate >= {d(f'{year}-01-01')}
+          AND l_shipdate < {d(f'{year + 1}-01-01')}
+          AND l_discount BETWEEN {disc_lo} AND {disc_hi}
+          AND l_quantity < {qty * 100}
+        """).fetchall()
+
+
+# ----------------------------------------------------- EXECUTE ... USING
+
+
+def test_execute_without_parameters_still_works(runner):
+    runner.execute("PREPARE plain FROM SELECT count(*) FROM region")
+    assert runner.execute("EXECUTE plain").only_value() == 5
+    runner.execute("DEALLOCATE PREPARE plain")
+    with pytest.raises(SemanticError, match="not found"):
+        runner.execute("EXECUTE plain")
+
+
+def test_prepare_execute_using_oracle_parity(runner, oracle):
+    runner.execute(f"PREPARE pq6 FROM {Q6_PREPARED}")
+    got = runner.execute("EXECUTE pq6 USING DATE '1994-01-01', "
+                         "DATE '1994-01-01', 0.06, 0.06, 24")
+    assert_same(got.rows, _oracle_q6(oracle, 1994, 5, 7, 24), False)
+    got = runner.execute("EXECUTE pq6 USING DATE '1995-01-01', "
+                         "DATE '1995-01-01', 0.07, 0.07, 25")
+    assert_same(got.rows, _oracle_q6(oracle, 1995, 6, 8, 25), False)
+
+
+def test_perturbed_execute_zero_misses_plan_hit(runner):
+    """THE acceptance criterion: a re-EXECUTE with perturbed values
+    reports plan_cache_hits >= 1 (no re-planning) and jit_misses == 0
+    (no XLA compiles) — parameter binding + cached-executable dispatch
+    is the whole cost."""
+    runner.execute(f"PREPARE pq6b FROM {Q6_PREPARED}")
+    runner.execute("EXECUTE pq6b USING DATE '1994-01-01', "
+                   "DATE '1994-01-01', 0.06, 0.06, 24")
+    runner.execute("EXECUTE pq6b USING DATE '1996-01-01', "
+                   "DATE '1996-01-01', 0.05, 0.08, 30")
+    stats = runner.last_query_stats
+    assert stats["plan_cache_hits"] >= 1
+    assert stats["plan_cache_misses"] == 0
+    assert stats["jit_misses"] == 0
+    assert stats["jit_param_hits"] > 0
+    # planning was skipped outright, not merely fast
+    assert stats["planning_s"] == 0.0
+
+
+def test_execute_matches_plain_sql(runner):
+    """The bound execution must be row-identical to the same statement
+    with the values written as literals (the oracle-verified path)."""
+    runner.execute(f"PREPARE pq6c FROM {Q6_PREPARED}")
+    got = runner.execute("EXECUTE pq6c USING DATE '1995-01-01', "
+                         "DATE '1995-01-01', 0.07, 0.07, 25")
+    want = runner.execute("""
+        SELECT sum(l_extendedprice * l_discount) AS revenue
+        FROM lineitem
+        WHERE l_shipdate >= DATE '1995-01-01'
+          AND l_shipdate < DATE '1995-01-01' + INTERVAL '1' YEAR
+          AND l_discount BETWEEN 0.07 - 0.01 AND 0.07 + 0.01
+          AND l_quantity < 25""")
+    assert_same(got.rows, want.rows, False)
+
+
+def test_execute_string_parameter(runner):
+    """String parameters bake in as literals (dictionary folds are
+    host-side) — correct rows, per-value kernels, stable plan key
+    across different string lengths (varchar normalizes unbounded)."""
+    runner.execute("PREPARE pseg FROM SELECT count(*) FROM customer "
+                   "WHERE c_mktsegment = ?")
+    base = runner.execute("SELECT count(*) FROM customer "
+                          "WHERE c_mktsegment = 'BUILDING'").only_value()
+    got = runner.execute("EXECUTE pseg USING 'BUILDING'").only_value()
+    assert got == base
+    runner.execute("EXECUTE pseg USING 'AUTOMOBILE'")
+    stats = runner.last_query_stats
+    assert stats["plan_cache_hits"] >= 1     # same varchar type, same plan
+
+
+def test_execute_null_parameter(runner):
+    """USING NULL: no type to key a value-free plan on, so the runner
+    substitutes the AST (literal-NULL semantics, plan per execution)
+    instead of surfacing an internal cast error."""
+    runner.execute("PREPARE pnull FROM "
+                   "SELECT count(*) FROM lineitem WHERE l_quantity < ?")
+    assert runner.execute("EXECUTE pnull USING NULL").only_value() == 0
+    got = runner.execute("EXECUTE pnull USING 24").only_value()
+    want = runner.execute("SELECT count(*) FROM lineitem "
+                          "WHERE l_quantity < 24").only_value()
+    assert got == want   # non-NULL re-execution still takes the fast path
+
+
+def test_execute_insert_prepared(runner):
+    """Non-query prepared statements bind by AST substitution."""
+    runner.execute("CREATE TABLE memory.default.prep_ins (a bigint)")
+    runner.execute("PREPARE pins FROM "
+                   "INSERT INTO memory.default.prep_ins VALUES (?)")
+    runner.execute("EXECUTE pins USING 7")
+    runner.execute("EXECUTE pins USING 9")
+    got = runner.execute(
+        "SELECT sum(a), count(*) FROM memory.default.prep_ins")
+    assert got.rows == [(16, 2)]
+    runner.execute("DROP TABLE memory.default.prep_ins")
+
+
+# ---------------------------------------------------- arity/type errors
+
+
+def test_execute_arity_mismatch(runner):
+    runner.execute("PREPARE parity FROM "
+                   "SELECT count(*) FROM lineitem WHERE l_quantity < ?")
+    with pytest.raises(SemanticError, match="expected 1 but found 0"):
+        runner.execute("EXECUTE parity")
+    with pytest.raises(SemanticError, match="expected 1 but found 2"):
+        runner.execute("EXECUTE parity USING 1, 2")
+
+
+def test_execute_type_mismatch(runner):
+    runner.execute("PREPARE ptype FROM "
+                   "SELECT count(*) FROM lineitem WHERE l_quantity < ?")
+    with pytest.raises(SemanticError, match="cannot compare"):
+        runner.execute("EXECUTE ptype USING 'not a number'")
+    runner.execute("PREPARE pdate FROM "
+                   "SELECT count(*) FROM lineitem WHERE l_shipdate >= ?")
+    with pytest.raises(SemanticError, match="cannot compare"):
+        runner.execute("EXECUTE pdate USING 'not a date'")
+
+
+def test_execute_non_constant_parameter(runner):
+    runner.execute("PREPARE pconst FROM "
+                   "SELECT count(*) FROM lineitem WHERE l_quantity < ?")
+    with pytest.raises(SemanticError, match="constant"):
+        runner.execute("EXECUTE pconst USING 1 + 1")
+    # a column reference fails name resolution (no scope in USING)
+    with pytest.raises(SemanticError, match="cannot be resolved"):
+        runner.execute("EXECUTE pconst USING l_quantity")
+
+
+# ------------------------------------------------------ padded IN-lists
+
+
+def test_in_lists_share_one_executable_within_bucket(runner):
+    """IN-lists of lengths 3/5/6 all pad to the minimum bucket (8): after
+    warming ANY of them, the others dispatch with zero compiles and the
+    jit cache does not grow."""
+    runner.execute(
+        "SELECT count(*) FROM part WHERE p_size IN (1, 2, 3, 4, 5)")
+    size0 = jit_cache.cache_info()
+    for in_list in ("(9, 14, 23)",                  # 3 members
+                    "(49, 14, 23, 45, 19)",        # 5 members
+                    "(49, 14, 23, 45, 19, 3)"):    # 6 members
+        runner.execute(
+            f"SELECT count(*) FROM part WHERE p_size IN {in_list}")
+        stats = runner.last_query_stats
+        assert stats["jit_misses"] == 0, \
+            f"IN {in_list} recompiled (pad bucket not shared)"
+    assert jit_cache.cache_info() == size0
+
+
+def test_padded_in_oracle_parity(runner, oracle):
+    for in_list in ("(9, 14, 23)", "(49, 14, 23, 45, 19)",
+                    "(49, 14, 23, 45, 19, 3)"):
+        got = runner.execute(
+            f"SELECT count(*) FROM part WHERE p_size IN {in_list}")
+        want = oracle.execute(
+            f"SELECT count(*) FROM part WHERE p_size IN {in_list}"
+        ).fetchall()
+        assert_same(got.rows, want, False)
+
+
+def test_padded_in_null_needle_semantics(runner):
+    """Null needle -> null membership -> WHERE drops the row (the OR-of-
+    eq Kleene semantics the padded kernel replaces)."""
+    runner.execute("CREATE TABLE memory.default.pin_null (v bigint)")
+    runner.execute("INSERT INTO memory.default.pin_null VALUES "
+                   "(1), (NULL), (3), (7)")
+    got = runner.execute("SELECT count(*) FROM memory.default.pin_null "
+                         "WHERE v IN (1, 3, 5)")
+    assert got.only_value() == 2
+    got = runner.execute("SELECT count(*) FROM memory.default.pin_null "
+                         "WHERE v NOT IN (1, 3, 5)")
+    assert got.only_value() == 1     # only 7; NULL is neither in nor out
+    runner.execute("DROP TABLE memory.default.pin_null")
+
+
+def test_prepared_in_list_parameters(runner, oracle):
+    """IN (?, ?, ?): members arrive as statement parameters and ride the
+    same padded vector literal lists do — after a LITERAL list of the
+    same shape warms the bucket, even the FIRST EXECUTE dispatches with
+    zero compiles, and perturbed members re-execute warm too."""
+    runner.execute(
+        "SELECT count(*) FROM part WHERE p_size IN (31, 33, 35)")
+    size0 = jit_cache.cache_info()
+    runner.execute("PREPARE pin FROM "
+                   "SELECT count(*) FROM part WHERE p_size IN (?, ?, ?)")
+    got = runner.execute("EXECUTE pin USING 9, 14, 23")
+    assert runner.last_query_stats["jit_misses"] == 0
+    assert jit_cache.cache_info() == size0
+    want = oracle.execute("SELECT count(*) FROM part "
+                          "WHERE p_size IN (9, 14, 23)").fetchall()
+    assert_same(got.rows, want, False)
+    runner.execute("EXECUTE pin USING 4, 11, 37")
+    stats = runner.last_query_stats
+    assert stats["jit_misses"] == 0
+    assert stats["plan_cache_hits"] >= 1
+
+
+# ----------------------------------------------------------- plan cache
+
+
+def test_plan_cache_repeated_statement_hits():
+    r = LocalQueryRunner.tpch("tiny")
+    sql = "SELECT count(*) FROM nation WHERE n_regionkey = 2"
+    r.execute(sql)
+    assert r.last_query_stats["plan_cache_misses"] == 1
+    r.execute(sql)
+    assert r.last_query_stats["plan_cache_hits"] == 1
+    assert r.last_query_stats["plan_cache_misses"] == 0
+    # a DIFFERENT literal is a different statement (plans may specialize
+    # on values): miss, while the kernels still share via hoisting
+    r.execute("SELECT count(*) FROM nation WHERE n_regionkey = 3")
+    assert r.last_query_stats["plan_cache_misses"] == 1
+
+
+def test_plan_cache_disabled_session_property():
+    r = LocalQueryRunner.tpch("tiny")
+    r.execute("SET SESSION plan_cache_enabled = false")
+    sql = "SELECT count(*) FROM region"
+    r.execute(sql)
+    r.execute(sql)
+    assert r.last_query_stats["plan_cache_hits"] == 0
+    assert r.last_query_stats["plan_cache_misses"] == 0   # never consulted
+
+
+def test_plan_cache_invalidation_insert_and_drop():
+    r = LocalQueryRunner.tpch("tiny")
+    r.execute("CREATE TABLE memory.default.pc_inv (a bigint)")
+    r.execute("INSERT INTO memory.default.pc_inv VALUES (1), (2)")
+    sql = "SELECT count(*) FROM memory.default.pc_inv"
+    assert r.execute(sql).only_value() == 2
+    r.execute(sql)
+    assert r.last_query_stats["plan_cache_hits"] == 1
+    # INSERT invalidates: the next run re-plans AND sees the new row
+    r.execute("INSERT INTO memory.default.pc_inv VALUES (3)")
+    assert r.execute(sql).only_value() == 3
+    assert r.last_query_stats["plan_cache_misses"] == 1
+    assert r.last_query_stats["plan_cache_hits"] == 0
+    # DROP + recreate: the cached plan's stale handle must not survive
+    r.execute(sql)   # re-warm
+    r.execute("DROP TABLE memory.default.pc_inv")
+    r.execute("CREATE TABLE memory.default.pc_inv (a bigint)")
+    r.execute("INSERT INTO memory.default.pc_inv VALUES (9)")
+    assert r.execute(sql).only_value() == 1
+    assert r.last_query_stats["plan_cache_misses"] == 1
+    r.execute("DROP TABLE memory.default.pc_inv")
+
+
+def test_plan_cache_lru_eviction():
+    r = LocalQueryRunner.tpch("tiny")
+    r.execute("SET SESSION plan_cache_max_entries = 2")
+    q1 = "SELECT count(*) FROM region"
+    q2 = "SELECT count(*) FROM nation"
+    q3 = "SELECT count(*) FROM supplier"
+    r.execute(q1)
+    r.execute(q2)
+    r.execute(q3)          # evicts q1 (LRU)
+    assert len(r._plan_cache) == 2
+    r.execute(q3)
+    assert r.last_query_stats["plan_cache_hits"] == 1
+    r.execute(q1)          # was evicted: full plan again
+    assert r.last_query_stats["plan_cache_misses"] == 1
+
+
+def test_plan_cache_clone_cannot_shrink_shared_cache():
+    """for_query() clones carry per-request (header-overridable) session
+    bags — a clone setting plan_cache_max_entries must not resize the
+    shared LRU out from under every other session."""
+    r = LocalQueryRunner.tpch("tiny")
+    r.execute("SELECT count(*) FROM region")
+    r.execute("SELECT count(*) FROM nation")
+    clone = r.for_query()
+    clone.session.properties["plan_cache_max_entries"] = 1
+    clone.execute("SELECT count(*) FROM supplier")
+    assert len(r._plan_cache) == 3   # clone's bound never applied
+    r.execute("SET SESSION plan_cache_max_entries = 1")
+    r.execute("SELECT count(*) FROM part")
+    assert len(r._plan_cache) == 1   # the owning runner's bound does
+
+
+def test_plan_cache_put_rejects_stale_generation():
+    """put() carries the generation read before planning: a plan built
+    against pre-invalidation catalog state must never land (the
+    invalidation that should have dropped it already ran)."""
+    c = pc.PlanCache()
+    table = ("memory", "default", "t")
+    gen = c.generation()
+    c.invalidate(table)                  # concurrent DDL during planning
+    c.put("k", "stale-plan", frozenset({table}), gen=gen)
+    assert c.get("k") is None            # rejected
+    c.put("k2", "plan", frozenset({("memory", "default", "u")}), gen=gen)
+    assert c.get("k2") == "plan"         # unaffected table still lands
+
+
+def test_plan_cache_ddl_during_planning_not_cached():
+    """The runner threads the pre-planning generation into put():
+    simulate a clone's INSERT invalidating the scanned table while this
+    runner is mid-planning — the stale plan must not be published."""
+    r = LocalQueryRunner.tpch("tiny")
+    r.execute("SELECT count(*) FROM region")
+    (entry,) = r._plan_cache._entries.values()
+    (table,) = entry.tables              # region's invalidation key
+    r._plan_cache.clear()
+    orig = r._plan_for_execution
+
+    def racy(query):
+        plan = orig(query)
+        r._plan_cache.invalidate(table)  # lands mid-planning
+        return plan
+
+    r._plan_for_execution = racy
+    try:
+        r.execute("SELECT count(*) FROM region")
+    finally:
+        del r._plan_for_execution
+    assert len(r._plan_cache) == 0       # stale plan rejected
+    r.execute("SELECT count(*) FROM region")
+    assert len(r._plan_cache) == 1       # next execution re-caches
+
+
+def test_plan_cache_shrink_applies_without_a_miss():
+    """SET SESSION plan_cache_max_entries must bind immediately: a
+    hit-only steady-state workload never reaches the miss path's
+    re-read, and a lowered bound must reclaim plans now."""
+    r = LocalQueryRunner.tpch("tiny")
+    for q in ("SELECT count(*) FROM region", "SELECT count(*) FROM nation",
+              "SELECT count(*) FROM supplier"):
+        r.execute(q)
+    assert len(r._plan_cache) == 3
+    r.execute("SET SESSION plan_cache_max_entries = 1")
+    assert len(r._plan_cache) == 1
+    assert r._plan_cache.max_entries == 1
+    r.execute("RESET SESSION plan_cache_max_entries")
+    assert r._plan_cache.max_entries == 256
+
+
+def test_plan_cache_keys_on_schema_and_plan_properties():
+    r = LocalQueryRunner.tpch("tiny")
+    sql = "SELECT count(*) FROM lineitem"
+    r.execute(sql)
+    r.execute("USE tpch.sf1")
+    r.execute(sql)       # same text, different schema: different plan
+    assert r.last_query_stats["plan_cache_misses"] == 1
+    r.execute("SET SESSION join_distribution_type = 'BROADCAST'")
+    r.execute(sql)       # plan-affecting property fragments the key
+    assert r.last_query_stats["plan_cache_misses"] == 1
+
+
+def test_distributed_runner_uses_plan_cache():
+    """The distributed runner plans through the same cache — a repeated
+    shape (or an EXECUTE re-run) reuses the distributed-optimized plan,
+    zero planning on re-execution."""
+    from trino_tpu.exec.distributed import DistributedQueryRunner
+    r = DistributedQueryRunner.tpch("tiny")
+    sql = "SELECT count(*) FROM nation"
+    r.execute(sql)
+    assert r.last_query_stats["plan_cache_misses"] == 1
+    r.execute(sql)
+    assert r.last_query_stats["plan_cache_hits"] == 1
+    r.execute("PREPARE dpq FROM "
+              "SELECT count(*) FROM nation WHERE n_regionkey = ?")
+    assert r.execute("EXECUTE dpq USING 1").only_value() == 5
+    r.execute("EXECUTE dpq USING 2")
+    stats = r.last_query_stats
+    assert stats["plan_cache_hits"] >= 1
+    assert stats["planning_s"] == 0.0
+
+
+def test_plan_cache_metrics_exported(runner):
+    from trino_tpu.obs.metrics import REGISTRY
+    runner.execute("SELECT count(*) FROM region")
+    text = REGISTRY.render()
+    for name in ("trino_tpu_plan_cache_entries",
+                 "trino_tpu_plan_cache_hits",
+                 "trino_tpu_plan_cache_misses",
+                 "trino_tpu_plan_cache_evictions_total",
+                 "trino_tpu_plan_cache_invalidations_total"):
+        assert name in text
+    assert pc.stats()["entries"] >= 1
+
+
+def test_explain_analyze_footer_shows_plan_cache():
+    r = LocalQueryRunner.tpch("tiny")
+    out = r.execute(
+        "EXPLAIN ANALYZE SELECT count(*) FROM region").only_value()
+    assert "plan cache 0 hits / 1 misses" in out
+    # EXPLAIN ANALYZE plans through the cache, sharing the entry the
+    # plain statement dispatches: both re-runs are hits
+    out = r.execute(
+        "EXPLAIN ANALYZE SELECT count(*) FROM region").only_value()
+    assert "plan cache 1 hits / 0 misses" in out
+    r.execute("SELECT count(*) FROM region")
+    assert r.last_query_stats["plan_cache_hits"] == 1
+
+
+def test_server_plan_cache_max_entries_config():
+    """Per-request header overrides on pooled clones never resize the
+    shared cache, so a deployment sizes it at the server constructor."""
+    from trino_tpu.server.app import TrinoServer
+    r = LocalQueryRunner.tpch("tiny")
+    server = TrinoServer(r, plan_cache_max_entries=1).start()
+    try:
+        assert r._plan_cache.max_entries == 1
+        r.execute("SELECT count(*) FROM region")
+        r.execute("SELECT count(*) FROM nation")
+        assert len(r._plan_cache) == 1
+        # the base session property matches, so a direct plan miss on the
+        # owning runner must not snap the bound back to the default
+        assert r.session.get("plan_cache_max_entries") == 1
+    finally:
+        server.stop()
+
+
+# ------------------------------------------ dictionary content keys
+
+
+def test_dictionary_content_fingerprint():
+    from trino_tpu.page import Dictionary
+    d1 = Dictionary(np.asarray(["a", "b", "c"], dtype=object))
+    d2 = Dictionary(np.asarray(["a", "b", "c"], dtype=object))
+    d3 = Dictionary(np.asarray(["a", "b", "d"], dtype=object))
+    assert d1 is not d2
+    assert d1 == d2 and hash(d1) == hash(d2)
+    assert d1 != d3
+
+
+def test_identical_dictionary_content_shares_one_trace():
+    """Two tables with byte-identical string pools must hit ONE trace of
+    a warm kernel — the jit trace cache keys dictionaries by content
+    fingerprint, not object identity."""
+    import jax
+    import jax.numpy as jnp
+    from trino_tpu.page import Column, Dictionary, Page
+
+    @jax.jit
+    def f(page):
+        return page.columns[0].values + 1
+
+    def make_page():
+        dct, codes = Dictionary.build(
+            np.asarray(["x", "y", "x", "z"], dtype=object))
+        return Page((Column(jnp.asarray(codes), None,
+                            T.VARCHAR, dct),), 4)
+
+    p1, p2 = make_page(), make_page()
+    assert p1.columns[0].dictionary is not p2.columns[0].dictionary
+    f(p1)
+    f(p2)
+    if hasattr(f, "_cache_size"):
+        assert f._cache_size() == 1
+
+
+def test_join_across_content_identical_dictionaries():
+    """Two tables whose string pools are byte-identical have the same
+    code mapping (content-fingerprint equality), so a string-key join
+    across them serves instead of raising 'distinct dictionaries'."""
+    r = LocalQueryRunner.tpch("tiny")
+    r.execute("CREATE TABLE memory.default.dj1 AS "
+              "SELECT n_name, n_nationkey FROM nation")
+    r.execute("CREATE TABLE memory.default.dj2 AS "
+              "SELECT n_name, n_regionkey FROM nation")
+    out = r.execute(
+        "SELECT count(*) FROM memory.default.dj1 a, memory.default.dj2 b "
+        "WHERE a.n_name = b.n_name").only_value()
+    assert out == 25   # 25 unique names, each matches itself once
+    # downstream string comparison across the two pools works too
+    # (expr/compiler._cmp_strings applies the same fingerprint equality)
+    out = r.execute(
+        "SELECT count(*) FROM memory.default.dj1 a "
+        "JOIN memory.default.dj2 b ON a.n_nationkey = b.n_regionkey "
+        "WHERE a.n_name < b.n_name").only_value()
+    want = r.execute(
+        "SELECT count(*) FROM nation a "
+        "JOIN nation b ON a.n_nationkey = b.n_regionkey "
+        "WHERE a.n_name < b.n_name").only_value()
+    assert out == want
+
+
+# ------------------------------------------------ HTTP wire protocol
+
+
+def _post(server, sql, headers=None):
+    req = urllib.request.Request(
+        f"{server.base_uri}/v1/statement", data=sql.encode(),
+        method="POST")
+    req.add_header("X-Trino-User", "test")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read()), dict(resp.headers)
+
+
+def _run(server, sql, headers=None):
+    payload, hdrs = _post(server, sql, headers)
+    rows = []
+    while "nextUri" in payload:
+        with urllib.request.urlopen(payload["nextUri"]) as resp:
+            hdrs.update(dict(resp.headers))
+            payload = json.loads(resp.read())
+        rows.extend(payload.get("data", []))
+    return payload, rows, hdrs
+
+
+def test_prepared_statement_over_http():
+    from trino_tpu.server.app import TrinoServer
+    server = TrinoServer(LocalQueryRunner.tpch("tiny")).start()
+    try:
+        stmt = "SELECT count(*) FROM region WHERE r_regionkey < ?"
+        # PREPARE echoes the statement back for the stateless client
+        payload, _, hdrs = _run(server, f"PREPARE hp FROM {stmt}")
+        added = hdrs.get("X-Trino-Added-Prepare", "")
+        name, _, enc = added.partition("=")
+        assert urllib.parse.unquote(name) == "hp"
+        assert urllib.parse.unquote(enc) == stmt
+        # EXECUTE works only when the client re-sends the statement
+        header = {"X-Trino-Prepared-Statement":
+                  f"hp={urllib.parse.quote(stmt, safe='')}"}
+        payload, rows, _ = _run(server, "EXECUTE hp USING 3", header)
+        assert payload.get("error") is None
+        assert rows == [[3]]
+        # without the header the session has no such statement
+        payload, _, _ = _run(server, "EXECUTE hp USING 3")
+        assert payload.get("error") is not None
+        assert "not found" in payload["error"]["message"]
+        # DEALLOCATE echoes the name for the client to forget
+        payload, _, hdrs = _run(server, "DEALLOCATE PREPARE hp", header)
+        assert hdrs.get("X-Trino-Deallocated-Prepare") == "hp"
+    finally:
+        server.stop()
+
+
+def test_prepared_http_name_normalization():
+    """The echo must carry the PARSER-normalized name: unquoted names
+    lowercase (EXECUTE resolves through the parser again, so a raw-case
+    echo would install a key EXECUTE can never find), quoted names
+    verbatim."""
+    from trino_tpu.server.app import TrinoServer
+    server = TrinoServer(LocalQueryRunner.tpch("tiny")).start()
+    try:
+        stmt = "SELECT count(*) FROM region WHERE r_regionkey < ?"
+        _, _, hdrs = _run(server, f"PREPARE MyQ FROM {stmt}")
+        added = hdrs.get("X-Trino-Added-Prepare", "")
+        name, _, enc = added.partition("=")
+        assert urllib.parse.unquote(name) == "myq"
+        # the client re-sends exactly what was echoed
+        payload, rows, _ = _run(server, "EXECUTE MyQ USING 3",
+                                {"X-Trino-Prepared-Statement": added})
+        assert payload.get("error") is None and rows == [[3]]
+        _, _, hdrs = _run(server, "DEALLOCATE PREPARE MyQ")
+        assert hdrs.get("X-Trino-Deallocated-Prepare") == "myq"
+        # quoted names echo verbatim (spaces and case preserved)
+        _, _, hdrs = _run(server, f'PREPARE "My Q" FROM {stmt}')
+        added = hdrs.get("X-Trino-Added-Prepare", "")
+        name, _, _ = added.partition("=")
+        assert urllib.parse.unquote(name) == "My Q"
+        payload, rows, _ = _run(server, 'EXECUTE "My Q" USING 3',
+                                {"X-Trino-Prepared-Statement": added})
+        assert payload.get("error") is None and rows == [[3]]
+    finally:
+        server.stop()

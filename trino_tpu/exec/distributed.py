@@ -245,7 +245,7 @@ class DistributedQueryRunner(LocalQueryRunner):
     # ------------------------------------------------------------ execute
 
     def _execute_query(self, query: t.Query) -> MaterializedResult:
-        plan = self._plan_distributed(query)
+        plan = self._plan_query(query)   # through the plan cache
         with self._phase("execution"):
             frag = fragment_plan(plan)
             # children schedule (and retry) independently BEFORE the
@@ -273,6 +273,7 @@ class DistributedQueryRunner(LocalQueryRunner):
         executor.faults = self._faults
         executor.deadline = self._deadline
         executor.collector = self._collector
+        executor.exec_params = self._exec_params
         if self._memory is not None:
             executor.memory = self._memory   # query-level shared ledger
         root_stream = executor.execute(frag.root)
@@ -297,7 +298,16 @@ class DistributedQueryRunner(LocalQueryRunner):
             self._collector.add_output(len(rows), nbytes)
         return MaterializedResult(list(plan.column_names), types, rows)
 
-    def _plan_distributed(self, query: t.Statement) -> OutputNode:
+    def _plan_query_for_analyze(self, query: t.Statement) -> OutputNode:
+        """EXPLAIN ANALYZE executes with the LOCAL executor, but this
+        runner's shared cache holds distributed (exchange-bearing) plans
+        — plan outside the cache so neither path poisons the other."""
+        return self._plan(query)
+
+    def _plan_for_execution(self, query: t.Statement) -> OutputNode:
+        """Distributed planning primitive behind the base runner's
+        `_plan_query` cache: a repeated shape (or an EXECUTE re-run)
+        reuses the fragmented-and-optimized plan too."""
         from trino_tpu.planner import LogicalPlanner
         with self._phase("planning"):
             plan = LogicalPlanner(self.metadata, self.session).plan(query)
@@ -368,6 +378,7 @@ class DistributedQueryRunner(LocalQueryRunner):
             executor.faults = self._faults
             executor.deadline = self._deadline
             executor.collector = self._collector
+            executor.exec_params = self._exec_params
             if self._memory is not None:
                 executor.memory = self._memory  # shards share the ledger
             dispatched.append(

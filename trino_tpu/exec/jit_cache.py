@@ -69,9 +69,12 @@ def set_observer(observer) -> None:
 
 
 def _param_signature(params) -> Tuple:
-    """Flatten a (possibly nested) tuple of 0-d scalar arrays into a
+    """Flatten a (possibly nested) tuple of scalar/vector arrays into a
     comparable value signature. Used only to tell `jit_param_hit` (same
-    canonical key, new literal values) apart from a plain `jit_hit`."""
+    canonical key, new literal values) apart from a plain `jit_hit`.
+    Vector entries (padded IN-list members) compare by shape + raw
+    bytes, so a reordered or repadded member list counts as a value
+    change just like a perturbed scalar."""
     out = []
 
     def visit(p):
@@ -80,7 +83,7 @@ def _param_signature(params) -> Tuple:
                 visit(x)
         else:
             a = np.asarray(p)
-            out.append((a.dtype.str, a.item()))
+            out.append((a.dtype.str, a.shape, a.tobytes()))
     visit(params)
     return tuple(out)
 

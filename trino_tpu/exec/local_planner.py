@@ -183,6 +183,11 @@ class LocalExecutionPlanner:
         # installed by the owning runner; None = no chaos / no limits
         self.faults = None
         self.deadline = None
+        # statement parameter values (EXECUTE ... USING), installed by
+        # the owning runner: the hoist pass binds BoundParam plan leaves
+        # from this tuple, so one cached (value-free) plan re-executes
+        # with fresh values through the same warm kernels
+        self.exec_params: tuple = ()
 
     def _checkpoint(self) -> None:
         """Cooperative cancellation/deadline point (page-batch boundary);
@@ -205,18 +210,24 @@ class LocalExecutionPlanner:
 
     def _hoist(self, expr):
         """Canonicalize one lowered expression: (literal-free tree,
-        runtime values tuple). Identity when hoisting is disabled."""
-        if expr is None or not self._hoist_on:
+        runtime values tuple). When hoisting is disabled, statement
+        parameters still bind — as baked-in Literals (per-value kernel
+        keys, the debugging pin's semantics)."""
+        if expr is None:
             return expr, ()
-        from trino_tpu.expr.hoist import hoist_literals
-        return hoist_literals(expr)
+        from trino_tpu.expr.hoist import hoist_literals, materialize_bound
+        if not self._hoist_on:
+            return materialize_bound(expr, self.exec_params), ()
+        return hoist_literals(expr, bound=self.exec_params)
 
     def _hoist_seq(self, exprs):
         """Canonicalize a projection list with one shared values tuple."""
+        from trino_tpu.expr.hoist import hoist_literal_seq, \
+            materialize_bound
         if not self._hoist_on:
-            return tuple(exprs), ()
-        from trino_tpu.expr.hoist import hoist_literal_seq
-        return hoist_literal_seq(exprs)
+            return tuple(materialize_bound(e, self.exec_params)
+                         for e in exprs), ()
+        return hoist_literal_seq(exprs, bound=self.exec_params)
 
     # ------------------------------------------------------------ dispatch
 

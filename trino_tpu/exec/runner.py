@@ -75,10 +75,22 @@ def _to_python(value, typ: T.Type):
 
 class LocalQueryRunner:
     def __init__(self, session: Optional[Session] = None):
+        from trino_tpu.exec.plan_cache import PlanCache
         self.catalogs = CatalogManager()
         self.metadata = Metadata(self.catalogs)
         self.session = session or Session()
         self._prepared = {}
+        # optimized-plan reuse (exec/plan_cache.py): keyed on the
+        # canonical statement fingerprint + context; per-runner (it holds
+        # handles resolved against THIS runner's catalogs) and shared
+        # with for_query() clones, so the server's executor pool warms
+        # one cache. DDL/INSERT invalidate by referenced table.
+        self._plan_cache = PlanCache()
+        self._owns_plan_cache = True
+        # statement parameter values for the CURRENT execution
+        # (EXECUTE ... USING): expr/hoist.py binds BoundParam plan
+        # leaves from this tuple at lowering time
+        self._exec_params: Tuple[Any, ...] = ()
         # per-query fault-tolerance state (set in execute, read by the
         # execution paths; one query at a time per runner — concurrent
         # queries each run on a for_query() clone)
@@ -107,6 +119,15 @@ class LocalQueryRunner:
             catalog=self.session.catalog, schema=self.session.schema,
             user=self.session.user, start_date=self.session.start_date,
             properties=dict(self.session.properties))
+        # _plan_cache and _prepared are intentionally SHARED (copy.copy
+        # keeps the references): concurrent queries warm one plan cache,
+        # and server-side prepared statements registered on the base
+        # runner stay visible (the server gives each query a private
+        # overlay for header-supplied statements). Clones do NOT own the
+        # cache: their (header-overridable) plan_cache_max_entries must
+        # not resize the shared LRU out from under other sessions.
+        clone._owns_plan_cache = False
+        clone._exec_params = ()
         clone._deadline = None
         clone._faults = None
         clone._memory = None
@@ -405,9 +426,12 @@ class LocalQueryRunner:
             name = str(stmt.name)
             value = _literal_value(stmt.value)
             self.session.set(name, value)
+            self._session_property_changed(name)
             return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
         if isinstance(stmt, t.ResetSession):
-            self.session.properties.pop(str(stmt.name), None)
+            name = str(stmt.name)
+            self.session.properties.pop(name, None)
+            self._session_property_changed(name)
             return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
         if isinstance(stmt, t.Use):
             if stmt.catalog is not None:
@@ -426,12 +450,7 @@ class LocalQueryRunner:
             self._prepared[stmt.name.value] = stmt.statement
             return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
         if isinstance(stmt, t.ExecuteStatement):
-            if stmt.name.value not in self._prepared:
-                raise SemanticError(
-                    f"prepared statement not found: {stmt.name.value}")
-            if stmt.parameters:
-                raise SemanticError("EXECUTE parameters not supported yet")
-            return self._execute_statement(self._prepared[stmt.name.value])
+            return self._execute_prepared(stmt)
         if isinstance(stmt, t.Deallocate):
             self._prepared.pop(stmt.name.value, None)
             return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
@@ -439,6 +458,88 @@ class LocalQueryRunner:
             return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
         raise SemanticError(
             f"unsupported statement: {type(stmt).__name__}")
+
+    # ------------------------------------------------ prepared statements
+
+    def _execute_prepared(self, stmt: t.ExecuteStatement
+                          ) -> MaterializedResult:
+        """EXECUTE [... USING v1, .., vn]: bind values to the prepared
+        statement's `?` markers and run it. Query statements take the
+        FAST path — plan once with value-free BoundParam leaves, reuse
+        the cached plan on every re-execution (any values, same types),
+        and let literal hoisting bind the values into the same warm
+        kernels — so a repeated EXECUTE costs parameter binding plus
+        cached-executable dispatch (the PREPARE/EXECUTE ... USING
+        protocol bound straight to ParameterRewriter slots). Non-query
+        prepared statements (INSERT/CTAS/DDL) substitute the value
+        expressions into the AST and run the normal path."""
+        from trino_tpu.sql.analyzer import (check_execute_arity,
+                                            count_parameters,
+                                            substitute_parameters)
+        prepared = self._prepared.get(stmt.name.value)
+        if prepared is None:
+            raise SemanticError(
+                f"prepared statement not found: {stmt.name.value}")
+        markers = count_parameters(prepared)
+        check_execute_arity(stmt.name.value, markers, len(stmt.parameters))
+        if markers == 0:
+            return self._execute_statement(prepared)
+        if not isinstance(prepared, t.Query):
+            return self._execute_statement(
+                substitute_parameters(prepared, stmt.parameters))
+        types, values = self._bind_execute_parameters(stmt)
+        if any(v is None for v in values):
+            # NULL parameters: a NULL carries no type to key a value-free
+            # plan on (and changes validity structure), so substitute the
+            # AST and plan per execution — literal-NULL semantics, exactly
+            # what the plain statement would do
+            return self._execute_statement(
+                substitute_parameters(prepared, stmt.parameters))
+        self.session.param_types = types
+        self._exec_params = values
+        try:
+            return self._execute_query(prepared)
+        finally:
+            self.session.param_types = None
+            self._exec_params = ()
+
+    def _bind_execute_parameters(self, stmt: t.ExecuteStatement):
+        """USING values -> (types, python values). Values must be
+        constants; string parameters normalize to unbounded varchar so a
+        different-length string binds the same cached plan."""
+        from trino_tpu.expr.ir import Call as IRCall, Literal as IRLiteral
+        from trino_tpu.planner.translate import ExpressionTranslator, Scope
+        tr = ExpressionTranslator(Scope([]), session=self.session)
+        types: List[T.Type] = []
+        values: List[Any] = []
+        for i, expr in enumerate(stmt.parameters):
+            lit = tr.translate(expr)
+            if isinstance(lit, IRCall) and lit.name == "negate" and \
+                    isinstance(lit.args[0], IRLiteral):
+                lit = IRLiteral(-lit.args[0].value, lit.type)
+            if not isinstance(lit, IRLiteral):
+                raise SemanticError(
+                    f"EXECUTE parameter {i + 1} must be a constant "
+                    f"literal: {expr}")
+            typ = lit.type
+            if T.is_string(typ):
+                typ = T.VARCHAR
+            types.append(typ)
+            values.append(lit.value)
+        return tuple(types), tuple(values)
+
+    def _session_property_changed(self, name: str) -> None:
+        """SET/RESET SESSION side effects: resizing the plan-cache LRU
+        applies immediately on the OWNING runner (a hit-only steady-state
+        workload never reaches the miss path's re-read, and a shrink must
+        evict now, not on the next put). Clones never resize the shared
+        cache — per-request header overrides must not evict other
+        sessions' warm plans."""
+        if name == "plan_cache_max_entries" and self._owns_plan_cache:
+            self._plan_cache.resize(
+                int(self.session.get("plan_cache_max_entries")))
+
+    # ----------------------------------------------------------- planning
 
     def _phase(self, name: str):
         """The collector's phase scope, or a no-op outside execute()."""
@@ -450,8 +551,73 @@ class LocalQueryRunner:
             plan = LogicalPlanner(self.metadata, self.session).plan(query)
             return optimize(plan, self.metadata, self.session)
 
+    def _plan_for_execution(self, query: t.Query) -> OutputNode:
+        """The planning primitive `_plan_query` caches. Subclasses
+        override (the distributed runner optimizes with distributed=True);
+        each runner produces ONE plan kind here, so cached plans never
+        cross execution modes."""
+        return self._plan(query)
+
+    def _plan_cache_key(self, query: t.Query):
+        from trino_tpu.exec.plan_cache import (PLAN_PROPERTIES,
+                                               statement_fingerprint)
+        skeleton, values = statement_fingerprint(query)
+        param_types = getattr(self.session, "param_types", None)
+        return (skeleton, values,
+                self.session.catalog, self.session.schema,
+                self.session.start_date,
+                None if param_types is None
+                else tuple(t_.display() for t_ in param_types),
+                tuple((p, self.session.get(p)) for p in PLAN_PROPERTIES))
+
+    def _plan_query(self, query: t.Query) -> OutputNode:
+        """Plan a SELECT through the plan cache: the key is the canonical
+        literal-free statement fingerprint + masked literal values +
+        catalog/schema/current_date + bound parameter types +
+        plan-affecting session properties (exec/plan_cache.py). Lowering-
+        time properties (hoist_literals, capacities, spill) re-apply per
+        execution, so they never fragment the key."""
+        from trino_tpu.exec.plan_cache import plan_tables
+        if not bool(self.session.get("plan_cache_enabled")):
+            return self._plan_for_execution(query)
+        key = self._plan_cache_key(query)
+        plan = self._plan_cache.get(key)
+        col = self._collector
+        if plan is not None:
+            if col is not None:
+                col.plan_cache_hit()
+            return plan
+        if col is not None:
+            col.plan_cache_miss()
+        # generation BEFORE planning: if a concurrent clone's DDL/INSERT
+        # invalidates a referenced table while this plan is being built,
+        # put() rejects it — publishing it would let a pre-change plan
+        # outlive the invalidation that should have dropped it
+        gen = self._plan_cache.generation()
+        plan = self._plan_for_execution(query)
+        if self._owns_plan_cache:
+            # the owning runner's plan_cache_max_entries binds (set via
+            # SET SESSION or direct property writes); a clone's never does
+            self._plan_cache.resize(
+                int(self.session.get("plan_cache_max_entries")))
+        self._plan_cache.put(key, plan, plan_tables(plan), gen=gen)
+        return plan
+
+    def _plan_query_for_analyze(self, query: t.Query) -> OutputNode:
+        """EXPLAIN ANALYZE's planning path: the cache, here — its plans
+        are the local kind `_explain_analyze` executes. The distributed
+        runner overrides (its cached plans carry exchanges for its own
+        executor and must not be mixed into the local analyze path)."""
+        return self._plan_query(query)
+
+    def _invalidate_plans(self, qname) -> None:
+        """DDL/DML against a table: drop cached plans referencing it
+        (stale handles and statistics must not outlive the change)."""
+        self._plan_cache.invalidate(
+            (qname.catalog, qname.schema, qname.table))
+
     def _execute_query(self, query: t.Query) -> MaterializedResult:
-        plan = self._plan(query)
+        plan = self._plan_query(query)
         return self._run_plan(plan)
 
     def _run_plan(self, plan: OutputNode) -> MaterializedResult:
@@ -475,6 +641,7 @@ class LocalQueryRunner:
         executor.faults = self._faults if chaos else None
         executor.deadline = self._deadline
         executor.collector = self._collector
+        executor.exec_params = self._exec_params
         if self._memory is not None:
             executor.memory = self._memory   # query-level shared ledger
         stream = executor.execute(plan)
@@ -511,6 +678,7 @@ class LocalQueryRunner:
                      for c in stmt.elements)
         conn.metadata.create_table(
             TableMetadata(qname.schema_table, cols), stmt.not_exists)
+        self._invalidate_plans(qname)
         return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
 
     def _create_table_as(self, stmt: t.CreateTableAsSelect
@@ -523,6 +691,7 @@ class LocalQueryRunner:
             for name, sym in zip(plan.column_names, plan.symbols))
         conn.metadata.create_table(
             TableMetadata(qname.schema_table, cols), stmt.not_exists)
+        self._invalidate_plans(qname)
         if not stmt.with_data:
             return MaterializedResult(["rows"], [T.BIGINT], [(0,)])
         handle = conn.metadata.get_table_handle(qname.schema_table)
@@ -530,7 +699,12 @@ class LocalQueryRunner:
             plan.source, qname.catalog, handle, plan.symbols,
             Symbol("rows", T.BIGINT))
         out = OutputNode(writer, ("rows",), (Symbol("rows", T.BIGINT),))
-        return self._run_plan(out)
+        # invalidate again once the data lands: a concurrent clone may
+        # have cached an empty-table plan between create and write
+        try:
+            return self._run_plan(out)
+        finally:
+            self._invalidate_plans(qname)
 
     def _insert(self, stmt: t.Insert) -> MaterializedResult:
         qname = self._resolve(stmt.target)
@@ -550,7 +724,16 @@ class LocalQueryRunner:
             plan.source, qname.catalog, handle, plan.symbols,
             Symbol("rows", T.BIGINT))
         out = OutputNode(writer, ("rows",), (Symbol("rows", T.BIGINT),))
-        return self._run_plan(out)
+        # INSERT changes data + statistics: cached plans over this table
+        # (scan capacities, broadcast decisions) must re-plan. Invalidate
+        # AFTER the write lands — invalidating first opens a window where
+        # a concurrent clone re-caches a pre-insert plan that then
+        # outlives the change. finally: a failed/partial write is still a
+        # change (conservative).
+        try:
+            return self._run_plan(out)
+        finally:
+            self._invalidate_plans(qname)
 
     def _drop_table(self, stmt: t.DropTable) -> MaterializedResult:
         qname = self._resolve(stmt.name)
@@ -561,6 +744,7 @@ class LocalQueryRunner:
                 return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
             raise SemanticError(f"table not found: {qname}")
         conn.metadata.drop_table(handle)
+        self._invalidate_plans(qname)
         return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
 
     # -------------------------------------------------------------- SHOW
@@ -568,9 +752,13 @@ class LocalQueryRunner:
     def _explain(self, stmt: t.Explain) -> MaterializedResult:
         if not isinstance(stmt.statement, t.Query):
             raise SemanticError("EXPLAIN requires a query")
-        plan = self._plan(stmt.statement)
         if stmt.analyze:
-            return self._explain_analyze(plan)
+            # through the plan cache: the footer's plan-cache counters
+            # are live, and EXPLAIN ANALYZE warms/reuses the same entry
+            # the plain statement dispatches
+            return self._explain_analyze(
+                self._plan_query_for_analyze(stmt.statement))
+        plan = self._plan(stmt.statement)
         if stmt.explain_type == "DISTRIBUTED":
             from trino_tpu.planner.optimizer import add_exchanges, \
                 OptimizerContext, StatsEstimator
@@ -603,6 +791,7 @@ class LocalQueryRunner:
         executor = LocalExecutionPlanner(self.metadata, self.session)
         executor.collector = col
         executor.deadline = self._deadline
+        executor.exec_params = self._exec_params
         if self._memory is not None:
             executor.memory = self._memory
         t0 = time.perf_counter()

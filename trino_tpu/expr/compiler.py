@@ -144,6 +144,8 @@ def _eval_call(expr: Call, page: Page, params=()) -> Column:
         return _array_call(expr, page, params)
     if name in ("format_datetime", "date_format"):
         return _format_datetime(expr, page, params)
+    if name == "$in_padded":
+        return _in_padded(expr, page, params)
     # --- generic null-propagating scalar ----------------------------------
     impl = F.lookup(name)
     args = [_eval(a, page, params) for a in expr.args]
@@ -153,6 +155,21 @@ def _eval_call(expr: Call, page: Page, params=()) -> Column:
     for a in args:
         valid = _vand(valid, a.valid)
     return Column(values, valid, expr.type, None)
+
+
+def _in_padded(expr: Call, page: Page, params=()) -> Column:
+    """Padded fixed-width IN-list membership (expr/hoist._pad_in_chain):
+    args are (needle, Param -> padded member vector, static width
+    Literal). The member vector arrives as a traced 1-d operand of the
+    bucket width, so every list length within a bucket runs one
+    executable; padding repeats a real member, so no mask is needed.
+    Null semantics match the OR-of-eq desugaring it replaces: members
+    are non-null by construction, so the result is null iff the needle
+    is null (Kleene OR of needle-null equality tests)."""
+    col = _eval(expr.args[0], page, params)
+    vec = jnp.asarray(params[expr.args[1].index])
+    vals = jnp.any(col.values[..., None] == vec, axis=-1)
+    return Column(vals, col.valid, expr.type, None)
 
 
 def _literal_str(expr: RowExpression) -> Optional[str]:
@@ -199,8 +216,10 @@ def _string_comparison(name: str, args, page: Page, out_type,
             vals = codes >= d.lower_bound(b_lit)
         return Column(vals, col.valid, out_type, None)
     # column vs column: only valid when both sides share one dictionary
+    # (content-fingerprint equality — byte-identical pools from different
+    # tables have the same code mapping, so code comparison is exact)
     other = _eval(args[1], page, params)
-    if col.dictionary is not other.dictionary:
+    if col.dictionary != other.dictionary:
         raise NotImplementedError(
             "string column comparison across distinct dictionaries")
     vals = F.lookup(name)(out_type, [T.BIGINT, T.BIGINT],
@@ -803,7 +822,8 @@ def _eval_special(expr: SpecialForm, page: Page, params=()) -> Column:
         return Column(vals, None, expr.type, None)
     if kind is SpecialKind.COALESCE:
         args = [_eval(a, page, params) for a in expr.args]
-        dicts = {id(a.dictionary) for a in args if a.dictionary is not None}
+        # content-equal pools dedup to one set element (fingerprint hash)
+        dicts = {a.dictionary for a in args if a.dictionary is not None}
         if len(dicts) > 1:
             raise NotImplementedError("COALESCE over distinct dictionaries")
         dictionary = next((a.dictionary for a in args
@@ -851,7 +871,7 @@ def _if_merge(cond: Column, then: Column, els: Column, out_type) -> Column:
     if cond.valid is not None:
         take_then = take_then & cond.valid
     if (then.dictionary is not None and els.dictionary is not None
-            and then.dictionary is not els.dictionary):
+            and then.dictionary != els.dictionary):
         # distinct string pools (e.g. CASE emitting literals): union the
         # pools at trace time and remap both sides' codes
         then, els = _merge_dictionaries(then, els)

@@ -80,6 +80,26 @@ class Param(RowExpression):
 
 
 @dataclasses.dataclass(frozen=True)
+class BoundParam(RowExpression):
+    """Statement-level parameter reference (`?` in a prepared statement).
+
+    Produced by planner/translate.py when EXECUTE ... USING binds values:
+    `position` indexes the statement's parameter list, typed from the
+    bound value. Plans carrying BoundParam leaves are value-free — the
+    plan cache reuses them across EXECUTEs — and expr/hoist.py folds them
+    into the SAME positional `Param` slots hoisted literals use, so a
+    re-execution with new values dispatches only warm executables.
+    Reference parity: sql/planner/ParameterRewriter.java binding
+    Parameter nodes during planning."""
+
+    position: int
+    type: T.Type
+
+    def __str__(self):
+        return f"$param{self.position}"
+
+
+@dataclasses.dataclass(frozen=True)
 class Call(RowExpression):
     """Scalar function call resolved to a registry name, e.g. 'add:bigint'."""
 

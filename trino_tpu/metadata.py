@@ -79,6 +79,19 @@ SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     # tree). Default on; set false to pin a misbehaving shape back to
     # per-literal compilation for debugging.
     "hoist_literals": True,
+    # plan cache (exec/plan_cache.py): reuse optimized plans for repeated
+    # statement shapes — a prepared statement's EXECUTE ... USING binds
+    # new values to one cached (value-free) plan, so re-execution skips
+    # parse/analyze/plan/optimize entirely. Keys include catalog/schema,
+    # current_date, parameter types, and the plan-affecting properties
+    # (join_*, distributed_sort); DDL/INSERT invalidate by table. Set
+    # false to pin a statement back to plan-per-execution.
+    # plan_cache_max_entries resizes the LRU only on the runner that OWNS
+    # the cache (SET SESSION on a direct runner / server config) — a
+    # per-request header override on a pooled query clone must not evict
+    # every other session's warm plans from the shared cache.
+    "plan_cache_enabled": True,
+    "plan_cache_max_entries": 256,
     # observability (obs/stats.py): per-operator stats collection for
     # EVERY query on the session (EXPLAIN ANALYZE forces it regardless).
     # Off by default: instrumenting node boundaries splits fused kernel

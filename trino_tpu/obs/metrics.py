@@ -305,5 +305,23 @@ def _engine_gauges():
            "(evicted shapes reload from the persistent XLA cache).",
            js["evictions"], {})
 
+    from trino_tpu.exec import plan_cache
+    ps = plan_cache.stats()
+    yield ("trino_tpu_plan_cache_entries",
+           "Optimized plans resident across live plan caches.",
+           ps["entries"], {})
+    yield ("trino_tpu_plan_cache_hits",
+           "Plan cache hits since process start — statements that "
+           "skipped parse/analyze/plan/optimize.", ps["hits"], {})
+    yield ("trino_tpu_plan_cache_misses",
+           "Plan cache misses (full plans built) since process start.",
+           ps["misses"], {})
+    yield ("trino_tpu_plan_cache_evictions_total",
+           "Plans evicted by the per-runner LRU since process start.",
+           ps["evictions"], {})
+    yield ("trino_tpu_plan_cache_invalidations_total",
+           "Plans dropped by DDL/INSERT table invalidation since "
+           "process start.", ps["invalidations"], {})
+
 
 REGISTRY.register_gauges(_engine_gauges)

@@ -72,6 +72,10 @@ class QueryStatsCollector:
         # from that key's previous call — kernel sharing that per-literal
         # keying could not have expressed
         self.jit_param_hits = 0
+        # plan cache consults (exec/plan_cache.py): a hit means this
+        # query skipped parse->plan->optimize and re-ran a cached plan
+        self.plan_cache_hits = 0
+        self.plan_cache_misses = 0
         self.retries = 0
         self.faults_injected = 0
 
@@ -136,6 +140,12 @@ class QueryStatsCollector:
     def jit_param_hit(self, key=None) -> None:
         self.jit_param_hits += 1
 
+    def plan_cache_hit(self) -> None:
+        self.plan_cache_hits += 1
+
+    def plan_cache_miss(self) -> None:
+        self.plan_cache_misses += 1
+
     # -------------------------------------------------------- finish
 
     def finish(self) -> None:
@@ -176,6 +186,8 @@ class QueryStatsCollector:
             "jit_hits": self.jit_hits,
             "jit_misses": self.jit_misses,
             "jit_param_hits": self.jit_param_hits,
+            "plan_cache_hits": self.plan_cache_hits,
+            "plan_cache_misses": self.plan_cache_misses,
             "retries": self.retries,
             "faults_injected": self.faults_injected,
         }
@@ -248,7 +260,9 @@ def render_analyzed_plan(plan, collector: QueryStatsCollector,
              f"planning {collector.planning_s * 1000:.2f}ms, "
              f"jit {collector.jit_hits} hits / "
              f"{collector.jit_misses} misses / "
-             f"{collector.jit_param_hits} param hits")
+             f"{collector.jit_param_hits} param hits, "
+             f"plan cache {collector.plan_cache_hits} hits / "
+             f"{collector.plan_cache_misses} misses")
     if collector.spilled_bytes:
         text += f", spilled {_fmt_bytes(collector.spilled_bytes)}"
     return text

@@ -253,7 +253,10 @@ def hash_join(
         for pk, bk in zip(probe_keys, build_keys):
             pd = probe.column(pk).dictionary
             bd = build.column(bk).dictionary
-            if pd is not None and bd is not None and pd is not bd:
+            # content-fingerprint inequality (page.py round 10), not
+            # object identity: pools with byte-identical values share one
+            # code mapping, so joining across them is exact
+            if pd is not None and bd is not None and pd != bd:
                 raise NotImplementedError(
                     "string join keys across distinct dictionaries; "
                     "re-encode to a shared dictionary first")
@@ -635,7 +638,10 @@ def unique_inner_probe(
         for pk, bk in zip(probe_keys, build_keys):
             pd = probe.column(pk).dictionary
             bd = build.column(bk).dictionary
-            if pd is not None and bd is not None and pd is not bd:
+            # content-fingerprint inequality (page.py round 10), not
+            # object identity: pools with byte-identical values share one
+            # code mapping, so joining across them is exact
+            if pd is not None and bd is not None and pd != bd:
                 raise NotImplementedError(
                     "string join keys across distinct dictionaries; "
                     "re-encode to a shared dictionary first")

@@ -176,7 +176,7 @@ def _eval(spec: WindowSpec, page: Page, live, idx, seg_b, seg_id, seg_start,
         if len(spec.arg_channels) > 2:       # explicit default
             dflt = arg(2)
             dvals = dflt.values
-            if x.dictionary is not dflt.dictionary:
+            if x.dictionary != dflt.dictionary:
                 # dictionary-encoded arg with a differently-encoded default
                 # (e.g. literal singleton pool): re-encode both onto a shared
                 # union pool at trace time (dictionaries are static aux data)

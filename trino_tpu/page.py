@@ -14,8 +14,9 @@ spi/block/ (68 files). Design decisions (SURVEY.md §7.1):
   via a stable flag-sort (Page.filter), the device analog of
   Page.getPositions (spi/Page.java:332) / Block.copyPositions.
 - Columns/Pages are registered pytrees so whole operator pipelines jit/shard
-  cleanly; Type and Dictionary ride as static aux data (hash/eq by identity id
-  for dictionaries, so repeated pages of one table never retrace).
+  cleanly; Type and Dictionary ride as static aux data (hash/eq by content
+  fingerprint for dictionaries, so repeated pages of one table — and any
+  OTHER table with a byte-identical pool — never retrace).
 """
 
 from __future__ import annotations
@@ -38,10 +39,20 @@ class Dictionary:
 
     Codes are indices into `values` (np.ndarray of str, ascending order), so
     integer comparison of codes == string comparison of values. Code -1 is
-    reserved for padding. Identity-hashed so it can be jit-static aux data.
+    reserved for padding. Hash/eq key on a CONTENT fingerprint so the pool
+    can ride as jit-static aux data without object identity fragmenting
+    the trace cache: two tables whose string pools are byte-identical
+    (same data loaded twice, a re-created memory table, a re-generated
+    connector pool) hit ONE trace for a warm canonical kernel instead of
+    retracing per Dictionary object. Correctness: every host-side fold a
+    trace bakes in (code_of, bounds, like/transform tables) is a pure
+    function of the pool CONTENT, so content-equal pools are
+    interchangeable within a trace. Eq compares fingerprints only — a
+    16-byte blake2b over the pool — so trace-cache lookups stay O(1)
+    instead of O(pool).
     """
 
-    __slots__ = ("values", "id", "_table_cache")
+    __slots__ = ("values", "id", "_table_cache", "_fp")
 
     def __init__(self, values: np.ndarray):
         values = np.asarray(values, dtype=object)
@@ -51,6 +62,23 @@ class Dictionary:
             raise ValueError("dictionary must be sorted")
         self.values = values
         self.id = next(_dict_ids)
+        self._fp = None   # lazy content fingerprint
+
+    @property
+    def fingerprint(self) -> bytes:
+        """Content digest of the pool (computed once, on first use):
+        the jit-static identity of this dictionary."""
+        fp = self._fp
+        if fp is None:
+            import hashlib
+            h = hashlib.blake2b(digest_size=16)
+            for s in self.values:
+                b = s.encode("utf-8", "surrogatepass") \
+                    if isinstance(s, str) else repr(s).encode()
+                h.update(len(b).to_bytes(4, "little"))
+                h.update(b)
+            fp = self._fp = h.digest()
+        return fp
 
     @classmethod
     def build(cls, strings: Sequence[str]) -> Tuple["Dictionary", np.ndarray]:
@@ -96,10 +124,14 @@ class Dictionary:
         return len(self.values)
 
     def __hash__(self):
-        return self.id
+        return hash(self.fingerprint)
 
     def __eq__(self, other):
-        return self is other
+        if self is other:
+            return True
+        if not isinstance(other, Dictionary):
+            return NotImplemented
+        return self.fingerprint == other.fingerprint
 
     def __repr__(self):  # pragma: no cover
         return f"Dictionary(id={self.id}, n={len(self.values)})"
@@ -448,7 +480,7 @@ def concat_pages(pages: Sequence[Page]) -> Page:
     total = sum(counts)
     for ci in range(ncols):
         ref = pages[0].column(ci)
-        if any(p.column(ci).dictionary is not ref.dictionary for p in pages):
+        if any(p.column(ci).dictionary != ref.dictionary for p in pages):
             raise ValueError(
                 f"column {ci}: pages use different dictionaries; re-encode "
                 "to a shared dictionary before concatenating")
@@ -504,7 +536,7 @@ def device_concat(pages: Sequence[Page]) -> Page:
     ncols = pages[0].num_columns
     for ci in range(ncols):
         ref = pages[0].column(ci)
-        if any(p.column(ci).dictionary is not ref.dictionary for p in pages):
+        if any(p.column(ci).dictionary != ref.dictionary for p in pages):
             raise ValueError(
                 f"column {ci}: pages use different dictionaries; re-encode "
                 "to a shared dictionary before concatenating")

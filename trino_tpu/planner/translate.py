@@ -12,8 +12,8 @@ import dataclasses
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from trino_tpu import types as T
-from trino_tpu.expr.ir import (Call, Literal, RowExpression, SpecialForm,
-                               SpecialKind, SymbolRef)
+from trino_tpu.expr.ir import (BoundParam, Call, Literal, RowExpression,
+                               SpecialForm, SpecialKind, SymbolRef)
 from trino_tpu.expr.functions import days_from_civil
 from trino_tpu.sql import tree as t
 from trino_tpu.sql.analyzer import (SemanticError, arithmetic_call,
@@ -216,6 +216,21 @@ class ExpressionTranslator:
                 raise SemanticError(f"current_{node.function.lower()} "
                                     "not available here")
             return Literal(self.session.start_date, T.DATE)
+        if isinstance(node, t.Parameter):
+            # a `?` marker: only plannable under EXECUTE ... USING, which
+            # stashes the bound value types on the session before planning
+            # (ParameterRewriter analog — the plan stays value-free, so
+            # the plan cache reuses it across executions)
+            types = getattr(self.session, "param_types", None) \
+                if self.session is not None else None
+            if types is None:
+                raise SemanticError(
+                    "parameters are only supported in EXECUTE ... USING")
+            if node.position >= len(types):
+                raise SemanticError(
+                    f"parameter ?{node.position + 1} has no bound value "
+                    f"({len(types)} provided)")
+            return BoundParam(node.position, types[node.position])
         # ------------------------------------------------------- references
         if isinstance(node, t.Identifier):
             return self._column((node.value,))

@@ -57,6 +57,12 @@ _SET_SESSION = re.compile(r"^\s*set\s+session\s+(\w+)\s*=\s*(.+?)\s*$",
                           re.IGNORECASE | re.DOTALL)
 _RESET_SESSION = re.compile(r"^\s*reset\s+session\s+(\w+)\s*$",
                             re.IGNORECASE)
+_PREPARE = re.compile(
+    r'^\s*prepare\s+("(?:[^"]|"")*"|\w+)\s+from\s+(.+?)\s*$',
+    re.IGNORECASE | re.DOTALL)
+_DEALLOCATE = re.compile(
+    r'^\s*deallocate\s+prepare\s+("(?:[^"]|"")*"|\w+)\s*$',
+    re.IGNORECASE)
 
 
 class _Query:
@@ -71,6 +77,12 @@ class _Query:
         self.update_type: Optional[str] = None
         self.set_session: Optional[tuple] = None
         self.clear_session: Optional[str] = None
+        # prepared-statement protocol state (StatementClientV1): a
+        # PREPARE echoes (name, sql) back via X-Trino-Added-Prepare so
+        # the stateless client re-sends it per request; DEALLOCATE
+        # echoes the name via X-Trino-Deallocated-Prepare
+        self.added_prepare: Optional[tuple] = None
+        self.deallocated_prepare: Optional[str] = None
         self.cancelled = False
         # crossed by threads: DELETE (HTTP) sets it, the runner's
         # cooperative checkpoints (executor thread) observe it
@@ -96,8 +108,22 @@ class TrinoServer:
                  max_running: int = 4,
                  resource_groups: Optional[ResourceGroupManager] = None,
                  resource_groups_path: Optional[str] = None,
-                 compilation_cache_dir: Optional[str] = None):
+                 compilation_cache_dir: Optional[str] = None,
+                 plan_cache_max_entries: Optional[int] = None):
         self.runner = runner
+        # server-level plan-cache sizing: per-request X-Trino-Session
+        # headers land on `for_query()` clones, which never resize the
+        # SHARED cache (one client must not evict everyone's warm plans),
+        # so the deployment bound is a constructor parameter on the
+        # owning runner. The session property is set too: if the base
+        # runner ever plans directly, its miss path re-reads the property
+        # and must not snap the bound back to the default.
+        if plan_cache_max_entries is not None:
+            runner.session.set("plan_cache_max_entries",
+                               int(plan_cache_max_entries))
+            # resize (under the cache lock), not a bare attribute write:
+            # a shrink over an already-warm runner must evict now
+            runner._plan_cache.resize(int(plan_cache_max_entries))
         # cross-process compile reuse: point XLA's on-disk cache at the
         # given directory (or $TRINO_TPU_COMPILATION_CACHE_DIR) so a cold
         # server start reloads compiled executables instead of recompiling
@@ -288,6 +314,28 @@ class TrinoServer:
         except ValueError:
             pass    # lost the race to a concurrent terminal transition
 
+    @staticmethod
+    def _apply_prepared_header(runner, headers: dict) -> None:
+        """X-Trino-Prepared-Statement: comma-separated name=value pairs,
+        both URL-encoded, each value a statement's SQL — the stateless
+        client re-sends every prepared statement per request
+        (ProtocolHeaders.requestPreparedStatement). Applied to a PRIVATE
+        overlay of the runner's prepared map, so concurrent clients'
+        names never collide server-side."""
+        from urllib.parse import unquote
+        from trino_tpu.sql import parse_statement
+        # overlay even when the header is absent: a PREPARE executed by
+        # this query must not leak into the shared base map (the client
+        # gets it back via X-Trino-Added-Prepare instead)
+        runner._prepared = dict(runner._prepared)
+        header = headers.get("x-trino-prepared-statement", "")
+        for part in header.split(","):
+            if "=" not in part:
+                continue
+            name, _, enc = part.partition("=")
+            runner._prepared[unquote(name.strip())] = \
+                parse_statement(unquote(enc.strip()))
+
     def _execute(self, q: _Query) -> None:
         headers = q.headers
         # per-query runner clone: a PRIVATE session over the shared
@@ -313,6 +361,7 @@ class TrinoServer:
                 # pre-coercion contract, where the raw string failed at
                 # execute(), kept the same visibility
                 session.set(k, v)
+            self._apply_prepared_header(runner, headers)
             # the runner builds the query's deadline AFTER the session
             # overrides apply (so header-sent limits bind), from the
             # submit time (query_max_run_time counts queueing) capped
@@ -331,6 +380,24 @@ class TrinoServer:
             if m:
                 q.update_type = "RESET SESSION"
                 q.clear_session = m.group(1)
+            m = _PREPARE.match(q.sql)
+            if m:
+                q.update_type = "PREPARE"
+                # echo the PARSER-normalized name (unquoted identifiers
+                # lowercase, quoted verbatim): the stateless client
+                # re-sends this key per request and EXECUTE resolves
+                # names through the parser again, so echoing the raw
+                # capture would install a key EXECUTE can never find.
+                # The statement text rides from the regex (the AST can't
+                # be un-parsed back to SQL).
+                from trino_tpu.sql import parse_statement
+                q.added_prepare = (parse_statement(q.sql).name.value,
+                                   m.group(2).strip())
+            m = _DEALLOCATE.match(q.sql)
+            if m:
+                q.update_type = "DEALLOCATE"
+                from trino_tpu.sql import parse_statement
+                q.deallocated_prepare = parse_statement(q.sql).name.value
             # publish LAST: a concurrently-polling client that sees
             # q.result must also see update_type/set_session (else the
             # X-Trino-Set-Session header is lost)
@@ -430,6 +497,18 @@ class TrinoServer:
                 if q is not None and q.clear_session is not None:
                     self.send_header("X-Trino-Clear-Session",
                                      q.clear_session)
+                if q is not None and q.added_prepare is not None:
+                    from urllib.parse import quote
+                    name, stmt_sql = q.added_prepare
+                    self.send_header(
+                        "X-Trino-Added-Prepare",
+                        f"{quote(name, safe='')}="
+                        f"{quote(stmt_sql, safe='')}")
+                if q is not None and q.deallocated_prepare is not None:
+                    from urllib.parse import quote
+                    self.send_header("X-Trino-Deallocated-Prepare",
+                                     quote(q.deallocated_prepare,
+                                           safe=""))
                 self.end_headers()
                 self.wfile.write(body)
 

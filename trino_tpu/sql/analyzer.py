@@ -407,3 +407,66 @@ def resolve_aggregate(name: str, arg_types: Sequence[T.Type]
         return ResolvedFunction("map_agg", args,
                                 T.MapType(key=args[0], value=args[1]))
     raise SemanticError(f"unknown aggregate: {name}()")
+
+
+# ------------------------------------------- prepared-statement parameters
+#
+# PREPARE stores the raw AST with `?` markers (sql/tree.Parameter, lexer-
+# numbered left to right); EXECUTE ... USING binds one constant per marker.
+# The checks here are the ExpressionAnalyzer.analyzeParameters slice: arity
+# must match exactly, and each bound value must be a constant whose type
+# the comparison/coercion rules can place in the marker's context (the
+# context check itself happens during planning, where a mis-typed
+# parameter fails the same way a mis-typed literal would — e.g. "cannot
+# compare decimal(12,2) with varchar").
+
+
+def count_parameters(stmt) -> int:
+    """Number of `?` markers in a statement AST (markers are numbered
+    contiguously by the lexer, so the count is max position + 1)."""
+    from trino_tpu.sql import tree as t
+
+    return 1 + max((n.position for n in t.walk(stmt)
+                    if isinstance(n, t.Parameter)), default=-1)
+
+
+def check_execute_arity(name: str, markers: int, provided: int) -> None:
+    """EXECUTE ... USING arity: one value per marker, no extras
+    (io.trino.sql.analyzer: "Incorrect number of parameters")."""
+    if markers != provided:
+        raise SemanticError(
+            f"incorrect number of parameters for prepared statement "
+            f"'{name}': expected {markers} but found {provided}")
+
+
+def substitute_parameters(stmt, parameters):
+    """Rebuild a statement AST with each `?` marker replaced by its bound
+    value EXPRESSION — the non-cached execution path (DDL/INSERT prepared
+    statements, and any runner that plans per execution). Equivalent to
+    re-parsing the statement with the values spliced in."""
+    import dataclasses as _dc
+
+    from trino_tpu.sql import tree as t
+
+    def walk(x):
+        if isinstance(x, t.Parameter):
+            if x.position >= len(parameters):
+                raise SemanticError(
+                    f"parameter ?{x.position + 1} has no bound value")
+            return parameters[x.position]
+        if _dc.is_dataclass(x) and isinstance(x, t.Node):
+            changed = False
+            fields = {}
+            for f in _dc.fields(x):
+                old = getattr(x, f.name)
+                new = walk(old)
+                fields[f.name] = new
+                changed = changed or new is not old
+            return _dc.replace(x, **fields) if changed else x
+        if isinstance(x, tuple):
+            out = tuple(walk(item) for item in x)
+            return out if any(a is not b for a, b in zip(out, x)) else x
+        if isinstance(x, list):
+            return [walk(item) for item in x]
+        return x
+    return walk(stmt)
