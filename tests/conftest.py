@@ -3,6 +3,13 @@
 Multi-chip hardware is not available in CI; sharding tests exercise a virtual
 8-device CPU mesh (mirrors how the driver dry-runs dryrun_multichip). Must be
 set before jax initializes — conftest is imported before any test module.
+
+The `mesh` marker (pytest.ini) tags the multi-chip sharded-execution suite
+(tests/test_mesh_queries.py): under this conftest it runs inline on the
+forced 8-device mesh; collected into a process whose backend came up with
+fewer devices, the module re-runs itself in a subprocess with
+XLA_FLAGS=--xla_force_host_platform_device_count=8 — either way tier-1
+exercises the sharded path without a TPU.
 """
 
 import os

@@ -219,3 +219,33 @@ def test_leak_detector_warns_and_counts():
         "SELECT leaked_bytes FROM system.runtime.queries "
         "WHERE leaked_bytes > 0").rows
     assert rows and rows[0][0] > 0
+
+
+def test_per_device_enforcement_for_measured_budgets():
+    """A MEASURED pool limit is one chip's HBM: device-hinted
+    reservations enforce against that chip's running total, so a mesh
+    query staging n shards of size ~limit/n each fits even though the
+    cross-chip SUM exceeds the single-chip limit. Hand-set limits keep
+    the historical global-sum enforcement (the chaos-test contract)."""
+    from trino_tpu.exec.memory import (ClusterOutOfMemoryError,
+                                       NodeMemoryPool, QueryMemoryContext)
+    pool = NodeMemoryPool(limit_bytes=1000, killer_policy="none")
+    pool.enforce_per_device = True
+    ctx = QueryMemoryContext(None, pool=pool, wait_s=0.0)
+    try:
+        for shard in range(8):
+            ctx.reserve(800, "mesh-stage", device=shard)   # sum = 6400
+        assert pool.reserved == 6400
+        assert all(pool.device_reserved[d] == 800 for d in range(8))
+        # the same chip overflowing ITS budget still fails
+        with pytest.raises(ClusterOutOfMemoryError):
+            ctx.reserve(300, "mesh-stage", device=0)
+        # global-sum enforcement for un-hinted reservations is unchanged
+        with pytest.raises(ClusterOutOfMemoryError):
+            ctx.reserve(10, "collect")
+        for shard in range(8):
+            ctx.free(800, "mesh-stage", device=shard)
+        assert pool.reserved == 0
+        assert all(v == 0 for v in pool.device_reserved.values())
+    finally:
+        assert ctx.close() == 0

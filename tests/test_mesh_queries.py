@@ -1,0 +1,209 @@
+"""Multi-chip sharded execution on the 8-device CPU mesh.
+
+The tier-1 proof of the co-scheduled mesh path (exec/mesh_exec.py):
+q1/q3/q5/q9 execute sharded end-to-end — leaf scans one-shard-per-device,
+joins/aggregations per shard, inter-fragment repartitioning as in-program
+collectives — and must match BOTH the single-device engine and the sqlite
+oracle, with the new exchange counters proving zero host-page staging
+(every exchange 'fused', none 'staged'). Plus the skew-aware join path
+and per-chip pool accounting.
+
+Marker + subprocess wiring (pytest.ini `mesh`): these tests need an
+8-device mesh. tests/conftest.py forces
+XLA_FLAGS=--xla_force_host_platform_device_count=8 before jax
+initializes, so under tier-1 they run inline; when collected into a
+process whose backend came up with fewer devices (user XLA_FLAGS,
+pre-initialized jax), the module re-runs ITSELF in a subprocess with the
+forced 8-device CPU mesh instead of skipping — tier-1 exercises the
+sharded path without a TPU either way.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import pytest
+
+pytestmark = pytest.mark.mesh
+
+_REQUIRED_DEVICES = 8
+
+
+def _inline() -> bool:
+    return len(jax.devices()) >= _REQUIRED_DEVICES or \
+        bool(os.environ.get("TRINO_TPU_MESH_SUBPROC"))
+
+
+if not _inline():
+    _RESULT = {}
+
+    def _subprocess_suite():
+        """Run this module once in a subprocess with the forced 8-device
+        CPU mesh; cache the result for every collected test."""
+        if "rc" not in _RESULT:
+            env = dict(os.environ)
+            env["TRINO_TPU_MESH_SUBPROC"] = "1"
+            env["JAX_PLATFORMS"] = "cpu"
+            # replace (not append) any existing device-count flag: the
+            # subprocess must come up with exactly the required mesh
+            flags = [f for f in env.get("XLA_FLAGS", "").split()
+                     if not f.startswith(
+                         "--xla_force_host_platform_device_count")]
+            flags.append("--xla_force_host_platform_device_count="
+                         f"{_REQUIRED_DEVICES}")
+            env["XLA_FLAGS"] = " ".join(flags)
+            proc = subprocess.run(
+                [sys.executable, "-m", "pytest", os.path.abspath(__file__),
+                 "-q", "-p", "no:cacheprovider"],
+                env=env, capture_output=True, text=True, timeout=1800,
+                cwd=os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))))
+            _RESULT["rc"] = proc.returncode
+            _RESULT["tail"] = (proc.stdout[-4000:] + "\n"
+                               + proc.stderr[-2000:])
+        return _RESULT
+
+    def test_mesh_suite_in_subprocess():
+        got = _subprocess_suite()
+        assert got["rc"] == 0, got["tail"]
+
+else:
+    import numpy as np
+
+    from trino_tpu.exec import LocalQueryRunner
+    from trino_tpu.exec.distributed import DistributedQueryRunner
+
+    from oracle import assert_same, load_tpch_sqlite
+    from tpch_sql import QUERIES
+
+    MESH_QUERIES = ["q1", "q3", "q5", "q9"]
+
+    @pytest.fixture(scope="module")
+    def local():
+        return LocalQueryRunner.tpch("tiny")
+
+    @pytest.fixture(scope="module")
+    def dist():
+        return DistributedQueryRunner.tpch("tiny")
+
+    @pytest.fixture(scope="module")
+    def oracle():
+        conn = load_tpch_sqlite(0.01)
+        yield conn
+        conn.close()
+
+    @pytest.mark.parametrize("name", MESH_QUERIES)
+    def test_mesh_sharded_end_to_end(local, dist, oracle, name):
+        """q1/q3/q5/q9 sharded over 8 devices: row parity vs the
+        single-device engine AND the sqlite oracle, with every
+        inter-fragment exchange fused into the co-scheduled program
+        (zero host-page exchanges — the acceptance criterion)."""
+        engine_sql, oracle_sql, ordered = QUERIES[name]
+        got = dist.execute(engine_sql)
+        st = dist.last_query_stats
+        assert st["mesh_devices"] == _REQUIRED_DEVICES, st
+        assert st["exchanges_fused"] > 0, st
+        assert st["exchanges_staged"] == 0, st
+        assert st["exchange_rows"] > 0 and st["exchange_bytes"] > 0, st
+
+        expect = local.execute(engine_sql)
+        assert got.column_names == expect.column_names
+        assert_same(got.rows, expect.rows, ordered)
+        expected = oracle.execute(oracle_sql or engine_sql).fetchall()
+        assert_same(got.rows, expected, ordered)
+
+    def test_mesh_partitioned_join_fused(local, dist):
+        """Forced PARTITIONED distribution: both join inputs repartition
+        on the clause keys and the exchange pair fuses into the join
+        program (the skew-aware pair when enabled)."""
+        dist.execute("SET SESSION join_distribution_type = 'PARTITIONED'")
+        try:
+            sql = ("SELECT c_mktsegment, count(*), sum(o_totalprice) "
+                   "FROM customer, orders WHERE c_custkey = o_custkey "
+                   "GROUP BY c_mktsegment")
+            got = dist.execute(sql)
+            st = dist.last_query_stats
+            assert st["exchanges_staged"] == 0, st
+            assert st["exchanges_fused"] >= 3, st   # probe + build + agg
+            expect = local.execute(sql)
+            assert_same(got.rows, expect.rows, False)
+        finally:
+            dist.execute("RESET SESSION join_distribution_type")
+
+    def test_mesh_skewed_key_join(local, dist):
+        """Skewed-key join through the spread/replicate exchange pair:
+        lineitem's l_orderkey distribution is skewed by construction of
+        the filter (one hot orderkey family via small key space after
+        modulo is not available, so force skew handling by shrinking the
+        heavy-hitter threshold is implicit — correctness must hold with
+        skew handling ON and OFF and results must be identical)."""
+        dist.execute("SET SESSION join_distribution_type = 'PARTITIONED'")
+        sql = ("SELECT l_linestatus, count(*) FROM lineitem, orders "
+               "WHERE l_orderkey = o_orderkey GROUP BY l_linestatus")
+        try:
+            expect = local.execute(sql)
+            got_skew = dist.execute(sql)
+            st = dist.last_query_stats
+            assert st["exchanges_staged"] == 0, st
+            assert_same(got_skew.rows, expect.rows, False)
+            dist.execute("SET SESSION skewed_exchange_enabled = false")
+            got_plain = dist.execute(sql)
+            assert_same(got_plain.rows, expect.rows, False)
+            assert sorted(got_skew.rows) == sorted(got_plain.rows)
+        finally:
+            dist.execute("RESET SESSION skewed_exchange_enabled")
+            dist.execute("RESET SESSION join_distribution_type")
+
+    def test_mesh_group_by_strategy_by_ndv(dist):
+        """CBO strategy selection ("Global Hash Tables Strike Back"):
+        low-NDV GROUP BY gathers tiny partial states (global strategy, no
+        all_to_all); high-NDV GROUP BY repartitions (partitioned
+        strategy). Observable through the distributed plan text."""
+        low = dist.execute(
+            "EXPLAIN (TYPE DISTRIBUTED) SELECT l_returnflag, count(*) "
+            "FROM lineitem GROUP BY l_returnflag").only_value()
+        high = dist.execute(
+            "EXPLAIN (TYPE DISTRIBUTED) SELECT l_orderkey, count(*) "
+            "FROM lineitem GROUP BY l_orderkey").only_value()
+        assert "gather" in low and "repartition" not in low, low
+        assert "repartition" in high, high
+
+    def test_mesh_per_chip_pool_accounting(dist):
+        """Sharded staging attributes reservations per chip: after a
+        mesh query, every device shows a nonzero peak and the node-pool
+        per-device gauges surface in system.runtime.nodes."""
+        from trino_tpu.exec.memory import NODE_POOL
+        dist.execute("SELECT count(*) FROM lineitem")
+        peaks = [NODE_POOL.device_peak.get(i, 0)
+                 for i in range(_REQUIRED_DEVICES)]
+        assert all(p > 0 for p in peaks), peaks
+        rows = dist.execute(
+            "SELECT node_id, pool_budget_source, device_peak_bytes "
+            "FROM system.runtime.nodes").rows
+        assert len(rows) == _REQUIRED_DEVICES
+        assert all(r[1] in ("default", "measured") for r in rows)
+        assert any(r[2] > 0 for r in rows), rows
+
+    def test_mesh_query_info_carries_mesh_shape(dist):
+        from trino_tpu.exec.query_tracker import TRACKER
+        sql = "SELECT count(*) AS mesh_shape_probe FROM nation"
+        dist.execute(sql)
+        info = next(q for q in TRACKER.list() if q.query == sql)
+        assert info.mesh == f"workers:{_REQUIRED_DEVICES}"
+        assert info.stats["mesh_devices"] == _REQUIRED_DEVICES
+
+    def test_mesh_fallback_still_correct(local, dist):
+        """mesh_execution=false pins the dispatch-loop path; results
+        must match and the exchange counters must read 'staged'."""
+        sql = ("SELECT o_orderpriority, count(*) FROM orders "
+               "GROUP BY o_orderpriority")
+        dist.execute("SET SESSION mesh_execution = false")
+        try:
+            got = dist.execute(sql)
+            st = dist.last_query_stats
+            assert st["exchanges_fused"] == 0, st
+            assert st["exchanges_staged"] > 0, st
+            assert_same(got.rows, local.execute(sql).rows, False)
+        finally:
+            dist.execute("RESET SESSION mesh_execution")

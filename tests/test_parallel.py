@@ -15,8 +15,9 @@ import pytest
 from trino_tpu import types as T
 from trino_tpu.ops import AggSpec, Step, hash_aggregate
 from trino_tpu.page import Column, Page
-from trino_tpu.parallel import (QueryMesh, all_to_all_by_key, broadcast_page,
-                                gather_page)
+from trino_tpu.parallel import (QueryMesh, all_to_all_by_key,
+                                all_to_all_replicate, broadcast_page,
+                                detect_heavy_keys, gather_page)
 
 pytestmark = pytest.mark.skipif(
     len(jax.devices()) < 2, reason="needs multi-device mesh")
@@ -103,6 +104,86 @@ def test_broadcast_and_gather():
         rows = list(zip(np.asarray(host.columns[0].values[s])[:n].tolist(),
                         np.asarray(host.columns[1].values[s])[:n].tolist()))
         assert sorted(rows) == sorted(all_rows)
+
+
+def make_skewed_pages(n_shards, cap, hot_key=7, hot_frac=0.7, key_mod=40):
+    rng = np.random.default_rng(11)
+    pages, all_rows = [], []
+    for s in range(n_shards):
+        keys = rng.integers(0, key_mod, cap).astype(np.int64)
+        keys[: int(cap * hot_frac)] = hot_key
+        vals = rng.integers(0, 1000, cap).astype(np.int64)
+        pages.append(Page((Column.from_numpy(keys, T.BIGINT),
+                           Column.from_numpy(vals, T.BIGINT)), cap))
+        all_rows += list(zip(keys.tolist(), vals.tolist()))
+    return pages, all_rows
+
+
+def test_heavy_hitter_detection_and_spread():
+    """JSPIM skew handling, probe half: detect_heavy_keys finds the hot
+    key in-program; spread-mode all_to_all round-robins its rows so no
+    shard receives the whole hot key, while rows are conserved."""
+    mesh = QueryMesh()
+    cap = 256
+    pages, all_rows = make_skewed_pages(mesh.n, cap)
+    global_page = mesh.shard_pages(pages)
+
+    def stage(page):
+        heavy = detect_heavy_keys(page, [0], 8, 64)
+        out, overflow = all_to_all_by_key(page, [0], 2 * cap, heavy=heavy)
+        return out, overflow, heavy
+
+    out, overflow, heavy = jax.jit(mesh.shard_map(stage))(global_page)
+    assert int(np.max(np.asarray(overflow))) == 0
+    hv = np.asarray(jax.device_get(heavy))[0]
+    assert 7 in hv.astype(np.int64), hv
+    host = jax.device_get(out)
+    received, per_shard = [], []
+    for s in range(mesh.n):
+        n = int(host.num_rows[s])
+        ks = np.asarray(host.columns[0].values[s])[:n]
+        vs = np.asarray(host.columns[1].values[s])[:n]
+        received += list(zip(ks.tolist(), vs.tolist()))
+        per_shard.append(n)
+    assert sorted(received) == sorted(all_rows)
+    # plain hashing would land every hot-key row (70% of all rows) on ONE
+    # shard; spread mode must keep every shard under half the total
+    assert max(per_shard) < 0.5 * mesh.n * cap, per_shard
+
+
+def test_replicate_heavy_build_rows():
+    """JSPIM skew handling, build half: rows of heavy keys replicate to
+    every shard (each spread probe row must still see all of its key's
+    build rows); non-heavy rows hash-route exactly once."""
+    mesh = QueryMesh()
+    cap = 64
+    hot = jnp.asarray(np.array([7], dtype=np.uint64))
+    heavy = jnp.concatenate([
+        hot, jnp.full((7,), 0xFFFFFFFFFFFFFFFF, dtype=jnp.uint64)])
+    pages, all_rows = [], []
+    for s in range(mesh.n):
+        keys = np.arange(s * 16, s * 16 + 16).astype(np.int64)
+        keys[0] = 7
+        vals = keys * 10 + s
+        pages.append(Page((Column.from_numpy(keys, T.BIGINT),
+                           Column.from_numpy(vals, T.BIGINT)), 16))
+        all_rows += list(zip(keys.tolist(), vals.tolist()))
+    global_page = mesh.shard_pages(pages)
+
+    fn = jax.jit(mesh.shard_map(
+        lambda p: all_to_all_replicate(p, [0], 4 * cap, heavy)))
+    out, overflow = fn(global_page)
+    assert int(np.max(np.asarray(overflow))) == 0
+    host = jax.device_get(out)
+    n_hot = sum(1 for k, _ in all_rows if k == 7)
+    others = []
+    for s in range(mesh.n):
+        n = int(host.num_rows[s])
+        ks = np.asarray(host.columns[0].values[s])[:n]
+        vs = np.asarray(host.columns[1].values[s])[:n]
+        assert int((ks == 7).sum()) == n_hot, (s, n_hot)
+        others += [(int(a), int(b)) for a, b in zip(ks, vs) if a != 7]
+    assert sorted(others) == sorted((k, v) for k, v in all_rows if k != 7)
 
 
 def test_distributed_group_by_matches_local():

@@ -41,7 +41,10 @@ TABLES: Dict[str, tuple] = {
         ("coordinator", T.BOOLEAN), ("state", T.VarcharType()),
         ("pool_limit_bytes", T.BIGINT), ("pool_reserved_bytes", T.BIGINT),
         ("pool_peak_bytes", T.BIGINT), ("pool_kills", T.BIGINT),
-        ("pool_leaks", T.BIGINT), ("pool_leaked_bytes", T.BIGINT)),
+        ("pool_leaks", T.BIGINT), ("pool_leaked_bytes", T.BIGINT),
+        ("pool_budget_source", T.VarcharType()),
+        ("device_reserved_bytes", T.BIGINT),
+        ("device_peak_bytes", T.BIGINT)),
     "resource_groups": (
         ("name", T.VarcharType()), ("parent", T.VarcharType()),
         ("queued", T.BIGINT), ("running", T.BIGINT),
@@ -87,12 +90,18 @@ def _rows_for(table: str) -> List[tuple]:
             devices = jax.devices()
         except Exception:
             devices = []
-        # the pool columns repeat per device row: the node pool is the
-        # single-controller process's budget, not per-chip
+        # the pool columns repeat per device row (the node pool is the
+        # single-controller process's per-chip budget + source); the
+        # device_* columns are THAT chip's attributed reservations, fed
+        # by mesh shard executors and sharded staging
         pool = (NODE_POOL.limit or 0, NODE_POOL.reserved, NODE_POOL.peak,
-                NODE_POOL.kills, NODE_POOL.leaks, NODE_POOL.leaked_bytes)
+                NODE_POOL.kills, NODE_POOL.leaks, NODE_POOL.leaked_bytes,
+                NODE_POOL.budget_source)
         return [(f"{d.platform}-{d.id}", jax.__version__, d.id == 0,
-                 "active") + pool for d in devices]
+                 "active") + pool
+                + (NODE_POOL.device_reserved.get(i, 0),
+                   NODE_POOL.device_peak.get(i, 0))
+                for i, d in enumerate(devices)]
     if table == "resource_groups":
         from trino_tpu.exec.resource_groups import list_all_groups
         return [(g.name,

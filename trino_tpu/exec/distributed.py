@@ -77,6 +77,7 @@ class ShardExecutionPlanner(LocalExecutionPlanner):
         self.shard = shard
         self.n_shards = n_shards
         self.exchange_inputs = exchange_inputs
+        self.mem_device = shard   # per-chip reservation attribution
         # the mesh device this task's pipelines run on: leaf pages are
         # placed here, and every downstream kernel follows its inputs, so
         # per-shard work queues on per-device streams and OVERLAPS across
@@ -104,17 +105,7 @@ class ShardExecutionPlanner(LocalExecutionPlanner):
         return PageStream(gen(), tuple(s for s, _ in node.assignments))
 
     def _split_capacity(self, conn, node: TableScanNode, splits) -> int:
-        cap = self.page_capacity
-        try:
-            stats = conn.metadata.get_table_statistics(node.table)
-            rows = int(stats.row_count) if stats and stats.row_count else 0
-        except Exception:
-            rows = 0
-        per_split = math.ceil(rows / max(1, len(splits)))
-        if per_split > cap:
-            max_cap = int(self.session.get("scan_page_capacity"))
-            cap = min(_next_pow2(per_split), max_cap)
-        return cap
+        return split_scan_capacity(self.session, conn, node, splits)
 
     def _exec_ValuesNode(self, node: ValuesNode) -> PageStream:
         if self.shard != 0:
@@ -229,23 +220,31 @@ class DistributedQueryRunner(LocalQueryRunner):
         super().__init__(session)
         self.mesh = QueryMesh(devices)
         self._exchange_jits: Dict[tuple, object] = {}
+        # size the node pool from the backend's measured per-device
+        # memory (TPU HBM minus scan-cache budget); no-op on CPU, which
+        # keeps the static default (exec/memory.autosize_node_pool)
+        from trino_tpu.exec.memory import autosize_node_pool
+        autosize_node_pool()
 
     @classmethod
     def tpch(cls, schema: str = "tiny",
              devices: Optional[Sequence] = None) -> "DistributedQueryRunner":
-        from trino_tpu.connector import (blackhole, memory, tpcds,
+        from trino_tpu.connector import (blackhole, memory, system, tpcds,
                                          tpch as tpch_conn)
         runner = cls(Session(catalog="tpch", schema=schema), devices)
         runner.catalogs.register("tpch", tpch_conn.create_connector())
         runner.catalogs.register("tpcds", tpcds.create_connector())
         runner.catalogs.register("memory", memory.create_connector())
         runner.catalogs.register("blackhole", blackhole.create_connector())
+        runner.catalogs.register("system", system.create_connector())
         return runner
 
     # ------------------------------------------------------------ execute
 
     def _execute_query(self, query: t.Query) -> MaterializedResult:
         plan = self._plan_query(query)   # through the plan cache
+        if self._collector is not None:
+            self._collector.mesh_devices = self.mesh.n
         with self._phase("execution"):
             frag = fragment_plan(plan)
             # children schedule (and retry) independently BEFORE the
@@ -320,11 +319,21 @@ class DistributedQueryRunner(LocalQueryRunner):
                            ) -> Dict[int, List[Optional[Page]]]:
         """Run every child fragment and lower its consuming exchange to a
         collective. Build-before-probe: later sources (join build sides are
-        the right/second child) schedule first (PhasedExecutionSchedule)."""
+        the right/second child) schedule first (PhasedExecutionSchedule).
+
+        Eligible child chains co-schedule first (exec/mesh_exec.py): the
+        whole fragment subtree + its exchange runs as ONE shard_map
+        program and pages never stage through the host. Unsupported
+        shapes fall back to the per-shard dispatch loop below (which
+        recursively offers mesh co-scheduling to ITS children)."""
         exchange_inputs: Dict[int, List[Optional[Page]]] = {}
         for child in reversed(frag.children):
-            child_pages = self._run_fragment_to_pages(child)
             remote = _find_remote(frag.root, child.fragment_id)
+            mesh_pages = self._try_mesh_child(child, remote)
+            if mesh_pages is not None:
+                exchange_inputs[child.fragment_id] = mesh_pages
+                continue
+            child_pages = self._run_fragment_to_pages(child)
             # the exchange apply is its own retry scope: a transient
             # collective failure (or injected fault) re-applies the
             # idempotent collective against the child's buffered output —
@@ -336,12 +345,41 @@ class DistributedQueryRunner(LocalQueryRunner):
                         self._apply_exchange(p, r))
         return exchange_inputs
 
-    def _exchange_span(self, child: PlanFragment, remote):
+    def _try_mesh_child(self, child: PlanFragment, remote
+                        ) -> Optional[List[Optional[Page]]]:
+        """Co-scheduled mesh execution of one child fragment chain, or
+        None to use the dispatch-loop fallback. Disabled under fault
+        injection (chaos must see per-shard sites) and operator-level
+        stats (node-boundary instrumentation needs the Python loop)."""
+        if not bool(self.session.get("mesh_execution")):
+            return None
+        if self.mesh.n < 2:
+            return None
+        if self._faults is not None:
+            return None
+        if self._collector is not None and self._collector.operator_level:
+            return None
+        from trino_tpu.exec import mesh_exec
+        try:
+            with self._frag_span(child,
+                                 f"mesh-fragment-{child.fragment_id}"):
+                pages = mesh_exec.run_co_scheduled(self, child, remote)
+                # the consuming exchange ran INSIDE the program; record
+                # its span (zero own-wall: its time is the fragment's)
+                with self._exchange_span(child, remote, "fused"):
+                    pass
+                return pages
+        except (mesh_exec.MeshUnsupported, NotImplementedError):
+            return None
+
+    def _exchange_span(self, child: PlanFragment, remote,
+                       data_plane: str = "staged"):
         from trino_tpu.obs.stats import maybe_span
         return maybe_span(
             self._collector, f"exchange-{child.fragment_id}",
             kind="exchange",
-            exchange_kind=str(remote.kind).rsplit(".", 1)[-1])
+            exchange_kind=str(remote.kind).rsplit(".", 1)[-1],
+            data_plane=data_plane)
 
     def _run_fragment_to_pages(self, frag: PlanFragment
                                ) -> List[Optional[Page]]:
@@ -399,6 +437,21 @@ class DistributedQueryRunner(LocalQueryRunner):
         if self._faults is not None:
             self._faults.site("exchange", f"fragment-{remote.fragment_id}")
         n = self.mesh.n
+        if self._collector is not None:
+            # 'staged' data plane: the producer ran through the per-shard
+            # dispatch loop and its outputs were re-staged for this
+            # standalone collective (vs. 'fused' in a mesh program).
+            # ONE batched count fetch — a per-page device_get would sync
+            # every shard's stream separately (the transfer discipline
+            # everything else on this path follows)
+            from trino_tpu.exec.memory import live_page_bytes
+            live = [p for p in child_pages if p is not None]
+            counts = [int(c) for c in jax.device_get(
+                [p.num_rows for p in live])]
+            rows = sum(counts)
+            nbytes = sum(live_page_bytes(p, c)
+                         for p, c in zip(live, counts))
+            self._collector.add_exchange("staged", rows, nbytes)
         ref = next((p for p in child_pages if p is not None), None)
         if ref is None:
             return [None] * n
@@ -458,6 +511,24 @@ class DistributedQueryRunner(LocalQueryRunner):
 
 # ---------------------------------------------------------------------------
 # page plumbing for the collective data plane
+
+
+def split_scan_capacity(session, conn, node: TableScanNode, splits) -> int:
+    """Scan page capacity for a sharded split set: the session page
+    floor, grown to the per-split row envelope up to scan_page_capacity.
+    Shared by the per-shard dispatch loop and mesh staging so the two
+    data planes size identical pages for the same query."""
+    cap = int(session.get("page_capacity"))
+    try:
+        stats = conn.metadata.get_table_statistics(node.table)
+        rows = int(stats.row_count) if stats and stats.row_count else 0
+    except Exception:
+        rows = 0
+    per_split = math.ceil(rows / max(1, len(splits)))
+    if per_split > cap:
+        max_cap = int(session.get("scan_page_capacity"))
+        cap = min(_next_pow2(per_split), max_cap)
+    return cap
 
 
 def _find_remote(node, fragment_id: int) -> RemoteSourceNode:

@@ -179,6 +179,11 @@ class LocalExecutionPlanner:
         from trino_tpu.exec.memory import QueryMemoryContext
         self.memory = QueryMemoryContext(
             int(session.get("query_max_memory")))
+        # which mesh device this executor's reservations live on (None =
+        # single-device execution): shard executors set their shard index
+        # so the node pool's per-chip gauges attribute HBM to the chip
+        # that actually holds it
+        self.mem_device: Optional[int] = None
         # fault-tolerance wiring (exec/faults.py + exec/deadline.py),
         # installed by the owning runner; None = no chaos / no limits
         self.faults = None
@@ -418,7 +423,8 @@ class LocalExecutionPlanner:
         # chaos site `memory`: injected node-pool pressure at the point a
         # real reservation would hit the killer
         self._fault_site("memory", "collect")
-        self.memory.reserve(page_bytes(page), "collect")
+        self.memory.reserve(page_bytes(page), "collect",
+                            device=self.mem_device)
         return page
 
     def merge_counted(self, pages: List[Page]) -> Optional[Page]:
@@ -540,7 +546,8 @@ class LocalExecutionPlanner:
         build side / sort input ever held)."""
         if page is not None:
             from trino_tpu.exec.memory import page_bytes
-            self.memory.free(page_bytes(page), "collect")
+            self.memory.free(page_bytes(page), "collect",
+                             device=self.mem_device)
 
     def _exec_AggregationNode(self, node: AggregationNode) -> PageStream:
         src = self.execute(node.source)
@@ -831,7 +838,8 @@ class LocalExecutionPlanner:
                 if page is None:
                     return
                 from trino_tpu.exec.memory import page_bytes as _pb
-                self.memory.reserve(_pb(page), "collect")
+                self.memory.reserve(_pb(page), "collect",
+                                    device=self.mem_device)
                 try:
                     yield sort_op(page)
                 finally:
@@ -1145,7 +1153,8 @@ class LocalExecutionPlanner:
                 ("spill-probe", tuple(probe_keys), probe_out_full),
                 lambda: spilled_unique_probe(probe_keys,
                                              probe_out=probe_out_full))
-        self.memory.reserve(held_bytes, "join-spill-keys")
+        self.memory.reserve(held_bytes, "join-spill-keys",
+                            device=self.mem_device)
         post_filter = None if post_pred is None else \
             compile_filter(post_pred)   # called with post_params below
         drop_extra = None
@@ -1177,7 +1186,8 @@ class LocalExecutionPlanner:
                         out = out.filter(post_filter(out, post_params))
                     yield out
         finally:
-            self.memory.free(held_bytes, "join-spill-keys")
+            self.memory.free(held_bytes, "join-spill-keys",
+                             device=self.mem_device)
 
     def _compact_probe(self, pre: Page, found, total: int,
                        live: int) -> Page:

@@ -27,7 +27,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from trino_tpu.errors import (CLUSTER_OUT_OF_MEMORY,
                               EXCEEDED_LOCAL_MEMORY_LIMIT, TrinoError)
@@ -124,6 +124,24 @@ class NodeMemoryPool:
         self.kills = 0          # victims selected by the killer
         self.leaks = 0          # successful queries that ended nonzero
         self.leaked_bytes = 0
+        # where the limit came from: "default" (unbounded / hand-set) or
+        # "measured" (sized from the backend's reported per-device memory
+        # minus the scan-cache budget at startup — autosize_node_pool)
+        self.budget_source = "default"
+        # when True (set by autosize_node_pool), `limit` is ONE chip's
+        # HBM budget and device-hinted reservations are enforced against
+        # THAT chip's running total — a mesh query staging n shards must
+        # not trip a single-chip limit with the cross-chip sum. Hand-set
+        # limits (tests, chaos harnesses, explicit server config) keep
+        # the historical global-sum enforcement.
+        self.enforce_per_device = False
+        # per-chip accounting: reservations carrying a device hint (mesh
+        # shard executors, sharded staging) attribute bytes to the chip
+        # that holds them. The pool `limit` models ONE chip's HBM, so the
+        # per-device gauges are what say whether any single chip is near
+        # its budget. Advisory after attempt rollbacks (like by_tag).
+        self.device_reserved: Dict[int, int] = {}
+        self.device_peak: Dict[int, int] = {}
         self._contexts: Dict[str, "QueryMemoryContext"] = {}
 
     # ------------------------------------------------------- configuration
@@ -169,7 +187,7 @@ class NodeMemoryPool:
     # ----------------------------------------------------------- the pool
 
     def acquire(self, ctx: "QueryMemoryContext", nbytes: int, tag: str,
-                wait_s: float) -> None:
+                wait_s: float, device: Optional[int] = None) -> None:
         """Grant `nbytes` to `ctx` or raise ClusterOutOfMemoryError.
 
         Runs the low-memory killer when the pool would overflow; blocks
@@ -179,9 +197,20 @@ class NodeMemoryPool:
             while True:
                 if ctx.kill_reason is not None:
                     raise ClusterOutOfMemoryError(ctx.kill_reason)
-                if self.limit is None or self.reserved + nbytes <= self.limit:
+                if self.enforce_per_device and device is not None:
+                    # per-chip budget: this chip's total is what the
+                    # limit bounds (the global sum spans n chips' HBM)
+                    current = self.device_reserved.get(device, 0)
+                else:
+                    current = self.reserved
+                if self.limit is None or current + nbytes <= self.limit:
                     self.reserved += nbytes
                     self.peak = max(self.peak, self.reserved)
+                    if device is not None:
+                        d = self.device_reserved.get(device, 0) + nbytes
+                        self.device_reserved[device] = d
+                        self.device_peak[device] = max(
+                            self.device_peak.get(device, 0), d)
                     return
                 # kill at most ONE victim per pressure event: while a
                 # marked victim still holds bytes, spurious wakeups (any
@@ -218,11 +247,14 @@ class NodeMemoryPool:
                         f"/{_fmt_bytes(self.limit)} reserved and no victim "
                         f"released within {wait_s:.1f}s")
 
-    def release(self, nbytes: int) -> None:
+    def release(self, nbytes: int, device: Optional[int] = None) -> None:
         if nbytes <= 0:
             return
         with self._cond:
             self.reserved = max(0, self.reserved - nbytes)
+            if device is not None:
+                self.device_reserved[device] = max(
+                    0, self.device_reserved.get(device, 0) - nbytes)
             self._cond.notify_all()
 
     def reset_context(self, ctx: "QueryMemoryContext") -> None:
@@ -235,6 +267,10 @@ class NodeMemoryPool:
             ctx.reserved = 0
             ctx.kill_reason = None
             self.reserved = max(0, self.reserved - delta)
+            for d, b in ctx.by_device.items():
+                self.device_reserved[d] = max(
+                    0, self.device_reserved.get(d, 0) - b)
+            ctx.by_device.clear()
             self._cond.notify_all()
 
     # ---------------------------------------------------------- the killer
@@ -278,6 +314,53 @@ class NodeMemoryPool:
 NODE_POOL = NodeMemoryPool()
 
 
+def measured_device_memory_bytes() -> Optional[int]:
+    """The backend's reported per-device memory capacity (TPU HBM via
+    device.memory_stats()['bytes_limit']); None when the backend doesn't
+    report (the CPU backend, including the forced 8-device dev mesh)."""
+    try:
+        import jax
+        dev = jax.local_devices()[0]
+        stats = dev.memory_stats()
+        if stats:
+            limit = int(stats.get("bytes_limit") or 0)
+            return limit or None
+    except Exception:
+        return None
+    return None
+
+
+def autosize_node_pool(scan_cache_budget: Optional[int] = None,
+                       pool: Optional[NodeMemoryPool] = None
+                       ) -> Tuple[Optional[int], str]:
+    """Size the node pool from the backend's MEASURED per-device memory
+    at startup (replacing any hand-tuned constant): per-chip budget =
+    measured HBM minus the connector scan-cache budget (the staged-column
+    LRU owns that slice of HBM by design), floored at a quarter of the
+    chip so a misconfigured cache budget can't zero the pool. Backends
+    that don't report capacity (CPU) keep the current static default and
+    return source "default". Returns (limit_bytes, source); the chosen
+    budget and source surface in system.runtime.nodes and /v1/metrics."""
+    pool = pool if pool is not None else NODE_POOL
+    measured = measured_device_memory_bytes()
+    if measured is None:
+        pool.budget_source = "default"
+        return pool.limit, "default"
+    if scan_cache_budget is None:
+        try:
+            from trino_tpu.connector import tpch
+            scan_cache_budget = int(tpch._DEVICE_COL_CACHE_BYTES)
+        except Exception:
+            scan_cache_budget = 0
+    limit = max(measured - int(scan_cache_budget), measured // 4)
+    pool.set_limit(limit)
+    pool.budget_source = "measured"
+    # the measured limit is PER-CHIP HBM: device-hinted reservations
+    # (mesh shards) enforce against their chip's total, not the mesh sum
+    pool.enforce_per_device = True
+    return limit, "measured"
+
+
 class QueryMemoryContext:
     """Single-query reservation ledger checked against query_max_memory,
     mirrored into a NodeMemoryPool when one is attached (the query level
@@ -296,6 +379,7 @@ class QueryMemoryContext:
         self.reserved = 0
         self.peak = 0
         self.by_tag: Dict[str, int] = {}
+        self.by_device: Dict[int, int] = {}
         if not query_id:
             QueryMemoryContext._anon += 1
             query_id = f"ctx_{QueryMemoryContext._anon}"
@@ -307,7 +391,8 @@ class QueryMemoryContext:
         if pool is not None:
             pool.register(self)
 
-    def reserve(self, nbytes: int, tag: str = "operator") -> None:
+    def reserve(self, nbytes: int, tag: str = "operator",
+                device: Optional[int] = None) -> None:
         nbytes = int(nbytes)
         if nbytes <= 0:
             return
@@ -320,19 +405,25 @@ class QueryMemoryContext:
                 f"{_fmt_bytes(nbytes)}, reserved "
                 f"{_fmt_bytes(self.reserved)}]")
         if self.pool is not None:
-            self.pool.acquire(self, nbytes, tag, self.wait_s)
+            self.pool.acquire(self, nbytes, tag, self.wait_s, device)
         self.reserved += nbytes
         self.by_tag[tag] = self.by_tag.get(tag, 0) + nbytes
+        if device is not None:
+            self.by_device[device] = self.by_device.get(device, 0) + nbytes
         self.peak = max(self.peak, self.reserved)
 
-    def free(self, nbytes: int, tag: str = "operator") -> None:
+    def free(self, nbytes: int, tag: str = "operator",
+             device: Optional[int] = None) -> None:
         nbytes = int(nbytes)
         released = min(max(nbytes, 0), self.reserved)
         self.reserved -= released
         if tag in self.by_tag:
             self.by_tag[tag] = max(0, self.by_tag[tag] - nbytes)
+        if device is not None:
+            self.by_device[device] = max(
+                0, self.by_device.get(device, 0) - nbytes)
         if self.pool is not None:
-            self.pool.release(released)
+            self.pool.release(released, device)
 
     def poll(self) -> None:
         """Cooperative kill checkpoint: raise if the low-memory killer (or

@@ -1,0 +1,731 @@
+"""Mesh co-scheduled fragment execution: one XLA program per stage chain.
+
+The tentpole of multi-chip sharded execution. The per-shard dispatch loop
+(exec/distributed.py `_fragment_attempt`) runs each fragment's operator
+pipelines shard-by-shard in Python, stages the per-shard outputs, and
+applies the consuming exchange as a standalone collective. This module
+replaces that for eligible fragment chains: the WHOLE chain — leaf scans
+sharded one-shard-per-chip in HBM, filter/project/join/aggregate kernels
+per shard, and every inter-fragment exchange as an in-program
+`jax.lax.all_to_all` / `all_gather` over the ICI mesh — compiles into ONE
+jitted `shard_map` program. Pages never stage through the host between
+fragments; all shards execute concurrently under a single dispatch.
+
+Reference parity: this is PlanFragmenter's stage tree executed the way
+the SNIPPETS.md references run training steps — `pjit`-style sharding
+annotations (NamedSharding over the workers Mesh, placed by
+QueryMesh.shard_pages) with collectives as the PartitionedOutputOperator
+data plane (SURVEY §7 step 7, the "co-scheduled fragments" round).
+
+Skew (JSPIM): partitioned joins detect globally-heavy probe keys
+in-program and switch the exchange pair to spread(probe)/replicate(build)
+so one hot key cannot overload a chip (parallel/exchange.py).
+
+Strategy selection: partitioned vs. global GROUP BY is decided by the
+CBO at plan time (planner/optimizer._grouped_exchange_kind — "Global
+Hash Tables Strike Back"); this module just executes the chosen exchange.
+
+Static shapes: repartition bucket capacities and join output capacities
+use the engine's overflow-ladder contract — each site returns its
+overflow/true-total as an aux output, and the host re-runs the program
+with that site's capacity doubled until everything fits. Programs are
+keyed in the jit cache on (canonical structure, ladder, mesh size), so a
+repeated query shape dispatches a warm executable.
+
+Fallback: any unsupported node (or chaos/operator-stats runs) raises
+MeshUnsupported and the caller transparently uses the per-shard dispatch
+loop; the obs exchange counters then record 'staged' instead of 'fused'
+exchanges, which is exactly what the mesh test suite asserts against.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.errors import GENERIC_INTERNAL_ERROR, TrinoError
+from trino_tpu.exec.jit_cache import cached_kernel
+from trino_tpu.exec.local_planner import _layout, _next_pow2, lower_expr
+from trino_tpu.expr.compiler import compile_expression, compile_filter
+from trino_tpu.ops import (AggSpec, JoinType, SortKey, Step, hash_aggregate,
+                           hash_join, order_by, top_n)
+from trino_tpu.ops.aggregate import (COLLECT_AGGREGATES, get_aggregate)
+from trino_tpu.page import Column, Page, union_dictionaries
+from trino_tpu.parallel.exchange import (AXIS, all_to_all_by_key,
+                                         all_to_all_replicate,
+                                         broadcast_page, detect_heavy_keys)
+from trino_tpu.planner.nodes import (
+    AggregationNode, AggStep, ExchangeKind, FilterNode, JoinClause,
+    JoinKind, JoinNode, LimitNode, ProjectNode, SemiJoinNode, SortNode,
+    Symbol, TableScanNode, TopNNode, WindowNode)
+from trino_tpu.planner.optimizer import PlanFragment, RemoteSourceNode
+
+
+class MeshUnsupported(Exception):
+    """This fragment chain cannot lower to one mesh program; the caller
+    falls back to the per-shard dispatch loop (not an error)."""
+
+
+class MeshExecutionError(TrinoError):
+    """The co-scheduled program failed to converge (ladder exhausted)."""
+
+    CODE = GENERIC_INTERNAL_ERROR
+
+
+_MAX_LADDER_ROUNDS = 10
+
+
+class _Env:
+    """Per-trace state a lowered closure tree reads: the staged leaf pages
+    (positional) and the capacity ladder; closures deposit per-site aux
+    scalars (overflow counters, true totals, exchanged rows) keyed by
+    static site id — the host reads them back to drive the ladder."""
+
+    def __init__(self, pages: Sequence[Page], ladder: Dict[int, int]):
+        self.pages = list(pages)
+        self.ladder = ladder
+        self.aux: Dict[int, dict] = {}
+
+
+def _page_row_bytes(page: Page) -> int:
+    """Static per-row byte estimate of a page (dtype itemsizes + masks)."""
+    total = 0
+    for c in page.columns:
+        total += c.values.dtype.itemsize
+        if c.valid is not None:
+            total += 1
+    return max(total, 1)
+
+
+def _exchange_aux(env: _Env, site: int, page: Page, extra: dict) -> None:
+    rows = jax.lax.psum(page.num_rows.astype(jnp.int64), AXIS)
+    d = {"rows": rows,
+         "bytes": rows * jnp.int64(_page_row_bytes(page))}
+    d.update(extra)
+    env.aux[site] = d
+
+
+class MeshLowerer:
+    """Lower a PlanFragment tree (+ its consuming exchange) into a single
+    per-shard traced function over staged, sharded leaf pages."""
+
+    def __init__(self, session, metadata, n_shards: int, exec_params=()):
+        self.session = session
+        self.metadata = metadata
+        self.n = n_shards
+        self.exec_params = tuple(exec_params)
+        self.scans: List[TableScanNode] = []
+        self.sites: List[str] = []       # site id -> kind (a2a | join)
+        self.key_parts: List = []        # canonical structure key
+        self.exchange_sites: List[int] = []
+        self._skew = bool(session.get("skewed_exchange_enabled"))
+        self._skew_k = max(1, int(session.get("skew_heavy_key_limit")))
+
+    # ------------------------------------------------------------ plumbing
+
+    def _key(self, *parts) -> None:
+        self.key_parts.append(tuple(parts))
+
+    def _site(self, kind: str) -> int:
+        self.sites.append(kind)
+        return len(self.sites) - 1
+
+    def _expr(self, e, layout, types):
+        """Lower + bind one expression for in-program evaluation. Literals
+        stay baked in (the program key carries them); EXECUTE parameters
+        bind from the statement's values."""
+        from trino_tpu.expr.hoist import materialize_bound
+        return materialize_bound(lower_expr(e, layout, types),
+                                 self.exec_params)
+
+    # ------------------------------------------------------------- entry
+
+    def lower_child(self, frag: PlanFragment, remote: RemoteSourceNode
+                    ) -> Callable:
+        """The co-scheduled unit: child fragment tree + its consuming
+        exchange. Returns fn(env) -> per-shard Page (post-exchange)."""
+        inner = self.lower_node(frag.root, frag)
+        return self._lower_exchange(inner, remote.kind,
+                                    remote.partition_keys, remote.order_by,
+                                    tuple(frag.root.outputs))
+
+    # ----------------------------------------------------------- exchange
+
+    def _lower_exchange(self, inner: Callable, kind: str, partition_keys,
+                        ordering, symbols: Tuple[Symbol, ...]) -> Callable:
+        self._key("exchange", kind,
+                  tuple(s.name for s in partition_keys))
+        if kind == ExchangeKind.REPARTITION:
+            lay = {s.name: i for i, s in enumerate(symbols)}
+            keys = tuple(lay[s.name] for s in partition_keys)
+            site = self._site("a2a")
+            self.exchange_sites.append(site)
+
+            def fn(env: _Env) -> Page:
+                page = inner(env)
+                n = jax.lax.psum(1, AXIS)
+                bucket = env.ladder.get(site) or \
+                    max(1024, _next_pow2(max(1, page.capacity // n)))
+                out, overflow = all_to_all_by_key(page, list(keys), bucket)
+                _exchange_aux(env, site, page,
+                              {"overflow": overflow,
+                               "bucket": jnp.int32(bucket)})
+                return out
+            return fn
+
+        # BROADCAST / GATHER / MERGE: materialize the full relation on
+        # every shard (GATHER consumers read shard 0's replica)
+        site = self._site("bcast")
+        self.exchange_sites.append(site)
+        sort_op = None
+        if kind == ExchangeKind.MERGE and ordering:
+            lay = {s.name: i for i, s in enumerate(symbols)}
+            sort_keys = [SortKey(lay[o.symbol.name], o.ascending,
+                                 o.nulls_first) for o in ordering]
+            self._key("merge-sort", tuple(sort_keys))
+            sort_op = order_by(sort_keys)
+
+        def fn(env: _Env) -> Page:
+            page = inner(env)
+            out = broadcast_page(page)
+            if sort_op is not None:
+                out = sort_op(out)
+            _exchange_aux(env, site, page, {})
+            return out
+        return fn
+
+    # ------------------------------------------------------------- nodes
+
+    def lower_node(self, node, frag: PlanFragment
+                   ) -> Callable:
+        name = type(node).__name__
+        method = getattr(self, f"_lower_{name}", None)
+        if method is None:
+            raise MeshUnsupported(f"no mesh lowering for {name}")
+        return method(node, frag)
+
+    def _lower_TableScanNode(self, node: TableScanNode, frag) -> Callable:
+        idx = len(self.scans)
+        self.scans.append(node)
+        self._key("scan", node.catalog, str(node.table),
+                  tuple(s.name for s, _ in node.assignments))
+        return lambda env: env.pages[idx]
+
+    def _lower_RemoteSourceNode(self, node: RemoteSourceNode, frag
+                                ) -> Callable:
+        child = next((c for c in frag.children
+                      if c.fragment_id == node.fragment_id), None)
+        if child is None:
+            raise MeshUnsupported(f"missing child {node.fragment_id}")
+        inner = self.lower_node(child.root, child)
+        return self._lower_exchange(inner, node.kind, node.partition_keys,
+                                    node.order_by,
+                                    tuple(child.root.outputs))
+
+    def _lower_FilterNode(self, node: FilterNode, frag) -> Callable:
+        src = self.lower_node(node.source, frag)
+        lay, typ = _layout(node.source.outputs)
+        pred = self._expr(node.predicate, lay, typ)
+        self._key("filter", pred)
+        f = compile_filter(pred)
+        return lambda env: (lambda p: p.filter(f(p, ())))(src(env))
+
+    def _lower_ProjectNode(self, node: ProjectNode, frag) -> Callable:
+        src = self.lower_node(node.source, frag)
+        lay, typ = _layout(node.source.outputs)
+        exprs = tuple(self._expr(e, lay, typ)
+                      for _, e in node.assignments)
+        self._key("project", exprs)
+        fns = [compile_expression(e) for e in exprs]
+
+        def fn(env: _Env) -> Page:
+            page = src(env)
+            return Page(tuple(f(page, ()) for f in fns), page.num_rows)
+        return fn
+
+    def _lower_LimitNode(self, node: LimitNode, frag) -> Callable:
+        if not node.partial:
+            raise MeshUnsupported("non-partial LIMIT in sharded fragment")
+        src = self.lower_node(node.source, frag)
+        self._key("limit", node.count)
+
+        def fn(env: _Env) -> Page:
+            page = src(env)
+            rows = jnp.minimum(page.num_rows,
+                               jnp.int32(node.count)).astype(jnp.int32)
+            return Page(page.columns, rows)
+        return fn
+
+    def _lower_TopNNode(self, node: TopNNode, frag) -> Callable:
+        if node.step == "final":
+            raise MeshUnsupported("final TopN in sharded fragment")
+        src = self.lower_node(node.source, frag)
+        lay, _ = _layout(node.source.outputs)
+        keys = [SortKey(lay[o.symbol.name], o.ascending, o.nulls_first)
+                for o in node.order_by]
+        self._key("topn", node.count, tuple(keys))
+        op = top_n(node.count, keys)
+        return lambda env: op(src(env))
+
+    def _lower_SortNode(self, node: SortNode, frag) -> Callable:
+        src = self.lower_node(node.source, frag)
+        lay, _ = _layout(node.source.outputs)
+        keys = [SortKey(lay[o.symbol.name], o.ascending, o.nulls_first)
+                for o in node.order_by]
+        self._key("sort", tuple(keys))
+        op = order_by(keys)
+        return lambda env: op(src(env))
+
+    def _lower_WindowNode(self, node: WindowNode, frag) -> Callable:
+        from trino_tpu.exec.local_planner import LocalExecutionPlanner
+        from trino_tpu.ops.window import WindowSpec, window
+        src = self.lower_node(node.source, frag)
+        lay, typ = _layout(node.source.outputs)
+        part = tuple(lay[s.name] for s in node.partition_by)
+        okeys = tuple(SortKey(lay[o.symbol.name], o.ascending,
+                              o.nulls_first) for o in node.order_by)
+        specs = []
+        for out_sym, wf in node.functions:
+            try:
+                whole, bounds = LocalExecutionPlanner._lower_frame(node, wf)
+            except Exception as e:
+                raise MeshUnsupported(f"window frame: {e}")
+            args = []
+            for a in wf.args:
+                if a.__class__.__name__ != "SymbolRef":
+                    raise MeshUnsupported("window args must be symbols")
+                args.append(lay[a.name])
+            specs.append(WindowSpec(wf.name.lower(), tuple(args),
+                                    out_sym.type, whole,
+                                    wf.frame_type == "ROWS", bounds))
+        self._key("window", part, okeys, tuple(specs))
+        op = window(part, okeys, specs)
+        return lambda env: op(src(env))
+
+    # -------------------------------------------------------- aggregation
+
+    def _agg_specs(self, node: AggregationNode, lay) -> Tuple[AggSpec, ...]:
+        specs = []
+        for _out, call in node.aggregations:
+            if call.args:
+                arg = call.args[0]
+                input_ch = lay[arg.name] if lay is not None else None
+                in_type = call.input_type
+            else:
+                input_ch, in_type = None, None
+            in2_ch = in2_type = None
+            if len(call.args) > 1 and lay is not None:
+                arg2 = call.args[1]
+                in2_ch, in2_type = lay[arg2.name], arg2.type
+            mask_ch = None
+            if call.filter is not None:
+                if lay is None:
+                    raise MeshUnsupported("FILTER agg in final step")
+                mask_ch = lay[call.filter.name]
+            specs.append(AggSpec(call.name, input_ch, in_type, mask_ch,
+                                 call.distinct, in2_ch, in2_type))
+        return tuple(specs)
+
+    def _lower_AggregationNode(self, node: AggregationNode, frag
+                               ) -> Callable:
+        src = self.lower_node(node.source, frag)
+        if node.step == AggStep.PARTIAL:
+            lay, _ = _layout(node.source.outputs)
+            keys = tuple(lay[s.name] for s in node.group_by)
+            specs = self._agg_specs(node, lay)
+            self._key("agg-partial", keys, specs)
+            op = hash_aggregate(list(keys), list(specs), Step.PARTIAL)
+            return lambda env: op(src(env))
+        if node.step == AggStep.FINAL:
+            specs = self._agg_specs(node, None)
+            nkeys = len(node.group_by)
+            state_channels = []
+            ch = nkeys
+            for spec in specs:
+                fn = get_aggregate(spec.name, spec.input_type)
+                k = len(fn.state(spec.input_type))
+                state_channels.append(list(range(ch, ch + k)))
+                ch += k
+            self._key("agg-final", nkeys, specs)
+            op = hash_aggregate(list(range(nkeys)), list(specs),
+                                Step.FINAL, state_channels)
+            return lambda env: op(src(env))
+        # SINGLE (DISTINCT / single-step aggs after a repartition): the
+        # sort-based kernel needs every row of a group in one call — the
+        # exchange guarantees that. Collect aggregates additionally need
+        # a host-measured list length; bail to the dispatch loop.
+        if any(call.name in COLLECT_AGGREGATES
+               for _, call in node.aggregations):
+            raise MeshUnsupported("collect aggregate needs host sizing")
+        lay, _ = _layout(node.source.outputs)
+        keys = tuple(lay[s.name] for s in node.group_by)
+        specs = self._agg_specs(node, lay)
+        self._key("agg-single", keys, specs)
+        op = hash_aggregate(list(keys), list(specs), Step.SINGLE)
+        return lambda env: op(src(env))
+
+    # -------------------------------------------------------------- joins
+
+    def _lower_JoinNode(self, node: JoinNode, frag) -> Callable:
+        if node.kind == JoinKind.RIGHT:
+            flipped = JoinNode(
+                JoinKind.LEFT, node.right, node.left,
+                tuple(JoinClause(c.right, c.left) for c in node.criteria),
+                node.filter, node.distribution)
+            inner = self._lower_JoinNode(flipped, frag)
+            out_syms = node.left.outputs + node.right.outputs
+            lay, _ = _layout(flipped.outputs)
+            order = tuple(lay[s.name] for s in out_syms)
+            self._key("select", order)
+            return lambda env: (lambda p: Page(
+                tuple(p.columns[c] for c in order), p.num_rows))(inner(env))
+        if node.kind not in (JoinKind.INNER, JoinKind.LEFT):
+            raise MeshUnsupported(f"{node.kind} join")
+        join_kind = JoinType.INNER if node.kind == JoinKind.INNER \
+            else JoinType.LEFT
+
+        probe_syms = node.left.outputs
+        build_syms = node.right.outputs
+        probe_lay, _ = _layout(probe_syms)
+        build_lay, _ = _layout(build_syms)
+        probe_keys = tuple(probe_lay[c.left.name] for c in node.criteria)
+        build_keys = tuple(build_lay[c.right.name] for c in node.criteria)
+        out_symbols = node.outputs
+        out_names = {s.name for s in out_symbols}
+        probe_keep = tuple(i for i, s in enumerate(probe_syms)
+                           if s.name in out_names)
+        build_keep = tuple(i for i, s in enumerate(build_syms)
+                           if s.name in out_names)
+
+        post_pred = None
+        if node.filter is not None:
+            if join_kind != JoinType.INNER:
+                raise MeshUnsupported("outer join residual filter")
+            lay, typ = _layout(out_symbols)
+            post_pred = self._expr(node.filter, lay, typ)
+        post_filter = None if post_pred is None else \
+            compile_filter(post_pred)
+
+        # co-partitioned join: both inputs repartition on the clause keys
+        # — fuse the exchange pair into this join and, when enabled, make
+        # it skew-aware (heavy probe keys spread, their build rows
+        # replicate: JSPIM). Otherwise the children lower normally (their
+        # own exchanges apply inside).
+        sides = self._co_partitioned_inputs(node, frag, join_kind)
+        if sides is not None:
+            probe_fn, build_fn, ppre_keys, bpre_keys, psite, bsite = sides
+        else:
+            probe_fn = self.lower_node(node.left, frag)
+            build_fn = self.lower_node(node.right, frag)
+            ppre_keys = bpre_keys = None
+            psite = bsite = None
+
+        site = self._site("join")
+        self._key("join", probe_keys, build_keys, join_kind, post_pred,
+                  probe_keep, build_keep)
+
+        def fn(env: _Env) -> Page:
+            if psite is None:
+                probe = probe_fn(env)
+                build = build_fn(env)
+            else:
+                probe, build = self._apply_skewed_pair(
+                    env, probe_fn, build_fn, ppre_keys, bpre_keys,
+                    psite, bsite)
+            probe = _align_key_dictionaries(probe, build, probe_keys,
+                                            build_keys)
+            cap = env.ladder.get(site) or probe.capacity
+            op = hash_join(list(probe_keys), list(build_keys), join_kind,
+                           output_capacity=cap, prepared=False,
+                           probe_out=probe_keep, build_out=build_keep)
+            out, total = op(probe, build)
+            if post_filter is not None:
+                out = out.filter(post_filter(out, ()))
+            env.aux[site] = {
+                "total": jax.lax.pmax(total.astype(jnp.int64), AXIS),
+                "cap": jnp.int32(cap)}
+            return out
+        return fn
+
+    def _co_partitioned_inputs(self, node: JoinNode, frag, join_kind):
+        left, right = node.left, node.right
+        if not (isinstance(left, RemoteSourceNode)
+                and isinstance(right, RemoteSourceNode)
+                and left.kind == ExchangeKind.REPARTITION
+                and right.kind == ExchangeKind.REPARTITION):
+            return None
+        lchild = next((c for c in frag.children
+                       if c.fragment_id == left.fragment_id), None)
+        rchild = next((c for c in frag.children
+                       if c.fragment_id == right.fragment_id), None)
+        if lchild is None or rchild is None:
+            return None
+        # the partition keys must be exactly the join clause keys, in
+        # clause order, for spread/replicate to preserve join semantics
+        if tuple(s.name for s in left.partition_keys) != \
+                tuple(c.left.name for c in node.criteria) or \
+                tuple(s.name for s in right.partition_keys) != \
+                tuple(c.right.name for c in node.criteria):
+            return None
+        probe_fn = self.lower_node(lchild.root, lchild)
+        build_fn = self.lower_node(rchild.root, rchild)
+        play = {s.name: i for i, s in enumerate(left.symbols)}
+        blay = {s.name: i for i, s in enumerate(right.symbols)}
+        ppre = tuple(play[s.name] for s in left.partition_keys)
+        bpre = tuple(blay[s.name] for s in right.partition_keys)
+        psite = self._site("a2a")
+        bsite = self._site("a2a")
+        self.exchange_sites += [psite, bsite]
+        self._key("skewed-pair", ppre, bpre, self._skew, self._skew_k)
+        return probe_fn, build_fn, ppre, bpre, psite, bsite
+
+    def _apply_skewed_pair(self, env: _Env, probe_fn, build_fn,
+                           ppre_keys, bpre_keys, psite, bsite):
+        probe_pre = probe_fn(env)
+        build_pre = build_fn(env)
+        n = jax.lax.psum(1, AXIS)
+        pbucket = env.ladder.get(psite) or \
+            max(1024, _next_pow2(max(1, probe_pre.capacity // n)))
+        bbucket = env.ladder.get(bsite) or \
+            max(1024, 2 * _next_pow2(max(1, build_pre.capacity // n)))
+        heavy = None
+        if self._skew:
+            heavy = detect_heavy_keys(probe_pre, list(ppre_keys),
+                                      self._skew_k,
+                                      max(pbucket // 2, 1024))
+        probe, p_ovf = all_to_all_by_key(probe_pre, list(ppre_keys),
+                                         pbucket, heavy=heavy)
+        if heavy is not None:
+            build, b_ovf = all_to_all_replicate(build_pre, list(bpre_keys),
+                                                bbucket, heavy)
+        else:
+            build, b_ovf = all_to_all_by_key(build_pre, list(bpre_keys),
+                                             bbucket)
+        _exchange_aux(env, psite, probe_pre,
+                      {"overflow": p_ovf, "bucket": jnp.int32(pbucket)})
+        _exchange_aux(env, bsite, build_pre,
+                      {"overflow": b_ovf, "bucket": jnp.int32(bbucket)})
+        return probe, build
+
+    def _lower_SemiJoinNode(self, node: SemiJoinNode, frag) -> Callable:
+        probe_fn = self.lower_node(node.source, frag)
+        build_fn = self.lower_node(node.filtering_source, frag)
+        probe_lay, _ = _layout(node.source.outputs)
+        build_lay, _ = _layout(node.filtering_source.outputs)
+        probe_keys = tuple(probe_lay[s.name] for s in node.source_keys)
+        build_keys = tuple(build_lay[s.name] for s in node.filtering_keys)
+        site = self._site("join")
+        self._key("semijoin", probe_keys, build_keys, node.null_aware)
+
+        def fn(env: _Env) -> Page:
+            probe = probe_fn(env)
+            build = build_fn(env)
+            probe = _align_key_dictionaries(probe, build, probe_keys,
+                                            build_keys)
+            cap = env.ladder.get(site) or probe.capacity
+            op = hash_join(list(probe_keys), list(build_keys),
+                           JoinType.MARK, output_capacity=cap,
+                           prepared=False, null_aware=node.null_aware)
+            out, total = op(probe, build)
+            env.aux[site] = {
+                "total": jax.lax.pmax(total.astype(jnp.int64), AXIS),
+                "cap": jnp.int32(cap)}
+            return out
+        return fn
+
+    def _lower_AssignUniqueIdNode(self, node, frag) -> Callable:
+        src = self.lower_node(node.source, frag)
+        self._key("assign-unique-id")
+
+        def fn(env: _Env) -> Page:
+            page = src(env)
+            base = jax.lax.axis_index(AXIS).astype(jnp.int64) << 44
+            idx = jnp.arange(page.capacity, dtype=jnp.int64) + base
+            col = Column(idx, None, T.BIGINT, None)
+            return Page(tuple(page.columns) + (col,), page.num_rows)
+        return fn
+
+
+def _align_key_dictionaries(probe: Page, build: Page, probe_keys,
+                            build_keys) -> Page:
+    """String join keys across distinct dictionaries: remap probe codes
+    onto the build pool at trace time (dictionaries are static aux data,
+    so the remap table is a host fold — the in-program analog of
+    LocalExecutionPlanner._align_join_dictionaries). Probe values absent
+    from the build pool map past the pool end and can never match."""
+    cols = list(probe.columns)
+    changed = False
+    for pk, bk in zip(probe_keys, build_keys):
+        pc = cols[pk]
+        bd = build.columns[bk].dictionary
+        if bd is None or pc.dictionary is None or pc.dictionary == bd:
+            continue
+        pvals = pc.dictionary.values
+        n_b = len(bd.values)
+        if n_b:
+            codes = np.minimum(np.searchsorted(bd.values, pvals),
+                               n_b - 1).astype(np.int64)
+            present = bd.values[codes] == pvals
+        else:
+            codes = np.zeros(len(pvals), np.int64)
+            present = np.zeros(len(pvals), bool)
+        out = np.where(present, codes,
+                       n_b + np.arange(len(pvals), dtype=np.int64))
+        tbl = jnp.asarray(out.astype(np.int32))
+        cols[pk] = Column(jnp.take(tbl, jnp.clip(pc.values, 0),
+                                   mode="clip"),
+                          pc.valid, pc.type, bd)
+        changed = True
+    return Page(tuple(cols), probe.num_rows) if changed else probe
+
+
+# ---------------------------------------------------------------------------
+# staging + program driver
+
+
+def _stage_scan(runner, node: TableScanNode) -> Tuple[List[Page], int]:
+    """Read one leaf scan as n per-shard pages (split round-robin, the
+    SourcePartitionedScheduler assignment), each merged to one page; the
+    caller normalizes + stacks them into a workers-sharded global Page."""
+    from trino_tpu.exec.distributed import split_scan_capacity
+    conn = runner.metadata.connector(node.catalog)
+    columns = [c for _, c in node.assignments]
+    n = runner.mesh.n
+    splits = conn.split_manager.get_splits(node.table, target_splits=n)
+    cap = split_scan_capacity(runner.session, conn, node, splits)
+    per_shard: List[Optional[Page]] = []
+    for shard in range(n):
+        mine = [s for s in splits if s.part % n == shard]
+        pages: List[Page] = []
+        for split in mine:
+            for page in conn.page_source.pages(split, columns, cap):
+                pages.append(page)
+        if not pages:
+            per_shard.append(None)
+        elif len(pages) == 1:
+            per_shard.append(pages[0])
+        else:
+            from trino_tpu.page import device_concat
+            key = ("mesh-sconcat", tuple(p.capacity for p in pages),
+                   pages[0].num_columns)
+            op = cached_kernel(key, lambda: lambda *ps: device_concat(ps))
+            per_shard.append(op(*pages))
+    ref = next((p for p in per_shard if p is not None), None)
+    if ref is None:
+        raise MeshUnsupported(f"empty table {node.table}")
+    from trino_tpu.exec.distributed import _empty_like, _normalize_pages
+    per_shard = [_empty_like(ref) if p is None else p for p in per_shard]
+    return _normalize_pages(per_shard), cap
+
+
+def run_co_scheduled(runner, frag: PlanFragment,
+                     remote: RemoteSourceNode) -> List[Optional[Page]]:
+    """Execute `frag` (and its whole child tree) plus the consuming
+    exchange as ONE jitted shard_map program over the runner's mesh.
+    Returns per-shard post-exchange pages for the parent fragment, or
+    raises MeshUnsupported for the dispatch-loop fallback."""
+    mesh = runner.mesh
+    lowerer = MeshLowerer(runner.session, runner.metadata, mesh.n,
+                          runner._exec_params)
+    top_fn = lowerer.lower_child(frag, remote)   # may raise MeshUnsupported
+
+    runner._check_deadline()
+    staged: List[Page] = []
+    staged_bytes: List[List[int]] = []
+    from trino_tpu.exec.memory import live_page_bytes, page_bytes
+    for scan in lowerer.scans:
+        pages, _cap = _stage_scan(runner, scan)
+        staged_bytes.append([page_bytes(p) for p in pages])
+        staged.append(mesh.shard_pages(pages))
+
+    ledger = runner._memory
+    reserved: List[Tuple[int, int]] = []
+    if ledger is not None:
+        for per_shard in staged_bytes:
+            for shard, nbytes in enumerate(per_shard):
+                ledger.reserve(nbytes, "mesh-stage", device=shard)
+                reserved.append((nbytes, shard))
+
+    struct_key = ("mesh-prog", tuple(lowerer.key_parts), mesh.n)
+    try:
+        ladder: Dict[int, int] = {}
+        for _round in range(_MAX_LADDER_ROUNDS):
+            runner._check_deadline()
+            out_global, aux = _run_program(
+                runner, lowerer, top_fn, staged, struct_key, ladder)
+            host_aux = jax.device_get(aux)
+            bumps = _ladder_bumps(lowerer, host_aux)
+            if not bumps:
+                break
+            ladder.update(bumps)
+        else:
+            raise MeshExecutionError(
+                "mesh program capacity ladder did not converge "
+                f"(ladder={ladder})")
+    finally:
+        if ledger is not None:
+            for nbytes, shard in reserved:
+                ledger.free(nbytes, "mesh-stage", device=shard)
+
+    from trino_tpu.exec.distributed import _unstack_page
+    per_shard = _unstack_page(out_global, mesh.n)
+    # per-chip peak accounting for the exchange outputs the parent will
+    # consume (reserve+free: the gauge is the peak, the pages themselves
+    # are owned by XLA until the parent materializes results)
+    if ledger is not None:
+        for shard, p in enumerate(per_shard):
+            if p is not None:
+                nbytes = page_bytes(p)
+                ledger.reserve(nbytes, "mesh-exchange", device=shard)
+                ledger.free(nbytes, "mesh-exchange", device=shard)
+
+    col = runner._collector
+    if col is not None:
+        col.mesh_devices = mesh.n
+        for site in lowerer.exchange_sites:
+            d = host_aux.get(site, {})
+            col.add_exchange(
+                "fused",
+                rows=int(np.max(np.asarray(d.get("rows", 0)))),
+                nbytes=int(np.max(np.asarray(d.get("bytes", 0)))))
+    return per_shard
+
+
+def _run_program(runner, lowerer: MeshLowerer, top_fn, staged,
+                 struct_key, ladder: Dict[int, int]):
+    mesh = runner.mesh
+    ladder_snapshot = dict(ladder)
+    key = struct_key + (tuple(sorted(ladder_snapshot.items())),)
+
+    def build():
+        def per_shard(*pages):
+            env = _Env(pages, ladder_snapshot)
+            out = top_fn(env)
+            return out, env.aux
+        return mesh.shard_map(per_shard)
+    prog = cached_kernel(key, build)
+    return prog(*staged)
+
+
+def _ladder_bumps(lowerer: MeshLowerer, host_aux: Dict[int, dict]
+                  ) -> Dict[int, int]:
+    """Read each site's aux scalars and decide capacity doublings. Aux
+    leaves are [n]-replicated (psum'd / identical per shard); take max."""
+    bumps: Dict[int, int] = {}
+    for site, kind in enumerate(lowerer.sites):
+        d = host_aux.get(site)
+        if d is None:
+            continue
+        if kind == "a2a" and "overflow" in d:
+            if int(np.max(np.asarray(d["overflow"]))) > 0:
+                bumps[site] = 2 * int(np.max(np.asarray(d["bucket"])))
+        elif kind == "join":
+            total = int(np.max(np.asarray(d["total"])))
+            cap = int(np.max(np.asarray(d["cap"])))
+            if total > cap:
+                bumps[site] = _next_pow2(total)
+    return bumps

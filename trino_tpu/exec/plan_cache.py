@@ -51,7 +51,8 @@ DEFAULT_MAX_ENTRIES = 256
 # thresholds, dynamic filtering) applies per execution and must NOT
 # fragment the key
 PLAN_PROPERTIES = ("join_distribution_type", "join_reordering_strategy",
-                   "join_broadcast_threshold_rows", "distributed_sort")
+                   "join_broadcast_threshold_rows", "distributed_sort",
+                   "partitioned_agg_min_ndv")
 
 TableKey = Tuple[str, str, str]   # (catalog, schema, table)
 

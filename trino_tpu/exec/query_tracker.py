@@ -56,6 +56,9 @@ class QueryInfo:
     retries: int = 0
     faults_injected: int = 0
     resource_group: Optional[str] = None
+    # mesh shape the query executed over ("workers:8"); None for
+    # single-device execution
+    mesh: Optional[str] = None
     pool_peak_bytes: int = 0
     memory_kills: int = 0        # times the low-memory killer chose us
     leaked_bytes: int = 0        # nonzero ledger at successful end

@@ -315,6 +315,10 @@ class LocalQueryRunner:
             col.finish()
             info.cpu_time_ms = int(col.execution_s * 1000)
             info.output_bytes = col.output_bytes
+            # mesh shape the query executed over (QueryMesh axis), for
+            # system.runtime.queries consumers and event listeners
+            info.mesh = (f"workers:{col.mesh_devices}"
+                         if col.mesh_devices else None)
             info.stats = col.snapshot()
             info.trace = col.trace_json()
             self.last_query_stats = info.stats

@@ -97,6 +97,29 @@ SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     # Off by default: instrumenting node boundaries splits fused kernel
     # chains and syncs the device once per page per operator.
     "collect_operator_stats": False,
+    # multi-chip sharded execution (exec/mesh_exec.py): co-schedule
+    # eligible fragment chains as ONE jitted shard_map program over the
+    # device mesh — per-shard scan/filter/join/aggregate pipelines with
+    # the inter-fragment exchanges as in-program collectives (all_to_all /
+    # all_gather), so multi-stage plans never stage pages through the
+    # host. Unsupported shapes (and chaos/operator-stats runs) fall back
+    # to the per-shard dispatch loop transparently.
+    "mesh_execution": True,
+    # partitioned vs. global GROUP BY strategy threshold ("Global Hash
+    # Tables Strike Back"): estimated group NDV at or above this
+    # repartitions by group key (partitioned strategy, final agg
+    # parallelizes across chips); below it the tiny partial states gather
+    # to one shard (global strategy, no all_to_all). Plan-affecting
+    # (plan cache keys on it).
+    "partitioned_agg_min_ndv": 1024,
+    # skew-aware repartition (JSPIM heavy-hitter handling) for
+    # mesh-co-scheduled partitioned joins: probe rows of globally-heavy
+    # keys spread round-robin across shards and the matching build rows
+    # replicate to every shard, so one hot key cannot overload a chip.
+    "skewed_exchange_enabled": True,
+    # static top-k candidate slots per shard for in-program heavy-hitter
+    # detection (per-shard top-k -> all_gather -> global counts)
+    "skew_heavy_key_limit": 8,
 }
 
 

@@ -139,6 +139,12 @@ def _record_terminal_metrics(info) -> None:
     m.FAULTS_INJECTED_TOTAL.inc(info.faults_injected)
     if info.stats:
         m.SPILLED_BYTES_TOTAL.inc(info.stats.get("spilled_bytes", 0))
+        m.EXCHANGE_BYTES_TOTAL.inc(info.stats.get("exchange_bytes", 0))
+        m.EXCHANGE_ROWS_TOTAL.inc(info.stats.get("exchange_rows", 0))
+        m.EXCHANGES_TOTAL.inc(info.stats.get("exchanges_fused", 0),
+                              mode="fused")
+        m.EXCHANGES_TOTAL.inc(info.stats.get("exchanges_staged", 0),
+                              mode="staged")
     if info.wall_ms is not None:
         m.QUERY_WALL_SECONDS.observe(info.wall_ms / 1000.0)
 

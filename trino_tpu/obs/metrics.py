@@ -249,6 +249,19 @@ SPILLED_BYTES_TOTAL = REGISTRY.counter(
 QUERY_WALL_SECONDS = REGISTRY.histogram(
     "trino_tpu_query_wall_seconds",
     "Query wall-clock duration from start to terminal state.")
+EXCHANGE_BYTES_TOTAL = REGISTRY.counter(
+    "trino_tpu_exchange_bytes_total",
+    "Bytes moved through inter-fragment exchanges (on-device "
+    "collectives; live-row estimate).")
+EXCHANGE_ROWS_TOTAL = REGISTRY.counter(
+    "trino_tpu_exchange_rows_total",
+    "Rows moved through inter-fragment exchanges.")
+EXCHANGES_TOTAL = REGISTRY.counter(
+    "trino_tpu_exchanges_total",
+    "Inter-fragment exchanges by data-plane mode: 'fused' = collective "
+    "inlined in a co-scheduled mesh program (pages never leave the "
+    "producing XLA program); 'staged' = standalone collective over "
+    "host-staged per-shard fragment outputs.", labeled=True)
 
 
 def _engine_gauges():
@@ -276,6 +289,15 @@ def _engine_gauges():
            NODE_POOL.leaks, {})
     yield ("trino_tpu_pool_leaked_bytes", pool + "bytes leaked total.",
            NODE_POOL.leaked_bytes, {})
+    for d in sorted(set(NODE_POOL.device_reserved)
+                    | set(NODE_POOL.device_peak)):
+        labels = {"device": d}
+        yield ("trino_tpu_pool_device_reserved_bytes",
+               pool + "current reservation attributed per mesh device.",
+               NODE_POOL.device_reserved.get(d, 0), labels)
+        yield ("trino_tpu_pool_device_peak_bytes",
+               pool + "peak reservation attributed per mesh device.",
+               NODE_POOL.device_peak.get(d, 0), labels)
 
     from trino_tpu.exec.resource_groups import list_all_groups
     for g in list_all_groups():

@@ -78,6 +78,18 @@ class QueryStatsCollector:
         self.plan_cache_misses = 0
         self.retries = 0
         self.faults_injected = 0
+        # inter-fragment exchange data plane (exec/mesh_exec.py +
+        # exec/distributed.py): 'fused' exchanges ran as collectives
+        # inlined in a co-scheduled mesh program; 'staged' exchanges ran
+        # as standalone collectives over host-staged per-shard fragment
+        # outputs (the fallback dispatch loop). Rows/bytes are live-row
+        # estimates of what crossed the exchange.
+        self.exchanges_fused = 0
+        self.exchanges_staged = 0
+        self.exchange_rows = 0
+        self.exchange_bytes = 0
+        # mesh shape the query executed over (0 = single-device)
+        self.mesh_devices = 0
 
     # ----------------------------------------------------------- spans
 
@@ -146,6 +158,18 @@ class QueryStatsCollector:
     def plan_cache_miss(self) -> None:
         self.plan_cache_misses += 1
 
+    def add_exchange(self, mode: str, rows: int = 0, nbytes: int = 0
+                     ) -> None:
+        """One inter-fragment exchange applied; mode 'fused' (collective
+        inside a co-scheduled mesh program) or 'staged' (standalone
+        collective over host-staged fragment outputs)."""
+        if mode == "fused":
+            self.exchanges_fused += 1
+        else:
+            self.exchanges_staged += 1
+        self.exchange_rows += int(rows)
+        self.exchange_bytes += int(nbytes)
+
     # -------------------------------------------------------- finish
 
     def finish(self) -> None:
@@ -190,6 +214,11 @@ class QueryStatsCollector:
             "plan_cache_misses": self.plan_cache_misses,
             "retries": self.retries,
             "faults_injected": self.faults_injected,
+            "exchanges_fused": self.exchanges_fused,
+            "exchanges_staged": self.exchanges_staged,
+            "exchange_rows": self.exchange_rows,
+            "exchange_bytes": self.exchange_bytes,
+            "mesh_devices": self.mesh_devices,
         }
         if self.operators:
             snap["operators"] = self.operator_rows()

@@ -55,30 +55,34 @@ class Dictionary:
     __slots__ = ("values", "id", "_table_cache", "_fp")
 
     def __init__(self, values: np.ndarray):
+        import hashlib
         values = np.asarray(values, dtype=object)
-        # sortedness is what makes device-side <,>,min,max on codes correct
-        if values.size > 1 and not all(
-                values[i] <= values[i + 1] for i in range(len(values) - 1)):
-            raise ValueError("dictionary must be sorted")
+        # ONE pass fuses the sortedness check (what makes device-side
+        # <,>,min,max on codes correct) with the content fingerprint:
+        # hashing at construction time means the pool bytes are walked
+        # exactly once, while they are cache-hot from being built — a
+        # lazily-hashed multi-GB pool used to stall the FIRST prepared
+        # EXECUTE over a large string table by multiple milliseconds at
+        # its first trace-cache lookup.
+        h = hashlib.blake2b(digest_size=16)
+        prev = None
+        for s in values:
+            if prev is not None and not (prev <= s):
+                raise ValueError("dictionary must be sorted")
+            prev = s
+            b = s.encode("utf-8", "surrogatepass") \
+                if isinstance(s, str) else repr(s).encode()
+            h.update(len(b).to_bytes(4, "little"))
+            h.update(b)
         self.values = values
         self.id = next(_dict_ids)
-        self._fp = None   # lazy content fingerprint
+        self._fp = h.digest()   # content fingerprint, fixed at build
 
     @property
     def fingerprint(self) -> bytes:
-        """Content digest of the pool (computed once, on first use):
-        the jit-static identity of this dictionary."""
-        fp = self._fp
-        if fp is None:
-            import hashlib
-            h = hashlib.blake2b(digest_size=16)
-            for s in self.values:
-                b = s.encode("utf-8", "surrogatepass") \
-                    if isinstance(s, str) else repr(s).encode()
-                h.update(len(b).to_bytes(4, "little"))
-                h.update(b)
-            fp = self._fp = h.digest()
-        return fp
+        """Content digest of the pool (computed incrementally at
+        construction): the jit-static identity of this dictionary."""
+        return self._fp
 
     @classmethod
     def build(cls, strings: Sequence[str]) -> Tuple["Dictionary", np.ndarray]:

@@ -11,4 +11,5 @@ jax.sharding.Mesh and REMOTE exchanges lower to ICI collectives —
 
 from trino_tpu.parallel.mesh import QueryMesh  # noqa: F401
 from trino_tpu.parallel.exchange import (  # noqa: F401
-    all_to_all_by_key, broadcast_page, gather_page)
+    all_to_all_by_key, all_to_all_replicate, broadcast_page,
+    detect_heavy_keys, gather_page)

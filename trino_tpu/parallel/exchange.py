@@ -16,6 +16,16 @@ collectives. These functions run INSIDE a shard_map over QueryMesh.AXIS:
 
 Hash function matches ops/join._mix64 (splitmix64) so co-partitioned joins
 land build/probe rows of one key on one shard.
+
+Skew (JSPIM heavy-hitter-aware partitioning): plain hash routing sends
+EVERY row of one hot key to one shard — a single skewed key overloads a
+chip while the rest idle (TPC-DS catalog/web fact joins). detect_heavy_keys
+finds globally-frequent keys in-program (local run lengths -> top-k
+candidates -> all_gather -> global counts); the join exchange then SPREADS
+heavy probe rows round-robin across the mesh and REPLICATES the matching
+build rows to every shard, so correctness is preserved (each probe row
+still sees all of its key's build rows exactly once) while no shard
+receives more than ~1/n of a hot key's probe rows.
 """
 
 from __future__ import annotations
@@ -30,28 +40,121 @@ from trino_tpu.page import Column, Page
 
 AXIS = "workers"
 
+_U64MAX = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def _is_heavy(key: jnp.ndarray, heavy: jnp.ndarray) -> jnp.ndarray:
+    """Row mask: key value appears in the (sentinel-padded) heavy set."""
+    hk = heavy[None, :]
+    return ((key[:, None] == hk) & (hk != _U64MAX)).any(axis=1)
+
 
 def _partition_of(page: Page, key_channels: Sequence[int],
-                  n_parts: int) -> jnp.ndarray:
+                  n_parts: int,
+                  heavy: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     key, is_null = _key_u64(page, key_channels)
     part = (_mix64(key) % jnp.uint64(n_parts)).astype(jnp.int32)
     # null keys route to shard 0 (they never match joins/group as equals is
     # handled downstream; they just need a deterministic home)
     part = jnp.where(is_null, 0, part)
+    if heavy is not None:
+        # spread mode: rows of a heavy key round-robin over the mesh by
+        # row position instead of hammering the key's hash shard
+        idx = jnp.arange(page.capacity, dtype=jnp.uint64)
+        spread = ((_mix64(key) + idx) % jnp.uint64(n_parts)) \
+            .astype(jnp.int32)
+        part = jnp.where(_is_heavy(key, heavy) & ~is_null, spread, part)
     return jnp.where(page.row_mask(), part, n_parts)  # dead rows -> dropped
 
 
+def detect_heavy_keys(page: Page, key_channels: Sequence[int], k: int,
+                      min_global_count: int, axis: str = AXIS
+                      ) -> jnp.ndarray:
+    """Globally-frequent key detection, entirely in-program (JSPIM's
+    heavy-hitter pre-pass as a collective): each shard sorts its keys,
+    takes its k most frequent as candidates, all_gathers the (n*k)
+    candidate (key, count) pairs, and sums counts across shards per
+    candidate. Returns a [k] uint64 vector of raw key values whose global
+    count reaches min_global_count, padded with the u64 sentinel.
+
+    A truly heavy key is in the local top-k of every shard where it is
+    frequent, so the global sum is exact for the keys that matter;
+    borderline keys may be undercounted and simply stay un-spread."""
+    n = jax.lax.psum(1, axis)
+    key, is_null = _key_u64(page, key_channels)
+    live = page.row_mask() & ~is_null
+    masked = jnp.where(live, key, _U64MAX)
+    s = jnp.sort(masked)
+    cap = page.capacity
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    boundary = (s != jnp.roll(s, 1)).at[0].set(True)
+    run_start = jax.lax.cummax(jnp.where(boundary, idx, 0))
+    nxt = jnp.where(boundary, idx, cap)
+    suffix_min = jnp.flip(jax.lax.cummin(jnp.flip(nxt)))
+    next_start = jnp.concatenate(
+        [suffix_min[1:], jnp.full((1,), cap, dtype=suffix_min.dtype)])
+    run_len = (next_start - run_start).astype(jnp.int32)
+    cand_count = jnp.where(boundary & (s != _U64MAX), run_len, 0)
+    top_counts, top_idx = jax.lax.top_k(cand_count, k)
+    cand_keys = jnp.take(s, top_idx)
+    all_keys = jax.lax.all_gather(cand_keys, axis).reshape(n * k)
+    all_counts = jax.lax.all_gather(top_counts, axis).reshape(n * k)
+    eq = all_keys[:, None] == all_keys[None, :]
+    glob = jnp.sum(eq * all_counts[None, :].astype(jnp.int64), axis=1)
+    nk = n * k
+    first = ~jnp.any(eq & (jnp.arange(nk)[None, :] < jnp.arange(nk)[:, None]),
+                     axis=1)
+    score = jnp.where((all_keys != _U64MAX) & first
+                      & (glob >= min_global_count), glob, -1)
+    sel_score, sel = jax.lax.top_k(score, k)
+    return jnp.where(sel_score > 0, jnp.take(all_keys, sel), _U64MAX)
+
+
+def _exchange_compact(cols, occ, n: int, bucket_capacity: int,
+                      axis: str) -> Page:
+    """The receive half of an all_to_all exchange: swap the per-destination
+    buckets over the mesh, mask validity by received occupancy, and compact
+    live rows to a dense prefix so downstream operators see a normal page."""
+    def a2a(x):
+        return jax.lax.all_to_all(
+            x.reshape(n, bucket_capacity, *x.shape[1:]), axis,
+            split_axis=0, concat_axis=0).reshape(n * bucket_capacity,
+                                                 *x.shape[1:])
+
+    occ_recv = a2a(occ)
+    out_cols = []
+    for c in cols:
+        vals = a2a(c.values)
+        valid = a2a(c.valid) & occ_recv
+        out_cols.append(Column(vals, valid if c.valid is not None else None,
+                               c.type, c.dictionary))
+
+    perm = jnp.argsort(~occ_recv, stable=True)
+    num = jnp.sum(occ_recv).astype(jnp.int32)
+    out_cols = [Column(jnp.take(c.values, perm),
+                       None if c.valid is None else jnp.take(c.valid, perm),
+                       c.type, c.dictionary)
+                for c in out_cols]
+    return Page(tuple(out_cols), num)
+
+
 def all_to_all_by_key(page: Page, key_channels: Sequence[int],
-                      bucket_capacity: int, axis: str = AXIS
+                      bucket_capacity: int, axis: str = AXIS,
+                      heavy: Optional[jnp.ndarray] = None
                       ) -> Tuple[Page, jnp.ndarray]:
     """Hash-repartition rows across the mesh axis.
 
     Returns (page_of_rows_now_owned_by_this_shard, global_overflow_count).
     Overflow > 0 means some source shard had more than bucket_capacity rows
     for one destination; the host re-runs the stage with a bigger bucket.
+
+    `heavy` (optional [k] uint64 from detect_heavy_keys) engages SPREAD
+    mode: rows of heavy keys round-robin across all shards instead of hash
+    routing — the probe half of the skew-aware join exchange (the build
+    half replicates via all_to_all_replicate with the SAME heavy set).
     """
     n = jax.lax.psum(1, axis)
-    part = _partition_of(page, key_channels, n)
+    part = _partition_of(page, key_channels, n, heavy=heavy)
 
     # stable sort rows by destination, then slot rows into per-destination
     # fixed-capacity buckets: position within bucket = rank within partition
@@ -91,32 +194,57 @@ def all_to_all_by_key(page: Page, key_channels: Sequence[int],
     occ = occ.at[slot].set(live, mode="drop")
 
     cols = [scatter_col(c) for c in page.columns]
-
-    def a2a(x):
-        return jax.lax.all_to_all(
-            x.reshape(n, bucket_capacity, *x.shape[1:]), axis,
-            split_axis=0, concat_axis=0).reshape(n * bucket_capacity,
-                                                 *x.shape[1:])
-
-    occ_recv = a2a(occ)
-    out_cols = []
-    for c in cols:
-        vals = a2a(c.values)
-        valid = a2a(c.valid) & occ_recv
-        out_cols.append(Column(vals, valid if c.valid is not None else None,
-                               c.type, c.dictionary))
-
-    # compact received rows to a dense prefix so downstream operators see a
-    # normal page (live rows first, num_rows scalar)
-    perm = jnp.argsort(~occ_recv, stable=True)
-    num = jnp.sum(occ_recv).astype(jnp.int32)
-    out_cols = [Column(jnp.take(c.values, perm),
-                       None if c.valid is None else jnp.take(c.valid, perm),
-                       c.type, c.dictionary)
-                for c in out_cols]
-    out = Page(tuple(out_cols), num)
+    out = _exchange_compact(cols, occ, n, bucket_capacity, axis)
     total_overflow = jax.lax.psum(overflow_local, axis)
     return out, total_overflow
+
+
+def all_to_all_replicate(page: Page, key_channels: Sequence[int],
+                         bucket_capacity: int, heavy: jnp.ndarray,
+                         axis: str = AXIS) -> Tuple[Page, jnp.ndarray]:
+    """Skew-aware build-side repartition: rows of non-heavy keys hash-route
+    as usual; rows of heavy keys are REPLICATED into every destination's
+    bucket, so each shard holds the full build set for the keys whose probe
+    rows were spread across the mesh (JSPIM heavy-hitter replication).
+
+    Returns (page, global_overflow_count) with the same overflow-ladder
+    contract as all_to_all_by_key."""
+    n = jax.lax.psum(1, axis)
+    key, is_null = _key_u64(page, key_channels)
+    live = page.row_mask()
+    hpart = (_mix64(key) % jnp.uint64(n)).astype(jnp.int32)
+    hpart = jnp.where(is_null, 0, hpart)
+    hvy = _is_heavy(key, heavy) & ~is_null
+    total_slots = n * bucket_capacity
+    overflow_local = jnp.int32(0)
+    dests = []
+    for d in range(n):
+        m = live & ((hpart == d) | hvy)
+        rank = jnp.cumsum(m) - 1
+        cnt = jnp.sum(m)
+        overflow_local = overflow_local + jnp.maximum(
+            cnt - bucket_capacity, 0).astype(jnp.int32)
+        ok = m & (rank < bucket_capacity)
+        slot = jnp.where(ok, d * bucket_capacity + rank, total_slots)
+        dests.append((slot, ok))
+
+    def scatter_col(col: Column) -> Column:
+        buf = jnp.zeros((total_slots,), dtype=col.values.dtype)
+        vbuf = jnp.zeros((total_slots,), dtype=jnp.bool_)
+        for slot, ok in dests:
+            buf = buf.at[slot].set(col.values, mode="drop")
+            src_valid = ok
+            if col.valid is not None:
+                src_valid = ok & col.valid
+            vbuf = vbuf.at[slot].set(src_valid, mode="drop")
+        return Column(buf, vbuf, col.type, col.dictionary)
+
+    occ = jnp.zeros((total_slots,), dtype=jnp.bool_)
+    for slot, ok in dests:
+        occ = occ.at[slot].set(ok, mode="drop")
+    cols = [scatter_col(c) for c in page.columns]
+    out = _exchange_compact(cols, occ, n, bucket_capacity, axis)
+    return out, jax.lax.psum(overflow_local, axis)
 
 
 def broadcast_page(page: Page, axis: str = AXIS) -> Page:

@@ -1407,16 +1407,48 @@ def add_exchanges(root: OutputNode, ctx: OptimizerContext) -> OutputNode:
     return out
 
 
+def _grouped_exchange_kind(agg: AggregationNode, src: PlanNode,
+                           ctx: OptimizerContext) -> str:
+    """Partitioned vs. global GROUP BY strategy ("Global Hash Tables
+    Strike Back" mapped onto the mesh): a LOW-NDV grouping collapses into
+    tiny partial states per shard, so gathering those states to one shard
+    (the shared/global hash table) beats paying an all_to_all; a HIGH-NDV
+    grouping must radix-partition so the final aggregation parallelizes
+    and no single chip materializes every group. The CBO's NDV product
+    picks the strategy; unknown NDV defaults to partitioned (the safe
+    choice at scale)."""
+    if not agg.group_by:
+        return ExchangeKind.GATHER
+    threshold = int(ctx.session.get("partitioned_agg_min_ndv"))
+    groups = 1.0
+    for s in agg.group_by:
+        n = ctx.stats.ndv(src, s.name)
+        if n is None:
+            return ExchangeKind.REPARTITION
+        groups *= max(n, 1.0)
+    # cap the NDV product at the input row count BEFORE comparing: a
+    # multi-key product can exceed the threshold while the true group
+    # count (bounded by rows) stays tiny (float product cannot
+    # meaningfully overflow — it saturates, and saturation > threshold)
+    groups = min(groups, ctx.stats.rows(src))
+    return (ExchangeKind.REPARTITION if groups >= threshold
+            else ExchangeKind.GATHER)
+
+
 def _split_aggregation(agg: AggregationNode, src: PlanNode,
                        ctx: OptimizerContext) -> Tuple[PlanNode, str]:
     """partial agg -> exchange -> final agg
     (PushPartialAggregationThroughExchange.java). DISTINCT or FILTER aggs
-    can't split; gather instead."""
+    can't split; gather instead. The exchange kind for grouped
+    aggregations is CBO-chosen: REPARTITION (partitioned strategy) vs
+    GATHER (global strategy) by estimated group NDV."""
     from trino_tpu.ops.aggregate import SINGLE_STEP_AGGREGATES
     splittable = all(not a.distinct and a.filter is None
                      and a.name not in SINGLE_STEP_AGGREGATES
                      for _, a in agg.aggregations)
     if not splittable:
+        # unsplittable aggs need every row of a group in ONE kernel call,
+        # so a grouped agg must repartition regardless of NDV
         kind = (ExchangeKind.REPARTITION if agg.group_by
                 else ExchangeKind.GATHER)
         ex = ExchangeNode(src, ExchangeScope.REMOTE, kind,
@@ -1429,11 +1461,12 @@ def _split_aggregation(agg: AggregationNode, src: PlanNode,
     # through the exchange collective.
     partial = AggregationNode(src, agg.group_by, agg.aggregations,
                               AggStep.PARTIAL)
-    kind = ExchangeKind.REPARTITION if agg.group_by else ExchangeKind.GATHER
+    kind = _grouped_exchange_kind(agg, src, ctx)
     ex = ExchangeNode(partial, ExchangeScope.REMOTE, kind,
                       tuple(agg.group_by))
     final = AggregationNode(ex, agg.group_by, agg.aggregations, AggStep.FINAL)
-    return final, ("hashed" if agg.group_by else "single")
+    return final, ("hashed" if kind == ExchangeKind.REPARTITION
+                   else "single")
 
 
 # ---------------------------------------------------------------------------
@@ -1443,12 +1476,19 @@ def _split_aggregation(agg: AggregationNode, src: PlanNode,
 @dataclasses.dataclass
 class PlanFragment:
     """One stage program: executes `root` over its partitioning; consumes
-    child fragments through the RemoteSourceNodes cut at REMOTE exchanges."""
+    child fragments through the RemoteSourceNodes cut at REMOTE exchanges.
+
+    `partition_keys` is the fragment's partitioning HANDLE (the reference's
+    PartitioningHandle): for a "hashed" fragment, the symbol names whose
+    hash placed each row on its shard — the mesh scheduler uses it to
+    recognize co-partitioned inputs (a join over inputs repartitioned on
+    the same clause keys needs no further exchange)."""
 
     fragment_id: int
     root: PlanNode
     partitioning: str               # single | source | hashed
     children: List["PlanFragment"]
+    partition_keys: Tuple[str, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1475,7 +1515,7 @@ class RemoteSourceNode(PlanNode):
         return self
 
     def node_name(self):
-        return f"RemoteSource[{self.fragment_id}]"
+        return f"RemoteSource[{self.fragment_id}, {self.kind}]"
 
 
 def fragment_plan(root: OutputNode) -> PlanFragment:
@@ -1491,7 +1531,8 @@ def fragment_plan(root: OutputNode) -> PlanFragment:
             child_root, grandchildren = cut(node.source, child_part)
             counter[0] += 1
             fid = counter[0]
-            frag = PlanFragment(fid, child_root, child_part, grandchildren)
+            frag = PlanFragment(fid, child_root, child_part, grandchildren,
+                                tuple(s.name for s in node.partition_keys))
             remote = RemoteSourceNode(fid, tuple(node.source.outputs),
                                       node.kind, node.partition_keys,
                                       node.order_by)

@@ -137,6 +137,11 @@ class TrinoServer:
         if compilation_cache_dir:
             import trino_tpu
             trino_tpu.enable_persistent_cache(compilation_cache_dir)
+        # size the node pool from the backend's measured per-device
+        # memory at server startup (HBM minus scan-cache budget); CPU
+        # backends keep the static default (exec/memory.autosize_node_pool)
+        from trino_tpu.exec.memory import autosize_node_pool
+        autosize_node_pool()
         self.keep = keep
         self.query_timeout_s = query_timeout_s
         self.max_running = max(1, int(max_running))
