@@ -558,6 +558,32 @@ def run_mesh(out_path=None) -> None:
             f.write(line + "\n")
 
 
+def run_qps(out_path=None) -> None:
+    """`bench.py --qps [OUT.json]`: the closed-loop serving-tier QPS
+    report (trino_tpu/serve/bench_serve.py) — N clients driving prepared
+    EXECUTEs through the HTTP server, sustained executions/s + latency
+    percentiles + cache hit rates. Like the main bench, the final JSON
+    line ALWAYS prints: a failure lands in an `error` field instead of
+    a bare nonzero exit with nothing parseable."""
+    platform = _ensure_backend()
+    payload = {"metric": "serve_qps", "backend": platform}
+    try:
+        from trino_tpu.serve.bench_serve import run_qps_bench
+        payload.update(run_qps_bench(
+            duration_s=float(os.environ.get(
+                "TRINO_TPU_QPS_DURATION_S", 8.0)),
+            clients=int(os.environ.get("TRINO_TPU_QPS_CLIENTS", 8))))
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the line must print
+        payload["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+    line = json.dumps(payload)
+    print(line, flush=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+
+
 def main():
     """Always emits exactly one final JSON line: a backend-init or rung
     failure lands in an `"error"` field (value stays null) instead of a
@@ -686,5 +712,7 @@ if __name__ == "__main__":
         run_rung(sys.argv[2])
     elif len(sys.argv) >= 2 and sys.argv[1] == "--mesh":
         run_mesh(sys.argv[2] if len(sys.argv) >= 3 else None)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--qps":
+        run_qps(sys.argv[2] if len(sys.argv) >= 3 else None)
     else:
         main()

@@ -560,7 +560,9 @@ def _post(server, sql, headers=None):
 
 def _run(server, sql, headers=None):
     payload, hdrs = _post(server, sql, headers)
-    rows = []
+    # data may arrive in ANY response including the first: the serving
+    # tier's result-cache fast path answers FINISHED inline on the POST
+    rows = list(payload.get("data", []))
     while "nextUri" in payload:
         with urllib.request.urlopen(payload["nextUri"]) as resp:
             hdrs.update(dict(resp.headers))

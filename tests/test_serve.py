@@ -1,0 +1,480 @@
+"""Serving tier (trino_tpu/serve/): streaming protocol, result/scan
+caches, warmup manifest, weighted CPU scheduling, QPS closed loop.
+
+The ISSUE-8 acceptance suite: a streaming client sees its first page
+before the query completes, a slow client's backpressure bounds the
+ring, result-cache hits are zero-work and INSERT provably invalidates,
+2:1 group weights drain 2:1 under concurrent load, and a warmup
+manifest leaves the first real EXECUTE fully warm.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from trino_tpu.exec import LocalQueryRunner
+from trino_tpu.server import TrinoServer
+
+
+def _post(server, sql, headers=None):
+    req = urllib.request.Request(
+        f"{server.base_uri}/v1/statement", data=sql.encode(),
+        method="POST")
+    req.add_header("X-Trino-User", "serve-test")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+def _get(uri):
+    with urllib.request.urlopen(uri) as resp:
+        return json.loads(resp.read())
+
+
+def _drain(server, sql, headers=None):
+    payload = _post(server, sql, headers)
+    rows = []
+    states = [payload["stats"]["state"]]
+    while "nextUri" in payload:
+        payload = _get(payload["nextUri"])
+        states.append(payload["stats"]["state"])
+        rows.extend(payload.get("data", []))
+    return payload, rows, states
+
+
+def _tracker_stats(query_id):
+    from trino_tpu.exec.query_tracker import TRACKER
+    info = next(q for q in TRACKER.list() if q.query_id == query_id)
+    return info.stats
+
+
+# ------------------------------------------------------------ streaming
+
+
+def test_streaming_first_page_before_completion():
+    """The async lifecycle contract: with a 1-chunk ring and a 2-chunk
+    result, the client's first data page arrives while the query is
+    still RUNNING — execution is paused at the ring, not finished."""
+    srv = TrinoServer(LocalQueryRunner.tpch("tiny"),
+                      stream_ring_chunks=1, result_cache=False,
+                      scan_cache=False).start()
+    try:
+        payload = _post(srv, "SELECT c_custkey FROM customer")
+        first_data_state = None
+        rows = []
+        states = [payload["stats"]["state"]]
+        while "nextUri" in payload:
+            payload = _get(payload["nextUri"])
+            states.append(payload["stats"]["state"])
+            if payload.get("data"):
+                if first_data_state is None:
+                    first_data_state = payload["stats"]["state"]
+                rows.extend(payload["data"])
+        assert len(rows) == 1500
+        assert first_data_state == "RUNNING", states
+        assert states[-1] == "FINISHED"
+        assert "FINISHING" in states    # producer-done, ring-draining
+    finally:
+        srv.stop()
+
+
+def test_slow_client_backpressure_bounds_ring():
+    """A lagging client must pause the producer: the ring never holds
+    more than its bound, no matter how large the result."""
+    srv = TrinoServer(LocalQueryRunner.tpch("tiny"),
+                      stream_ring_chunks=2, result_cache=False,
+                      scan_cache=False).start()
+    try:
+        payload = _post(srv, "SELECT o_orderkey FROM orders")
+        qid = payload["id"]
+        rows = []
+        while "nextUri" in payload:
+            time.sleep(0.02)            # the slow client
+            payload = _get(payload["nextUri"])
+            rows.extend(payload.get("data", []))
+        assert len(rows) == 15000       # 15 chunks through a 2-slot ring
+        stream = srv._queries[qid].stream
+        assert stream.high_watermark <= 2, stream.high_watermark
+        assert stream.total_rows == 15000
+        stats = _tracker_stats(qid)
+        assert stats["streamed_chunks"] >= 15
+    finally:
+        srv.stop()
+
+
+def test_stream_ring_unit():
+    """ResultStream protocol unit: full chunks publish immediately, the
+    partial remainder stages until flush/close (so every non-final
+    chunk is exactly chunk_rows — token-aligned with buffered paging),
+    ack-on-request frees slots, retry of the current token works,
+    acked tokens are gone, close ends."""
+    from trino_tpu.serve.streaming import ResultStream
+    s = ResultStream(max_chunks=2, chunk_rows=2)
+    s.open(["a"], [None])
+    s.put([(1,), (2,), (3,)])   # one FULL chunk published, (3,) staged
+    assert s.buffered == 1
+    status, chunk = s.get(0, timeout=0.1)
+    assert status == "chunk" and chunk == [(1,), (2,)]
+    assert s.get(0, timeout=0.1)[0] == "chunk"     # same-token retry
+    assert s.get(1, timeout=0.05)[0] == "pending"  # remainder staged
+    s.close()                   # flushes the partial final chunk
+    status, chunk = s.get(1, timeout=0.1)
+    assert status == "chunk" and chunk == [(3,)]
+    assert s.total_rows == 3
+    assert s.get(0, timeout=0.05)[0] == "gone"     # behind the horizon
+    assert s.get(2, timeout=0.1)[0] == "end"
+    assert s.drained
+
+
+def test_stream_put_blocks_then_unblocks():
+    from trino_tpu.serve.streaming import ResultStream
+    s = ResultStream(max_chunks=1, chunk_rows=1)
+    s.open(["a"], [None])
+    s.put([(0,)])
+    done = threading.Event()
+
+    def producer():
+        s.put([(1,)])       # blocks until the consumer requests token 1
+        done.set()
+    th = threading.Thread(target=producer, daemon=True)
+    th.start()
+    time.sleep(0.1)
+    assert not done.is_set()            # full ring is really blocking
+    assert s.get(1, timeout=2.0)[0] == "chunk"
+    assert done.wait(2.0)
+    th.join(timeout=5)
+
+
+# --------------------------------------------------------- result cache
+
+
+def test_result_cache_hit_zero_work_and_insert_invalidation():
+    """The zero-work contract and the stale-impossible contract, on a
+    direct runner: a hit reports planning_s == 0, jit_misses == 0,
+    execution_s == 0 with delivery-consistent rows/bytes; INSERT evicts
+    result AND scan caches through the plan cache's hooks."""
+    r = LocalQueryRunner.tpch("tiny")
+    r.session.set("result_cache_enabled", True)
+    r.session.set("scan_cache_enabled", True)
+    r.execute("CREATE TABLE memory.default.serve_t (a bigint)")
+    r.execute("INSERT INTO memory.default.serve_t VALUES 1, 2, 3")
+    sql = "SELECT sum(a) FROM memory.default.serve_t"
+    assert r.execute(sql).rows == [(6,)]
+    miss_stats = dict(r.last_query_stats)
+    assert miss_stats["result_cache_misses"] == 1
+    assert r.execute(sql).rows == [(6,)]
+    hit_stats = dict(r.last_query_stats)
+    assert hit_stats["result_cache_hits"] == 1
+    assert hit_stats["planning_s"] == 0.0
+    assert hit_stats["execution_s"] == 0.0
+    assert hit_stats["jit_misses"] == 0
+    assert hit_stats["output_rows"] == miss_stats["output_rows"]
+    assert hit_stats["output_bytes"] == miss_stats["output_bytes"]
+    # INSERT invalidates: the very next run must see the new row (a
+    # stale cached 6 is provably impossible, not just unlikely)
+    r.execute("INSERT INTO memory.default.serve_t VALUES 10")
+    assert r.execute(sql).rows == [(16,)]
+    assert r.last_query_stats["result_cache_hits"] == 0
+    assert r.last_query_stats["result_cache_misses"] == 1
+    # ... and caches again from the fresh data
+    assert r.execute(sql).rows == [(16,)]
+    assert r.last_query_stats["result_cache_hits"] == 1
+
+
+def test_scan_cache_hit_and_invalidation():
+    r = LocalQueryRunner.tpch("tiny")
+    r.session.set("scan_cache_enabled", True)
+    sql1 = "SELECT count(*) FROM memory.default.scan_t"
+    r.execute("CREATE TABLE memory.default.scan_t (a bigint)")
+    r.execute("INSERT INTO memory.default.scan_t VALUES 1, 2")
+    assert r.execute(sql1).rows == [(2,)]
+    assert r.last_query_stats["scan_cache_misses"] >= 1
+    # a DIFFERENT query over the same columns reuses the staged pages
+    assert r.execute(
+        "SELECT max(a) FROM memory.default.scan_t").rows == [(2,)]
+    assert r.last_query_stats["scan_cache_hits"] >= 1
+    r.execute("INSERT INTO memory.default.scan_t VALUES 7")
+    assert r.execute(sql1).rows == [(3,)]   # invalidated, re-staged
+
+
+def test_nondeterministic_statements_never_cached():
+    """The determinism gate (the engine has no random() yet, so the
+    check is exercised on parsed ASTs directly)."""
+    from trino_tpu.serve.caches import statement_is_cacheable
+    from trino_tpu.sql import parse_statement
+    assert not statement_is_cacheable(
+        parse_statement("SELECT random() FROM nation"))
+    assert not statement_is_cacheable(
+        parse_statement("SELECT a, now() FROM t WHERE a < 3"))
+    assert statement_is_cacheable(
+        parse_statement("SELECT n_name FROM nation WHERE n_nationkey = 1"))
+
+
+def test_stats_consistent_across_delivery_modes():
+    """Satellite contract: QueryInfo.stats rows/bytes identical whether
+    the result was buffered (direct runner), streamed (server ring), or
+    served from the result cache."""
+    sql = "SELECT c_custkey FROM customer"
+    buffered = LocalQueryRunner.tpch("tiny")
+    buffered.execute(sql)
+    base = dict(buffered.last_query_stats)
+    assert base["output_rows"] == 1500
+
+    srv = TrinoServer(LocalQueryRunner.tpch("tiny"),
+                      result_cache=False, scan_cache=False).start()
+    try:
+        payload, rows, _ = _drain(srv, sql)
+        assert len(rows) == 1500
+        streamed = _tracker_stats(payload["id"])
+        assert streamed["streamed_chunks"] >= 2
+        assert streamed["output_rows"] == base["output_rows"]
+        assert streamed["output_bytes"] == base["output_bytes"]
+    finally:
+        srv.stop()
+
+    cached = LocalQueryRunner.tpch("tiny")
+    cached.session.set("result_cache_enabled", True)
+    cached.execute(sql)
+    cached.execute(sql)
+    hit = dict(cached.last_query_stats)
+    assert hit["result_cache_hits"] == 1
+    assert hit["output_rows"] == base["output_rows"]
+    assert hit["output_bytes"] == base["output_bytes"]
+
+
+# ------------------------------------------------- HTTP fast path + DDL
+
+
+def test_http_result_cache_fast_path_and_invalidation():
+    """Second identical POST answers FINISHED with the data inline (no
+    dispatch, no executor) and zero-work stats; INSERT over HTTP evicts
+    so the next POST recomputes."""
+    srv = TrinoServer(LocalQueryRunner.tpch("tiny")).start()
+    try:
+        _drain(srv, "CREATE TABLE memory.default.http_t (a bigint)")
+        _drain(srv, "INSERT INTO memory.default.http_t VALUES 5, 6")
+        sql = "SELECT sum(a) FROM memory.default.http_t"
+        _, rows, _ = _drain(srv, sql)
+        assert rows == [[11]]
+        payload = _post(srv, sql)       # the fast path
+        assert payload["stats"]["state"] == "FINISHED"
+        assert payload.get("data") == [[11]]
+        assert "nextUri" not in payload
+        stats = _tracker_stats(payload["id"])
+        assert stats["result_cache_hits"] == 1
+        assert stats["planning_s"] == 0.0
+        assert stats["execution_s"] == 0.0
+        assert stats["jit_misses"] == 0
+        _drain(srv, "INSERT INTO memory.default.http_t VALUES 100")
+        _, rows, _ = _drain(srv, sql)
+        assert rows == [[111]]          # stale 11 is impossible
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------- weighted scheduling
+
+
+def test_weighted_scheduling_drains_2to1():
+    """Wall-stride over group weights (the dispatcher's pick logic,
+    driven deterministically with fixed equal charges): queues of 3+3
+    into 2:1-weighted groups drain wa,wb,wa,wa,wb,wb — two 'wa' per
+    'wb' while both queues are backed — and the wall accounting lands
+    on the chains."""
+    from trino_tpu.exec.resource_groups import ResourceGroupManager
+    mgr = ResourceGroupManager()
+    mgr.configure("wa", weight=2)
+    mgr.configure("wb", weight=1)
+    for i in range(3):
+        assert mgr.submit("wa", f"qa{i}", f"qa{i}")
+        assert mgr.submit("wb", f"qb{i}", f"qb{i}")
+    order = []
+    for _ in range(6):
+        group, item = mgr.take(timeout=1.0)
+        order.append(group.name)
+        # equal-cost execution slice, charged like the server does
+        mgr.charge(group, 0.1)
+        mgr.finish(group, str(item))
+    assert order == ["wa", "wb", "wa", "wa", "wb", "wb"], order
+    by_name = {g.name: g for g in mgr.groups()}
+    assert by_name["wa"].scheduled_wall_s == pytest.approx(0.3)
+    assert by_name["wb"].scheduled_wall_s == pytest.approx(0.3)
+
+
+def test_skewed_costs_yield_slots_by_wall():
+    """The point of WALL-denominated stride: a group burning 10x-cost
+    queries stops monopolizing — with equal weights, the cheap group
+    gets picked more often between the heavy group's slices."""
+    from trino_tpu.exec.resource_groups import ResourceGroupManager
+    mgr = ResourceGroupManager()
+    mgr.configure("heavy", weight=1)
+    mgr.configure("light", weight=1)
+    for i in range(20):
+        mgr.submit("heavy", f"qh{i}", f"qh{i}")
+        mgr.submit("light", f"ql{i}", f"ql{i}")
+    picks = {"heavy": 0, "light": 0}
+    for _ in range(24):
+        group, item = mgr.take(timeout=1.0)
+        picks[group.name] += 1
+        mgr.charge(group, 1.0 if group.name == "heavy" else 0.1)
+        mgr.finish(group, str(item))
+    # per unit wall the light group runs ~10x more queries; well over
+    # half the picks must be light once the EWMA estimates converge
+    assert picks["light"] > picks["heavy"] * 2, picks
+
+
+def test_server_charges_wall_to_groups():
+    """Server wiring: executor slices charge through to the group
+    chain and surface in system.runtime.resource_groups."""
+    srv = TrinoServer(LocalQueryRunner.tpch("tiny"), max_running=2,
+                      result_cache=False, scan_cache=False).start()
+    try:
+        for i in range(3):
+            _drain(srv, f"SELECT {300 + i}",
+                   headers={"X-Trino-Session": "resource_group=wally"})
+        by_name = {g.name: g for g in srv.groups.groups()}
+        assert by_name["wally"].scheduled_wall_s > 0
+        _, rows, _ = _drain(
+            srv, "SELECT name, scheduled_wall_ms FROM "
+                 "system.runtime.resource_groups WHERE name = 'wally'")
+        assert rows and rows[0][1] >= 1, rows
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------ warmup manifest
+
+
+def test_warmup_manifest_first_execute_warm(tmp_path):
+    """The cold-start contract: after startup with a manifest, the FIRST
+    client EXECUTE (new parameter values) binds into a warm plan cache
+    and warm kernels — plan_cache_hits == 1, jit_misses == 0."""
+    manifest = tmp_path / "warmup.json"
+    manifest.write_text(json.dumps({"statements": [
+        {"name": "warm_probe",
+         "sql": "SELECT n_name FROM nation WHERE n_nationkey = ?",
+         "using": "2"},
+    ]}))
+    srv = TrinoServer(LocalQueryRunner.tpch("tiny"),
+                      warmup_manifest=str(manifest)).start()
+    try:
+        assert srv.warmup_report and \
+            "error" not in srv.warmup_report[0], srv.warmup_report
+        payload, rows, _ = _drain(srv, "EXECUTE warm_probe USING 9")
+        assert rows == [["INDONESIA"]]
+        stats = _tracker_stats(payload["id"])
+        assert stats["plan_cache_hits"] == 1, stats
+        assert stats["jit_misses"] == 0, stats
+        assert stats["planning_s"] == 0.0
+    finally:
+        srv.stop()
+
+
+def test_warmup_manifest_validation():
+    from trino_tpu.serve.warmup import load_manifest
+    assert load_manifest([{"sql": "SELECT 1"}]) == [{"sql": "SELECT 1"}]
+    with pytest.raises(ValueError, match="statements"):
+        load_manifest({"queries": []})
+    with pytest.raises(ValueError, match="unknown keys"):
+        load_manifest([{"sql": "SELECT 1", "usnig": "1"}])
+    with pytest.raises(ValueError, match="needs an object"):
+        load_manifest(["SELECT 1"])
+
+
+# ------------------------------------------------- masked LIMIT kernels
+
+
+def test_topn_limit_counts_share_one_kernel():
+    """Masked fixed-capacity TopN: the count is a runtime operand, so a
+    new LIMIT k of a warm shape dispatches zero fresh compiles — the
+    warmup-manifest coverage for LIMIT families."""
+    from trino_tpu.exec import jit_cache
+    r = LocalQueryRunner.tpch("tiny")
+    base = "SELECT n_name FROM nation ORDER BY n_nationkey DESC LIMIT {}"
+    first = r.execute(base.format(4)).rows
+    assert len(first) == 4
+    size_before = jit_cache.stats()["size"]
+    for k in (1, 7, 19):
+        rows = r.execute(base.format(k)).rows
+        assert len(rows) == k
+        assert r.last_query_stats["jit_misses"] == 0, k
+    assert jit_cache.stats()["size"] == size_before
+
+
+# ------------------------------------------------------------ OTLP spans
+
+
+def test_otlp_span_export_to_file(tmp_path):
+    from trino_tpu.obs.otlp import (install_otlp_exporter,
+                                    uninstall_otlp_exporter)
+    out = tmp_path / "spans.jsonl"
+    exporter = install_otlp_exporter(str(out))
+    try:
+        r = LocalQueryRunner.tpch("tiny")
+        r.execute("SELECT count(*) FROM nation")
+        assert exporter.exported >= 1 and exporter.failed == 0
+        lines = out.read_text().strip().splitlines()
+        payload = json.loads(lines[-1])
+        scope = payload["resourceSpans"][0]["scopeSpans"][0]
+        spans = scope["spans"]
+        assert spans and spans[0]["traceId"] and spans[0]["spanId"]
+        names = {s["name"] for s in spans}
+        assert "execution" in names     # the phase span made it through
+        root = spans[0]
+        assert int(root["endTimeUnixNano"]) >= \
+            int(root["startTimeUnixNano"])
+    finally:
+        uninstall_otlp_exporter(exporter)
+
+
+def test_otlp_off_by_default(monkeypatch):
+    from trino_tpu.obs.otlp import install_otlp_exporter
+    monkeypatch.delenv("TRINO_TPU_OTLP_ENDPOINT", raising=False)
+    monkeypatch.delenv("TRINO_TPU_OTLP_FILE", raising=False)
+    assert install_otlp_exporter() is None
+
+
+# -------------------------------------------------------- introspection
+
+
+def test_system_runtime_caches_table():
+    r = LocalQueryRunner.tpch("tiny")
+    rows = r.execute("SELECT cache, entries, hits FROM "
+                     "system.runtime.caches ORDER BY cache").rows
+    assert [row[0] for row in rows] == ["jit", "plan", "result", "scan"]
+    by_name = {row[0]: row for row in rows}
+    assert by_name["jit"][1] >= 0 and by_name["plan"][2] >= 0
+
+
+# ---------------------------------------------------------- QPS closed loop
+
+
+def test_qps_smoke():
+    """Tier-1 QPS smoke (the CI guard): a short closed loop sustains
+    nonzero throughput with bounded p99 and no errors, and cache hits
+    are provably zero-work."""
+    from trino_tpu.serve.bench_serve import run_qps_bench
+    report = run_qps_bench(duration_s=2.0, clients=4, warmup_s=0.5)
+    assert report["errors"] == 0, report
+    assert report["qps"] > 0, report
+    assert report["completed"] > 0
+    assert report["p99_ms"] < 30_000, report    # under the wall cap
+    assert report["result_cache_hit_rate"] > 0.5, report
+    assert report.get("cache_hit_zero_planning") is True
+    assert report.get("cache_hit_zero_jit") is True
+    assert report.get("cache_hit_zero_execution") is True
+
+
+@pytest.mark.slow
+def test_zz_qps_sweep():
+    """Heavy sweep (slow, collected last): the full 8-client loop must
+    sustain the acceptance floor on CPU."""
+    from trino_tpu.serve.bench_serve import run_qps_bench
+    report = run_qps_bench(duration_s=8.0, clients=8)
+    assert report["errors"] == 0, report
+    assert report["qps"] >= 500, report
+    assert report["p99_ms"] < 1000, report

@@ -38,9 +38,13 @@ def _get(uri):
 
 
 def run_query(server, sql, headers=None):
-    """Client loop: POST, then follow nextUri until absent."""
+    """Client loop: POST, then follow nextUri until absent. Data may
+    appear in ANY response including the first (StatementClientV1 reads
+    it wherever it shows up — the result-cache fast path answers
+    FINISHED with the rows inline in the POST response)."""
     payload, hdrs = _post(server, sql, headers)
-    columns, rows = None, []
+    columns = payload.get("columns")
+    rows = list(payload.get("data", []))
     states = [payload["stats"]["state"]]
     while "nextUri" in payload:
         payload, h = _get(payload["nextUri"])
@@ -82,7 +86,9 @@ def test_paging(server):
     payload, _, rows, states, _ = run_query(
         server, "SELECT c_custkey FROM customer")
     assert len(rows) == 1500
-    assert states.count("RUNNING") >= 1      # at least one intermediate page
+    # at least one intermediate page: RUNNING while producing, or
+    # FINISHING while the result ring drains (the streaming lifecycle)
+    assert states.count("RUNNING") + states.count("FINISHING") >= 1
     assert "nextUri" not in payload
 
 
@@ -177,8 +183,11 @@ def test_concurrent_paging_during_long_query(server):
     import threading
     import time
 
-    # finish a short query first; keep its page-0 URI
-    payload, _ = _post(server, "SELECT n_nationkey FROM nation")
+    # finish a short query first; keep its page-0 URI (a statement no
+    # earlier test cached — a result-cache hit answers the POST inline
+    # with no nextUri to page)
+    payload, _ = _post(server, "SELECT n_nationkey, n_regionkey "
+                               "FROM nation")
     first_uri = payload["nextUri"]
     while "nextUri" in payload:
         payload, _ = _get(payload["nextUri"])
@@ -400,10 +409,12 @@ def test_queue_full_admission(server):
     """Admission control: an over-limit submit fails as
     QUERY_QUEUE_FULL, not an HTTP error (InternalResourceGroup
     canQueueMore analog) — driven through a zero-capacity group so no
-    timing games are needed."""
+    timing games are needed. The statement must be one the result cache
+    has never seen: a cache hit consumes no executor resources and is
+    legitimately answered without admission."""
     server.groups.configure("zeroq", max_queued=0)
     payload, _, _, _, _ = run_query(
-        server, "SELECT 1",
+        server, "SELECT 1 + 0 * 9",
         headers={"X-Trino-Session": "resource_group=zeroq"})
     assert payload["stats"]["state"] == "FAILED"
     assert payload["error"]["errorName"] == "QUERY_QUEUE_FULL"

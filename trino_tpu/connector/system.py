@@ -52,7 +52,15 @@ TABLES: Dict[str, tuple] = {
         ("hard_concurrency", T.BIGINT), ("max_queued", T.BIGINT),
         ("soft_memory_limit_bytes", T.BIGINT),
         ("scheduling_weight", T.BIGINT),
-        ("memory_usage_bytes", T.BIGINT)),
+        ("memory_usage_bytes", T.BIGINT),
+        ("scheduled_wall_ms", T.BIGINT)),
+    # the serving tier's cache inventory (trino_tpu/serve/caches.py +
+    # exec/plan_cache.py + exec/jit_cache.py): one row per cache layer,
+    # the same counters /v1/metrics exports, SQL-queryable
+    "caches": (
+        ("cache", T.VarcharType()), ("entries", T.BIGINT),
+        ("bytes", T.BIGINT), ("hits", T.BIGINT), ("misses", T.BIGINT),
+        ("evictions", T.BIGINT), ("invalidations", T.BIGINT)),
     # the process metrics registry (obs/metrics.py) as a table: the same
     # samples GET /v1/metrics exposes, SQL-queryable
     "metrics": (
@@ -110,8 +118,27 @@ def _rows_for(table: str) -> List[tuple]:
                  g.hard_concurrency, g.max_queued,
                  g.soft_memory_limit_bytes if
                  g.soft_memory_limit_bytes is not None else 0,
-                 g.weight, g.memory_usage())
+                 g.weight, g.memory_usage(),
+                 int(g.scheduled_wall_s * 1000))
                 for g in list_all_groups()]
+    if table == "caches":
+        from trino_tpu.exec import jit_cache, plan_cache
+        from trino_tpu.serve.caches import (result_cache_stats,
+                                            scan_cache_stats)
+        ps = plan_cache.stats()
+        rs = result_cache_stats()
+        ss = scan_cache_stats()
+        js = jit_cache.stats()
+        return [
+            ("plan", ps["entries"], 0, ps["hits"], ps["misses"],
+             ps["evictions"], ps["invalidations"]),
+            ("result", rs["entries"], 0, rs["hits"], rs["misses"],
+             rs["evictions"], rs["invalidations"]),
+            ("scan", ss["entries"], ss["bytes"], ss["hits"],
+             ss["misses"], ss["evictions"], ss["invalidations"]),
+            ("jit", js["size"], 0, js["hits"], js["misses"],
+             js["evictions"], 0),
+        ]
     if table == "metrics":
         from trino_tpu.obs.metrics import REGISTRY
         return REGISTRY.samples()

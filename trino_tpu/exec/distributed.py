@@ -243,6 +243,8 @@ class DistributedQueryRunner(LocalQueryRunner):
 
     def _execute_query(self, query: t.Query) -> MaterializedResult:
         plan = self._plan_query(query)   # through the plan cache
+        from trino_tpu.exec.plan_cache import plan_tables
+        self._last_plan_tables = plan_tables(plan)  # result-cache keys
         if self._collector is not None:
             self._collector.mesh_devices = self.mesh.n
         with self._phase("execution"):
@@ -293,6 +295,7 @@ class DistributedQueryRunner(LocalQueryRunner):
                                   for j in range(len(cols))))
         if self._faults is not None:
             self._faults.site("fragment", "root")
+        self._last_output_nbytes = nbytes
         if self._collector is not None:
             self._collector.add_output(len(rows), nbytes)
         return MaterializedResult(list(plan.column_names), types, rows)

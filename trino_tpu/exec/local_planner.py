@@ -35,7 +35,8 @@ from trino_tpu.expr.ir import (Call, InputRef, Literal, RowExpression,
                                SpecialForm, SpecialKind, SymbolRef)
 from trino_tpu.metadata import Metadata, Session
 from trino_tpu.ops import (AggSpec, JoinType, SortKey, Step, hash_aggregate,
-                           hash_join, order_by, prepare_build, top_n)
+                           hash_join, order_by, prepare_build, top_n,
+                           top_n_masked)
 from trino_tpu.ops.join import unique_inner_probe
 from trino_tpu.page import Column, Page, concat_pages
 from trino_tpu.planner.nodes import (
@@ -188,6 +189,11 @@ class LocalExecutionPlanner:
         # installed by the owning runner; None = no chaos / no limits
         self.faults = None
         self.deadline = None
+        # serving-tier scan cache (trino_tpu/serve/caches.ScanCache),
+        # installed by the owning runner when scan_cache_enabled: raw
+        # staged pages are reusable by ANY query over the same columns
+        # (filters/projections chain downstream per query)
+        self.scan_cache = None
         # statement parameter values (EXECUTE ... USING), installed by
         # the owning runner: the hoist pass binds BoundParam plan leaves
         # from this tuple, so one cached (value-free) plan re-executes
@@ -286,15 +292,45 @@ class LocalExecutionPlanner:
         conn = self.metadata.connector(node.catalog)
         columns = [c for _, c in node.assignments]
         cap = self._scan_capacity(conn, node)
+        symbols = tuple(s for s, _ in node.assignments)
+        cache = self.scan_cache
+        key = None
+        if cache is not None and node.catalog != "system":
+            # system.runtime tables materialize live engine state at
+            # scan time — caching them would freeze it
+            st = node.table.name
+            key = ((node.catalog, st.schema, st.table),
+                   tuple((c.name, c.ordinal) for c in columns), cap)
+            staged = cache.get(key)
+            if staged is not None:
+                if self.collector is not None:
+                    self.collector.scan_cache_hit()
+
+                def gen_hit(pages=staged):
+                    for page in pages:
+                        self._checkpoint()
+                        yield page
+                return PageStream(gen_hit(), symbols)
+            if self.collector is not None:
+                self.collector.scan_cache_miss()
+        gen_seen = None if key is None else cache.generation()
         splits = conn.split_manager.get_splits(node.table, target_splits=1)
 
         def gen():
+            staging = [] if key is not None else None
             for split in splits:
                 self._fault_site("scan", str(node.table))
                 for page in conn.page_source.pages(split, columns, cap):
                     self._checkpoint()
+                    if staging is not None:
+                        staging.append(page)
                     yield page
-        return PageStream(gen(), tuple(s for s, _ in node.assignments))
+            if staging is not None:
+                # gen_seen guards the race with a concurrent INSERT: a
+                # scan that started pre-change must not publish post-
+                # invalidation (same discipline as PlanCache.put)
+                cache.put(key, staging, gen=gen_seen)
+        return PageStream(gen(), symbols)
 
     def _scan_capacity(self, conn, node: TableScanNode) -> int:
         """Size scan pages to the table: one big page per split keeps the
@@ -859,14 +895,24 @@ class LocalExecutionPlanner:
     def _exec_TopNNode(self, node: TopNNode) -> PageStream:
         src = self.execute(node.source)
         lay, _ = _layout(src.symbols)
-        keys = [SortKey(lay[o.symbol.name], o.ascending, o.nulls_first)
-                for o in node.order_by]
+        keys = tuple(SortKey(lay[o.symbol.name], o.ascending,
+                             o.nulls_first) for o in node.order_by)
+        # masked fixed-capacity kernel (ops/sort.top_n_masked): the count
+        # rides as a runtime operand through the chain's param slots, so
+        # the jit key is COUNT-FREE — LIMIT 5 and LIMIT 500 of one shape
+        # dispatch the same warm executable, exactly like a hoisted
+        # literal (the warmup-manifest contract for LIMIT k families)
+        count = np.int32(node.count)
+        key = ("topn-masked", keys)
+
+        def builder():
+            fn = top_n_masked(keys)
+            return lambda page, g: fn(page, g[0])
         # per-page partial top-n fused with the upstream chain
         partial_topn = compose_chain(
-            src.pending, ("topn", node.count, tuple(keys)),
-            lambda: top_n(node.count, keys))
-        merge_topn = cached_kernel(("topn", node.count, tuple(keys)),
-                                   lambda: top_n(node.count, keys))
+            src.pending + ((key, builder, (count,)),))
+        merge_kernel = cached_kernel(key, lambda: top_n_masked(keys),
+                                     params=(count,))
 
         def gen():
             # partial top-n per page bounds the concat size at
@@ -878,7 +924,7 @@ class LocalExecutionPlanner:
                 else partials[0]
             if int(merged.num_rows) == 0:
                 return
-            yield merge_topn(merged)
+            yield merge_kernel(merged, count)
         return PageStream(gen(), src.symbols)
 
     def _exec_JoinNode(self, node: JoinNode) -> PageStream:

@@ -84,7 +84,17 @@ class PlanCache:
         # any referenced table was invalidated since
         self._gen = 0
         self._invalidated_at: Dict[TableKey, int] = {}
+        # invalidation fan-out (trino_tpu/serve/caches.py): the result
+        # and scan caches register here so the ONE invalidate() call a
+        # DDL/INSERT drives evicts plans, cached answers, and staged
+        # scan pages together — no cache can outlive a table change
+        self._hooks: List = []
         _INSTANCES.add(self)
+
+    def add_invalidation_hook(self, fn) -> None:
+        with self._lock:
+            if fn not in self._hooks:
+                self._hooks.append(fn)
 
     def get(self, key: Hashable) -> Optional[Any]:
         with self._lock:
@@ -140,8 +150,11 @@ class PlanCache:
                      if table in e.tables]
             for k in stale:
                 del self._entries[k]
+            hooks = list(self._hooks)
         if stale:
             _count("invalidations", len(stale))
+        for fn in hooks:    # outside the lock: hooks take their own
+            fn(table)
         return len(stale)
 
     def clear(self) -> None:

@@ -9,11 +9,16 @@ the node pool admits no new query until usage drops), and a
 `scheduling_weight` used for WEIGHTED_FAIR selection across siblings.
 
 Scheduling is stride-based (the deterministic form of the reference's
-WEIGHTED_FAIR policy): every group carries a virtual `pass` advanced by
-1/weight per started query; when an executor slot frees, selection walks
-the tree picking the eligible child with the smallest pass. Under
-saturation a 2:1-weighted sibling pair therefore drains queries 2:1 —
-exactly, not just in expectation.
+WEIGHTED_FAIR policy) over WALL-CLOCK virtual time: every group carries
+a virtual `pass` advanced by an estimated execution quantum per started
+query (the group's EWMA slice, `avg_slice_s`) divided by its weight, and
+reconciled against the MEASURED slice when the server charges the
+finished execution's wall (`charge`). When an executor slot frees,
+selection walks the tree picking the eligible child with the smallest
+pass. Under saturation with equal-cost queries a 2:1-weighted sibling
+pair therefore drains queries 2:1 — exactly, not just in expectation —
+and with skewed costs the groups share executor SECONDS 2:1: a group
+burning long queries yields slots to lighter siblings.
 
 Group names are dotted paths ("adhoc.alice"); intermediate groups are
 created on demand, and limits are enforced at EVERY level of the chain
@@ -62,7 +67,13 @@ class ResourceGroup:
         self.running: set = set()  # subtree running query ids
         self.started = 0
         self.finished = 0
-        self._pass = 0.0         # stride virtual time (starts / weight)
+        self.scheduled_wall_s = 0.0   # execution wall charged to subtree
+        # EWMA of observed execution-slice wall: the stride quantum a
+        # start pre-charges (reconciled by `charge` when the real slice
+        # is known) — keeps pass wall-denominated so sub-second and
+        # multi-second statements compete in the same units
+        self.avg_slice_s = 0.1
+        self._pass = 0.0         # stride virtual time (seconds / weight)
 
     def memory_usage(self) -> int:
         """Node-pool bytes currently held by this subtree's running
@@ -100,6 +111,13 @@ class ResourceGroupManager:
         self.max_groups = max_groups
         self._top: Dict[str, ResourceGroup] = {}
         self._by_name: Dict[str, ResourceGroup] = {}
+        # per-query record of the slice estimates take() pre-charged
+        # (group name -> estimate, one per chain level): charge() must
+        # reconcile against the estimate that was ACTUALLY charged, not
+        # the current EWMA — with concurrent queries in one group the
+        # EWMA moves between take and charge, and reconciling against
+        # the moved value would systematically mis-charge the group
+        self._precharged: Dict[str, Dict[str, float]] = {}
         _MANAGERS.add(self)
 
     # ------------------------------------------------------------ the tree
@@ -282,11 +300,17 @@ class ResourceGroupManager:
                 leaf = self._pick_locked()
                 if leaf is not None:
                     item, qid = leaf.queue.popleft()
+                    est: Dict[str, float] = {}
                     for a in leaf._chain():
                         a.queued -= 1
                         a.running.add(qid)
                         a.started += 1
-                        a._pass += 1.0 / a.weight
+                        # pre-charge the estimated quantum (stride with
+                        # estimated slices): without it, every take
+                        # between two charges would pick the same group
+                        est[a.name] = a.avg_slice_s
+                        a._pass += a.avg_slice_s / a.weight
+                    self._precharged[qid] = est
                     return leaf, item
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
@@ -298,9 +322,43 @@ class ResourceGroupManager:
 
     def finish(self, group: ResourceGroup, query_id: str) -> None:
         with self._cond:
+            # un-charged queries (direct manager users) drop their
+            # pre-charge record here; charged ones already popped it
+            self._precharged.pop(query_id, None)
             for a in group._chain():
                 a.running.discard(query_id)
                 a.finished += 1
+            self._cond.notify_all()
+
+    def charge(self, group: ResourceGroup, seconds: float,
+               query_id: Optional[str] = None) -> None:
+        """Per-group weighted CPU scheduling (the split-scheduler's
+        weighted share, collapsed to the single-controller engine):
+        account a finished execution slice's wall to the group chain and
+        reconcile the stride pass — the start pre-charged an ESTIMATED
+        quantum, so the correction is (measured - estimate)/weight, and
+        the estimate itself updates (EWMA) for the next pre-charge.
+        `query_id` recovers the estimate that was ACTUALLY pre-charged
+        at take (the EWMA may have moved since, and reconciling against
+        the moved value would mis-charge concurrent same-group queries);
+        without it the current EWMA approximates. Net effect: pass
+        advances by MEASURED seconds/weight per query, so the next
+        `take` favors groups that have consumed less executor wall per
+        unit weight — not just started fewer queries. With equal-cost
+        queries this reduces to the exact 2:1 start drain; with skewed
+        costs a group burning long queries yields slots to lighter
+        siblings proportionally to weight."""
+        if group is None or seconds <= 0:
+            return
+        with self._cond:
+            pre = self._precharged.pop(query_id, None) \
+                if query_id is not None else None
+            for a in group._chain():
+                estimate = a.avg_slice_s if pre is None \
+                    else pre.get(a.name, a.avg_slice_s)
+                a.scheduled_wall_s += seconds
+                a._pass += (seconds - estimate) / a.weight
+                a.avg_slice_s += 0.2 * (seconds - a.avg_slice_s)
             self._cond.notify_all()
 
     # ------------------------------------------------- weighted-fair pick

@@ -33,11 +33,22 @@ from trino_tpu.sql.analyzer import SemanticError
 
 @dataclasses.dataclass
 class MaterializedResult:
-    """testing/MaterializedResult.java analog."""
+    """testing/MaterializedResult.java analog.
+
+    `row_count` is the TRUE produced-row count when it differs from
+    len(rows): a streamed query past the result-cache bound delivers its
+    rows through the ring buffer only and drops the materialized copy —
+    `rows` is then empty but the count (tracker, stats, the wire `rows`
+    field) stays exact."""
 
     column_names: List[str]
     column_types: List[T.Type]
     rows: List[Tuple[Any, ...]]
+    row_count: Optional[int] = None
+
+    @property
+    def reported_rows(self) -> int:
+        return len(self.rows) if self.row_count is None else self.row_count
 
     def __len__(self):
         return len(self.rows)
@@ -87,6 +98,27 @@ class LocalQueryRunner:
         # one cache. DDL/INSERT invalidate by referenced table.
         self._plan_cache = PlanCache()
         self._owns_plan_cache = True
+        # serving-tier caches (trino_tpu/serve/caches.py): per-runner
+        # like the plan cache, shared with for_query() clones, and
+        # evicted by the SAME invalidation call DDL/INSERT drives into
+        # the plan cache (hooks below) — a cached answer or staged scan
+        # page can never outlive a table change
+        from trino_tpu.serve.caches import ResultSetCache, ScanCache
+        self._result_cache = ResultSetCache()
+        self._scan_cache = ScanCache()
+        self._plan_cache.add_invalidation_hook(self._result_cache.invalidate)
+        self._plan_cache.add_invalidation_hook(self._scan_cache.invalidate)
+        # streaming result sink for the CURRENT query (serve/streaming
+        # ResultStream, installed per execute() by the server): pages
+        # leave through the ring as they are produced; None = buffered
+        self._sink = None
+        # result-cache collection bound for the CURRENT query (None =
+        # unbounded materialization, the classic protocol)
+        self._cache_collect: Optional[int] = None
+        # tables the last executed plan referenced + its live output
+        # bytes (result-cache bookkeeping, stamped by the attempt)
+        self._last_plan_tables = frozenset()
+        self._last_output_nbytes = 0
         # statement parameter values for the CURRENT execution
         # (EXECUTE ... USING): expr/hoist.py binds BoundParam plan
         # leaves from this tuple at lowering time
@@ -127,6 +159,8 @@ class LocalQueryRunner:
         # cache: their (header-overridable) plan_cache_max_entries must
         # not resize the shared LRU out from under other sessions.
         clone._owns_plan_cache = False
+        clone._sink = None
+        clone._cache_collect = None
         clone._exec_params = ()
         clone._deadline = None
         clone._faults = None
@@ -155,7 +189,7 @@ class LocalQueryRunner:
     def execute(self, sql: str, *, query_id: Optional[str] = None,
                 queued_at: Optional[float] = None,
                 wall_cap_s: Optional[float] = None,
-                cancel_event=None) -> MaterializedResult:
+                cancel_event=None, result_sink=None) -> MaterializedResult:
         """Run one statement through the query lifecycle registry
         (QueryStateMachine analog): QUEUED -> RUNNING ->
         FINISHED/FAILED/CANCELED, visible in system.runtime.queries while
@@ -182,6 +216,11 @@ class LocalQueryRunner:
         info = TRACKER.begin(sql, user=self.session.user,
                              query_id=query_id, resource_group=group)
         self._retries = 0
+        # streaming sink (serve/streaming.ResultStream): the attempt
+        # opens it only for shapes where streaming is safe (no writer,
+        # no retries possible — see _run_plan_attempt); when it stays
+        # unopened the caller falls back to buffered paging
+        self._sink = result_sink
         # the query's stats pipeline: always-on query-level collection;
         # operator-level instrumentation is opt-in (session property) or
         # forced by EXPLAIN ANALYZE. The jit-cache observer is
@@ -233,6 +272,12 @@ class LocalQueryRunner:
                         result = self._execute_statement(stmt)
                     break
                 except Exception as e:
+                    if self._sink is not None and self._sink.emitted:
+                        # rows already left through the result stream: a
+                        # re-run would duplicate them client-side (the
+                        # attempt only opens the sink when no retry is
+                        # possible, so this is a guard, not a path)
+                        raise
                     if (attempts > 1 and not spill_forced
                             and _is_memory_pressure(e)):
                         # the killer's victim (or injected pressure):
@@ -265,10 +310,11 @@ class LocalQueryRunner:
             raise
         finally:
             self._deadline = None
+            self._sink = None
             jit_cache.set_observer(None)
         self._finish_query_stats(info)
         self._close_memory(info, failed=False)
-        TRACKER.finish(info, len(result.rows))
+        TRACKER.finish(info, result.reported_rows)
         return result
 
     def _close_memory(self, info, failed: bool) -> None:
@@ -381,6 +427,8 @@ class LocalQueryRunner:
                         return fn()
                 return fn()
             except Exception as e:
+                if self._sink is not None and self._sink.emitted:
+                    raise   # streamed rows cannot be un-delivered
                 memory_pressure = (isinstance(e, ExceededMemoryLimitError)
                                    or _is_memory_pressure(e))
                 if memory_pressure and not spill_forced \
@@ -407,7 +455,7 @@ class LocalQueryRunner:
 
     def _execute_statement(self, stmt: t.Statement) -> MaterializedResult:
         if isinstance(stmt, t.Query):
-            return self._execute_query(stmt)
+            return self._execute_query_cached(stmt)
         if isinstance(stmt, t.Explain):
             return self._explain(stmt)
         if isinstance(stmt, t.ShowTables):
@@ -502,7 +550,7 @@ class LocalQueryRunner:
         self.session.param_types = types
         self._exec_params = values
         try:
-            return self._execute_query(prepared)
+            return self._execute_query_cached(prepared)
         finally:
             self.session.param_types = None
             self._exec_params = ()
@@ -531,6 +579,118 @@ class LocalQueryRunner:
             types.append(typ)
             values.append(lit.value)
         return tuple(types), tuple(values)
+
+    # --------------------------------------------------- result-set cache
+
+    def _result_cache_eligible(self, query: t.Query) -> bool:
+        from trino_tpu.serve.caches import statement_is_cacheable
+        if not bool(self.session.get("result_cache_enabled")):
+            return False
+        if float(self.session.get("fault_injection_rate")) > 0:
+            return False    # a cached answer would dodge the chaos
+        col = self._collector
+        if col is not None and col.operator_level:
+            return False    # operator rows need a real execution
+        return statement_is_cacheable(query)
+
+    def _result_cache_key(self, query: t.Query):
+        """The plan-cache key PLUS the bound parameter values: a
+        prepared statement's plan is value-free, but its answer is
+        not."""
+        return (self._plan_cache_key(query), self._exec_params)
+
+    def _execute_query_cached(self, query: t.Query) -> MaterializedResult:
+        """SELECT through the serving tier's result-set cache: a hit
+        returns the materialized answer with zero planning, zero
+        compiles, zero operator execution; a miss executes normally and
+        publishes the answer when it is cacheable (deterministic
+        statement, non-system tables, within the row bound, and no
+        concurrent invalidation raced the execution)."""
+        from trino_tpu.serve.caches import CachedResult
+        if not self._result_cache_eligible(query):
+            return self._execute_query(query)
+        key = self._result_cache_key(query)
+        entry = self._result_cache.get(key)
+        col = self._collector
+        if entry is not None:
+            if col is not None:
+                col.result_cache_hit()
+                # output accounting stays consistent with a real run:
+                # rows/bytes count once whether executed, streamed, or
+                # served from cache
+                col.add_output(entry.row_count, entry.output_bytes)
+            return MaterializedResult(
+                list(entry.column_names), list(entry.column_types),
+                list(entry.rows), row_count=entry.row_count)
+        if col is not None:
+            col.result_cache_miss()
+        max_rows = int(self.session.get("result_cache_max_rows"))
+        gen = self._result_cache.generation()
+        self._cache_collect = max_rows
+        try:
+            result = self._execute_query(query)
+        finally:
+            self._cache_collect = None
+        tables = self._last_plan_tables
+        if (result.reported_rows <= max_rows
+                and len(result.rows) == result.reported_rows
+                and not any(tk[0] == "system" for tk in tables)):
+            if self._owns_plan_cache:
+                self._result_cache.resize(
+                    int(self.session.get("result_cache_max_entries")))
+            self._result_cache.put(
+                key,
+                CachedResult(tuple(result.column_names),
+                             tuple(result.column_types),
+                             tuple(result.rows), result.reported_rows,
+                             self._last_output_nbytes, frozenset(tables)),
+                gen=gen)
+        return result
+
+    def peek_cached_result(self, sql: str):
+        """Parse-only result-cache probe for the server's POST-time fast
+        path: resolves EXECUTE through the prepared map, binds parameter
+        values, and looks the key up WITHOUT planning or executing.
+        Returns the CachedResult or None (any wrinkle — unknown
+        statement kind, NULL parameters, arity mismatch — defers to the
+        normal dispatch path, which will surface the real error)."""
+        from trino_tpu.sql.analyzer import count_parameters
+        if not bool(self.session.get("result_cache_enabled")) or \
+                float(self.session.get("fault_injection_rate")) > 0 or \
+                bool(self.session.get("collect_operator_stats")):
+            return None
+        try:
+            stmt = parse_statement(sql)
+        except Exception:
+            return None
+        params: Tuple[Any, ...] = ()
+        if isinstance(stmt, t.ExecuteStatement):
+            prepared = self._prepared.get(stmt.name.value)
+            if not isinstance(prepared, t.Query):
+                return None
+            if count_parameters(prepared) != len(stmt.parameters):
+                return None
+            if stmt.parameters:
+                try:
+                    types, values = self._bind_execute_parameters(stmt)
+                except Exception:
+                    return None
+                if any(v is None for v in values):
+                    return None
+                self.session.param_types = types
+                params = values
+            stmt = prepared
+        if not isinstance(stmt, t.Query):
+            return None
+        try:
+            saved, self._exec_params = self._exec_params, params
+            try:
+                key = self._result_cache_key(stmt)
+            finally:
+                self._exec_params = saved
+        finally:
+            self.session.param_types = None
+        return self._result_cache.get(key, count_miss=False)
 
     def _session_property_changed(self, name: str) -> None:
         """SET/RESET SESSION side effects: resizing the plan-cache LRU
@@ -622,6 +782,8 @@ class LocalQueryRunner:
 
     def _execute_query(self, query: t.Query) -> MaterializedResult:
         plan = self._plan_query(query)
+        from trino_tpu.exec.plan_cache import plan_tables
+        self._last_plan_tables = plan_tables(plan)
         return self._run_plan(plan)
 
     def _run_plan(self, plan: OutputNode) -> MaterializedResult:
@@ -638,6 +800,14 @@ class LocalQueryRunner:
             return self._retry_task("local-plan",
                                     lambda: self._run_plan_attempt(plan))
 
+    def _streaming_safe(self) -> bool:
+        """Streaming is only safe when NO re-run is possible: a retry
+        after rows left the ring would duplicate them client-side
+        (retry_policy=NONE also rules out the memory-degrade re-run),
+        and injected chaos exists to exercise retries."""
+        return (str(self.session.get("retry_policy")).upper() == "NONE"
+                and self._faults is None)
+
     def _run_plan_attempt(self, plan: OutputNode,
                           chaos: bool = True) -> MaterializedResult:
         self._check_deadline()
@@ -646,11 +816,28 @@ class LocalQueryRunner:
         executor.deadline = self._deadline
         executor.collector = self._collector
         executor.exec_params = self._exec_params
+        if bool(self.session.get("scan_cache_enabled")) \
+                and self._faults is None:
+            # chaos runs bypass the scan cache: the `scan` fault site
+            # must fire, and injected scan failures must not poison it
+            executor.scan_cache = self._scan_cache
         if self._memory is not None:
             executor.memory = self._memory   # query-level shared ledger
         stream = executor.execute(plan)
         types = [s.type for s in plan.symbols]
+        sink = self._sink
+        if sink is not None and (_contains_writer(plan)
+                                 or not self._streaming_safe()):
+            sink = None     # unopened sink -> caller pages the buffered result
+        if sink is not None:
+            sink.open(list(plan.column_names), types)
         rows: List[Tuple[Any, ...]] = []
+        # when streaming, the materialized copy exists only to feed the
+        # result cache — past the collection bound it is dropped and the
+        # rows live solely in the ring until the client drains them
+        collect_cap = self._cache_collect if sink is not None else None
+        collecting = sink is None or collect_cap is not None
+        total = 0
         nbytes = 0
         from trino_tpu.exec.memory import live_page_bytes
         for page in stream.iter_pages():
@@ -660,15 +847,38 @@ class LocalQueryRunner:
                 continue
             nbytes += live_page_bytes(page, n)
             cols = page.to_host(n)
-            for i in range(n):
-                rows.append(tuple(
-                    _to_python(cols[j][i], types[j])
-                    for j in range(len(cols))))
+            chunk = [tuple(_to_python(cols[j][i], types[j])
+                           for j in range(len(cols)))
+                     for i in range(n)]
+            total += n
+            if sink is not None:
+                sink.put(chunk, checkpoint=self._check_deadline)
+                if collecting and (collect_cap is None
+                                   or total <= collect_cap):
+                    rows.extend(chunk)
+                else:
+                    collecting = False
+                    rows = []
+            else:
+                rows.extend(chunk)
+        if sink is not None:
+            # publish the staged partial final chunk while still inside
+            # execution (the FINISHING window opens only after the whole
+            # result is ring-visible), then account delivery ONCE
+            sink.flush(checkpoint=self._check_deadline)
+            if self._collector is not None and total:
+                self._collector.add_streamed(
+                    -(-total // sink.chunk_rows), total)
         if chaos and self._faults is not None:
             self._faults.site("fragment", "local-plan")
+        self._last_output_nbytes = nbytes
         if self._collector is not None:
-            self._collector.add_output(len(rows), nbytes)
-        return MaterializedResult(list(plan.column_names), types, rows)
+            # rows/bytes count ONCE here, whether the result was
+            # streamed through the ring or buffered (satellite contract:
+            # QueryInfo.stats is delivery-mode independent)
+            self._collector.add_output(total, nbytes)
+        return MaterializedResult(list(plan.column_names), types, rows,
+                                  row_count=total)
 
     # --------------------------------------------------------------- DDL
 

@@ -92,6 +92,26 @@ SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     # every other session's warm plans from the shared cache.
     "plan_cache_enabled": True,
     "plan_cache_max_entries": 256,
+    # serving tier (trino_tpu/serve/): result-set caching — a repeated
+    # statement (same fingerprint + literal/parameter VALUES) over
+    # unchanged tables returns its materialized answer with zero
+    # planning, zero compiles, zero execution. INSERT/DDL evicts through
+    # the plan cache's invalidation hooks. Off by default on direct
+    # runners; TrinoServer turns it on for server sessions (the
+    # production front door is what the cache exists for). Skipped per
+    # query under fault injection (a cached answer would dodge the chaos
+    # the session asked for) and under collect_operator_stats (operator
+    # rows must come from a real execution).
+    "result_cache_enabled": False,
+    "result_cache_max_entries": 128,
+    # per-entry row bound: results larger than this are never cached
+    # (and a streamed result past the bound stops buffering host-side)
+    "result_cache_max_rows": 100000,
+    # table-scan page cache: raw connector pages staged on device,
+    # reusable by ANY query over the same columns; byte-budgeted LRU,
+    # invalidated per table like the result cache. Off by default
+    # (direct runners); TrinoServer turns it on.
+    "scan_cache_enabled": False,
     # observability (obs/stats.py): per-operator stats collection for
     # EVERY query on the session (EXPLAIN ANALYZE forces it regardless).
     # Off by default: instrumenting node boundaries splits fused kernel

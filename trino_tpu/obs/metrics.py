@@ -345,5 +345,47 @@ def _engine_gauges():
            "Plans dropped by DDL/INSERT table invalidation since "
            "process start.", ps["invalidations"], {})
 
+    from trino_tpu.serve.caches import (result_cache_stats,
+                                        scan_cache_stats)
+    rs = result_cache_stats()
+    yield ("trino_tpu_result_cache_entries",
+           "Materialized results resident across live result caches.",
+           rs["entries"], {})
+    yield ("trino_tpu_result_cache_hits",
+           "Result cache hits since process start — statements answered "
+           "with zero planning, zero compiles, zero execution.",
+           rs["hits"], {})
+    yield ("trino_tpu_result_cache_misses",
+           "Result cache misses (statements executed) since process "
+           "start.", rs["misses"], {})
+    yield ("trino_tpu_result_cache_evictions_total",
+           "Results evicted by the LRU since process start.",
+           rs["evictions"], {})
+    yield ("trino_tpu_result_cache_invalidations_total",
+           "Results dropped by DDL/INSERT table invalidation since "
+           "process start.", rs["invalidations"], {})
+    ss = scan_cache_stats()
+    yield ("trino_tpu_scan_cache_entries",
+           "Staged table scans resident across live scan caches.",
+           ss["entries"], {})
+    yield ("trino_tpu_scan_cache_bytes",
+           "Device bytes pinned by staged scan pages.", ss["bytes"], {})
+    yield ("trino_tpu_scan_cache_hits",
+           "Scan cache hits since process start — table scans served "
+           "from staged device pages.", ss["hits"], {})
+    yield ("trino_tpu_scan_cache_misses",
+           "Scan cache misses (scans staged from the connector) since "
+           "process start.", ss["misses"], {})
+
+    from trino_tpu.serve.streaming import stream_stats
+    st = stream_stats()
+    yield ("trino_tpu_streams_open",
+           "Result streams currently open (producing or draining).",
+           st["open"], {})
+    yield ("trino_tpu_stream_buffered_chunks",
+           "Result chunks resident in open stream ring buffers "
+           "(bounded per stream by the ring size — the backpressure "
+           "signal).", st["buffered_chunks"], {})
+
 
 REGISTRY.register_gauges(_engine_gauges)

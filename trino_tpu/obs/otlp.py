@@ -1,0 +1,168 @@
+"""OTLP/JSON trace span export, wired from the event-listener registry.
+
+Reference parity: trino-main's OpenTelemetry integration
+(io.opentelemetry wiring in ServerMainModule) exports query spans over
+OTLP so fleet operators correlate engine traces with everything else.
+Here the engine's spans are the structured dump obs/spans.py already
+records per query (QueryInfo.trace); this module converts that dump to
+the OTLP/JSON `ResourceSpans` shape and ships it from a query_completed/
+query_failed event listener — OFF by default, enabled by registering the
+listener (install_otlp_exporter, the TrinoServer `otlp_export` option,
+or $TRINO_TPU_OTLP_ENDPOINT / $TRINO_TPU_OTLP_FILE).
+
+Targets: an `http(s)://` endpoint receives one POST per query at
+`<endpoint>/v1/traces` (the OTLP/HTTP JSON binding); any other target is
+a file path appended one JSON line per query (the file-exporter shape
+collectors replay). Export failures are swallowed and logged — tracing
+must never fail queries (the same contract as every other listener).
+
+Span identity: the trace id derives from the query id (16 bytes of its
+blake2b), span ids from the path to the span in the tree — stable,
+collision-resistant, and reproducible across re-exports of one query.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from trino_tpu.obs.listeners import (EventListener, QueryEvent,
+                                     register_listener,
+                                     unregister_listener)
+
+log = logging.getLogger("trino_tpu.obs.otlp")
+
+
+def _hex_id(seed: str, nbytes: int) -> str:
+    return hashlib.blake2b(seed.encode(), digest_size=nbytes).hexdigest()
+
+
+def _attr_value(v: Any) -> Dict[str, Any]:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}    # OTLP JSON carries int64 as string
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def _attributes(attrs: Dict[str, Any]) -> List[Dict[str, Any]]:
+    return [{"key": str(k), "value": _attr_value(v)}
+            for k, v in attrs.items()]
+
+
+def spans_to_otlp(trace: Dict[str, Any], query_id: str,
+                  end_unix_ns: Optional[int] = None) -> Dict[str, Any]:
+    """One query's span dump (Span.to_json shape: relative start_ms /
+    wall_ms trees) -> an OTLP/JSON ResourceSpans payload. The dump's
+    times are relative to the query root; `end_unix_ns` (default: now)
+    anchors them on the wall clock so the absolute timestamps line up
+    with when the export happened."""
+    if end_unix_ns is None:
+        end_unix_ns = time.time_ns()
+    root_wall_ns = int(trace.get("wall_ms", 0.0) * 1e6)
+    origin_ns = end_unix_ns - root_wall_ns
+    trace_id = _hex_id(query_id, 16)
+    spans: List[Dict[str, Any]] = []
+
+    def walk(node: Dict[str, Any], path: str, parent_span_id: str) -> None:
+        span_id = _hex_id(f"{query_id}/{path}", 8)
+        start_ns = origin_ns + int(node.get("start_ms", 0.0) * 1e6)
+        attrs = dict(node.get("attrs", ()))
+        attrs["trino.span.kind"] = node.get("kind", "internal")
+        span = {
+            "traceId": trace_id,
+            "spanId": span_id,
+            "name": node.get("name", "span"),
+            "kind": 1,     # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(start_ns),
+            "endTimeUnixNano": str(
+                start_ns + int(node.get("wall_ms", 0.0) * 1e6)),
+            "attributes": _attributes(attrs),
+        }
+        if parent_span_id:
+            span["parentSpanId"] = parent_span_id
+        spans.append(span)
+        for i, child in enumerate(node.get("children", ())):
+            walk(child, f"{path}/{i}:{child.get('name', '')}", span_id)
+
+    walk(trace, trace.get("name", "query"), "")
+    return {
+        "resourceSpans": [{
+            "resource": {"attributes": _attributes(
+                {"service.name": "trino-tpu",
+                 "trino.query_id": query_id})},
+            "scopeSpans": [{
+                "scope": {"name": "trino_tpu.obs"},
+                "spans": spans,
+            }],
+        }],
+    }
+
+
+class OtlpSpanExporter(EventListener):
+    """The listener: exports every completed/failed query's trace."""
+
+    def __init__(self, endpoint: Optional[str] = None,
+                 path: Optional[str] = None, timeout_s: float = 2.0):
+        if (endpoint is None) == (path is None):
+            raise ValueError(
+                "OtlpSpanExporter needs exactly one of endpoint / path")
+        self.endpoint = endpoint
+        self.path = path
+        self.timeout_s = timeout_s
+        self.exported = 0
+        self.failed = 0
+
+    def query_completed(self, event: QueryEvent) -> None:
+        self._export(event)
+
+    def query_failed(self, event: QueryEvent) -> None:
+        self._export(event)
+
+    def _export(self, event: QueryEvent) -> None:
+        if not event.trace:
+            return     # nothing recorded (e.g. a pre-execute failure)
+        try:
+            payload = spans_to_otlp(event.trace, event.query_id)
+            if self.path is not None:
+                with open(self.path, "a") as f:
+                    f.write(json.dumps(payload) + "\n")
+            else:
+                import urllib.request
+                req = urllib.request.Request(
+                    self.endpoint.rstrip("/") + "/v1/traces",
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                urllib.request.urlopen(req, timeout=self.timeout_s).close()
+            self.exported += 1
+        except Exception:   # noqa: BLE001 — tracing must not fail queries
+            self.failed += 1
+            log.exception("OTLP span export failed for %s", event.query_id)
+
+
+def install_otlp_exporter(target: Optional[str] = None
+                          ) -> Optional[OtlpSpanExporter]:
+    """Register an exporter for `target` (http(s) endpoint or file
+    path), falling back to $TRINO_TPU_OTLP_ENDPOINT then
+    $TRINO_TPU_OTLP_FILE. Returns None (exporting stays OFF) when no
+    target is configured anywhere."""
+    target = (target or os.environ.get("TRINO_TPU_OTLP_ENDPOINT")
+              or os.environ.get("TRINO_TPU_OTLP_FILE"))
+    if not target:
+        return None
+    if target.startswith("http://") or target.startswith("https://"):
+        exporter = OtlpSpanExporter(endpoint=target)
+    else:
+        exporter = OtlpSpanExporter(path=target)
+    return register_listener(exporter)
+
+
+def uninstall_otlp_exporter(exporter: OtlpSpanExporter) -> None:
+    unregister_listener(exporter)

@@ -76,6 +76,19 @@ class QueryStatsCollector:
         # query skipped parse->plan->optimize and re-ran a cached plan
         self.plan_cache_hits = 0
         self.plan_cache_misses = 0
+        # serving-tier caches (trino_tpu/serve/caches.py): a result-cache
+        # hit answered with zero planning/compiles/execution; a
+        # scan-cache hit reused staged device pages for a table scan
+        self.result_cache_hits = 0
+        self.result_cache_misses = 0
+        self.scan_cache_hits = 0
+        self.scan_cache_misses = 0
+        # streaming delivery (trino_tpu/serve/streaming.py): chunks that
+        # left through the result ring buffer. Output rows/bytes are
+        # counted ONCE at the producer regardless of whether the result
+        # was streamed, buffered, or served from the result cache.
+        self.streamed_chunks = 0
+        self.streamed_rows = 0
         self.retries = 0
         self.faults_injected = 0
         # inter-fragment exchange data plane (exec/mesh_exec.py +
@@ -158,6 +171,22 @@ class QueryStatsCollector:
     def plan_cache_miss(self) -> None:
         self.plan_cache_misses += 1
 
+    def result_cache_hit(self) -> None:
+        self.result_cache_hits += 1
+
+    def result_cache_miss(self) -> None:
+        self.result_cache_misses += 1
+
+    def scan_cache_hit(self) -> None:
+        self.scan_cache_hits += 1
+
+    def scan_cache_miss(self) -> None:
+        self.scan_cache_misses += 1
+
+    def add_streamed(self, chunks: int, rows: int) -> None:
+        self.streamed_chunks += int(chunks)
+        self.streamed_rows += int(rows)
+
     def add_exchange(self, mode: str, rows: int = 0, nbytes: int = 0
                      ) -> None:
         """One inter-fragment exchange applied; mode 'fused' (collective
@@ -212,6 +241,12 @@ class QueryStatsCollector:
             "jit_param_hits": self.jit_param_hits,
             "plan_cache_hits": self.plan_cache_hits,
             "plan_cache_misses": self.plan_cache_misses,
+            "result_cache_hits": self.result_cache_hits,
+            "result_cache_misses": self.result_cache_misses,
+            "scan_cache_hits": self.scan_cache_hits,
+            "scan_cache_misses": self.scan_cache_misses,
+            "streamed_chunks": self.streamed_chunks,
+            "streamed_rows": self.streamed_rows,
             "retries": self.retries,
             "faults_injected": self.faults_injected,
             "exchanges_fused": self.exchanges_fused,

@@ -12,4 +12,5 @@ from trino_tpu.ops.filter_project import filter_project
 from trino_tpu.ops.aggregate import (
     AGGREGATES, AggSpec, hash_aggregate, Step)
 from trino_tpu.ops.join import hash_join, prepare_build, JoinType
-from trino_tpu.ops.sort import limit, order_by, top_n, SortKey
+from trino_tpu.ops.sort import (limit, order_by, top_n, top_n_masked,
+                                SortKey)

@@ -12,7 +12,7 @@ ORDER BY is stable w.r.t. input order via a trailing row-index key.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Optional, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -83,17 +83,32 @@ def order_by(keys: Sequence[SortKey]) -> Callable[[Page], Page]:
     return op
 
 
-def top_n(count: int, keys: Sequence[SortKey]) -> Callable[[Page], Page]:
-    """ORDER BY ... LIMIT n. Full sort then truncate count.
-
-    (TopNOperator analog; a partial top-k kernel is a later optimization —
-    correctness first, the sort is already one fused XLA op.)
-    """
+def top_n_masked(keys: Sequence[SortKey]) -> Callable[[Page, Any], Page]:
+    """ORDER BY ... LIMIT ? with the COUNT as a runtime operand: the
+    sort runs at full page capacity and the count only masks `num_rows`,
+    so nothing in the traced program depends on it — one jitted
+    executable (keyed literal-free, like a hoisted parameter) serves
+    LIMIT 5 and LIMIT 500 of the same shape. This is what lets a warmup
+    manifest cover a whole `LIMIT k` family with one compile."""
     sort_op = order_by(keys)
 
-    def op(page: Page) -> Page:
+    def op(page: Page, count) -> Page:
         out = sort_op(page)
-        return Page(out.columns, jnp.minimum(out.num_rows, count))
+        return Page(out.columns,
+                    jnp.minimum(out.num_rows,
+                                jnp.asarray(count, dtype=jnp.int32)))
+
+    return op
+
+
+def top_n(count: int, keys: Sequence[SortKey]) -> Callable[[Page], Page]:
+    """ORDER BY ... LIMIT n with the count baked in (TopNOperator
+    analog): the masked kernel with a fixed count — mesh programs and
+    other static callers keep this shape."""
+    masked = top_n_masked(keys)
+
+    def op(page: Page) -> Page:
+        return masked(page, count)
 
     return op
 

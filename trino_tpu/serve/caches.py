@@ -1,0 +1,281 @@
+"""Serving-tier caches: result sets and table-scan pages.
+
+Reference parity: the reference engine has no built-in result cache (it
+fronts one with external layers; Presto forks ship a coordinator result
+cache keyed on the canonical statement), and its connectors implement
+scan caching individually (Hive/Alluxio). Here both live in the engine,
+keyed on the SAME statement fingerprints the plan cache uses
+(exec/plan_cache.py), and evicted through the plan cache's invalidation
+hooks — one DDL/INSERT drops the plan, the cached result sets, and the
+staged scan pages in a single call, so a stale answer is structurally
+impossible rather than merely unlikely.
+
+ResultSetCache: fully-materialized query answers. Key = the runner's
+plan-cache key (canonical literal-free fingerprint + masked literal
+values + catalog/schema/current_date + parameter types + plan
+properties) plus the BOUND parameter values — a prepared statement's
+plan is value-free but its answer is not. A hit returns rows with zero
+planning, zero compiles, and zero operator execution. Entries record the
+tables their plan referenced; `invalidate(table)` drops every entry
+touching the table, and `put` carries the generation read before
+execution so a result computed against pre-change data can never land
+after the invalidation that should have dropped it (the same race guard
+as PlanCache.put).
+
+ScanCache: raw connector pages staged on device, keyed on (table,
+columns, page capacity). Downstream filters/projections are pending
+chain ops applied per query, so raw pages are reusable by ANY query
+over the same columns — a warm scan skips the host->device staging that
+dominates small-table latency. Byte-budgeted LRU (pages pin device
+memory); invalidated per table like the result cache.
+
+Both caches are per-runner (they hold handles/pages resolved against
+that runner's catalogs), shared with `for_query()` clones under a lock
+— the server's executor pool warms one of each.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import weakref
+from typing import Any, Dict, FrozenSet, Hashable, List, Optional, Tuple
+
+TableKey = Tuple[str, str, str]   # (catalog, schema, table)
+
+# process-lifetime counters across every runner's caches (obs/metrics.py
+# exports these; system.runtime.caches scans them)
+_RESULT_STATS = {"hits": 0, "misses": 0, "evictions": 0,
+                 "invalidations": 0}
+_SCAN_STATS = {"hits": 0, "misses": 0, "evictions": 0, "invalidations": 0}
+_STATS_LOCK = threading.Lock()
+_RESULT_INSTANCES: "weakref.WeakSet[ResultSetCache]" = weakref.WeakSet()
+_SCAN_INSTANCES: "weakref.WeakSet[ScanCache]" = weakref.WeakSet()
+
+DEFAULT_RESULT_MAX_ENTRIES = 128
+DEFAULT_SCAN_BUDGET_BYTES = 512 << 20
+
+# functions whose value depends on more than their arguments: a result
+# containing one must be recomputed per execution (current_date is fine —
+# it is part of the statement key via session.start_date)
+_NONDETERMINISTIC_FUNCTIONS = frozenset({
+    "random", "rand", "uuid", "shuffle", "now", "current_timestamp",
+    "localtimestamp", "current_time", "localtime"})
+
+
+def statement_is_cacheable(stmt) -> bool:
+    """True when a statement's answer is a pure function of its text and
+    the tables it reads: no nondeterministic function calls anywhere in
+    the AST. Table-level concerns (system catalog, referenced-table
+    invalidation) are handled by the caller from the executed plan."""
+    from trino_tpu.sql import tree as t
+
+    def walk(x) -> bool:
+        if isinstance(x, t.FunctionCall):
+            if x.name.suffix.lower() in _NONDETERMINISTIC_FUNCTIONS:
+                return False
+        if dataclasses.is_dataclass(x) and isinstance(x, t.Node):
+            return all(walk(getattr(x, f.name))
+                       for f in dataclasses.fields(x))
+        if isinstance(x, (tuple, list)):
+            return all(walk(item) for item in x)
+        return True
+    return walk(stmt)
+
+
+def _count(stats: Dict[str, int], name: str, n: int = 1) -> None:
+    with _STATS_LOCK:
+        stats[name] += n
+
+
+@dataclasses.dataclass
+class CachedResult:
+    """One materialized answer: what a cache-hit EXECUTE returns without
+    touching the planner or the device."""
+
+    column_names: Tuple[str, ...]
+    column_types: Tuple[Any, ...]
+    rows: Tuple[Tuple[Any, ...], ...]
+    row_count: int
+    output_bytes: int               # live-row device bytes of the answer
+    tables: FrozenSet[TableKey]     # referenced tables, for invalidation
+
+
+class _GenerationGuard:
+    """The put-generation race discipline every cache layer shares (the
+    same guard as exec/plan_cache.PlanCache): `generation()` snapshots
+    BEFORE the work whose output will be cached; `put` rejects when any
+    referenced table was invalidated since — so a value computed against
+    pre-change state can never land after the invalidation that should
+    have dropped it. Single-sourced here so a fix to the discipline
+    cannot silently miss one cache."""
+
+    def _init_generations(self) -> None:
+        self._gen = 0
+        self._invalidated_at: Dict[TableKey, int] = {}
+
+    def generation(self) -> int:
+        with self._lock:
+            return self._gen
+
+    def _bump_generation_locked(self, table: TableKey) -> None:
+        self._gen += 1
+        self._invalidated_at[table] = self._gen
+
+    def _stale_locked(self, tables, gen: Optional[int]) -> bool:
+        return gen is not None and any(
+            self._invalidated_at.get(tk, 0) > gen for tk in tables)
+
+
+class ResultSetCache(_GenerationGuard):
+    """LRU of materialized results with table-keyed invalidation and the
+    put-generation race guard (see module docstring)."""
+
+    def __init__(self, max_entries: int = DEFAULT_RESULT_MAX_ENTRIES):
+        self._lock = threading.RLock()
+        self._entries: "collections.OrderedDict[Hashable, CachedResult]" \
+            = collections.OrderedDict()
+        self.max_entries = max_entries
+        self._init_generations()
+        _RESULT_INSTANCES.add(self)
+
+    def get(self, key: Hashable,
+            count_miss: bool = True) -> Optional[CachedResult]:
+        """`count_miss=False` is the server's POST-time probe: a probe
+        miss falls through to the execute path, which counts the miss
+        itself — counting both would double every dispatched query."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                if count_miss:
+                    _count(_RESULT_STATS, "misses")
+                return None
+            self._entries.move_to_end(key)
+            _count(_RESULT_STATS, "hits")
+            return entry
+
+    def put(self, key: Hashable, entry: CachedResult,
+            gen: Optional[int] = None) -> bool:
+        if self.max_entries <= 0:
+            return False
+        with self._lock:
+            if self._stale_locked(entry.tables, gen):
+                return False
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                _count(_RESULT_STATS, "evictions")
+            return True
+
+    def resize(self, max_entries: int) -> None:
+        with self._lock:
+            self.max_entries = max_entries
+            while len(self._entries) > max(self.max_entries, 0):
+                self._entries.popitem(last=False)
+                _count(_RESULT_STATS, "evictions")
+
+    def invalidate(self, table: TableKey) -> int:
+        with self._lock:
+            self._bump_generation_locked(table)
+            stale = [k for k, e in self._entries.items()
+                     if table in e.tables]
+            for k in stale:
+                del self._entries[k]
+        if stale:
+            _count(_RESULT_STATS, "invalidations", len(stale))
+        return len(stale)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class ScanCache(_GenerationGuard):
+    """Byte-budgeted LRU of raw staged scan pages, keyed on (table,
+    column identities, page capacity)."""
+
+    def __init__(self, budget_bytes: int = DEFAULT_SCAN_BUDGET_BYTES):
+        self._lock = threading.RLock()
+        # key -> (pages, nbytes); key[0] is the TableKey, for invalidation
+        self._entries: "collections.OrderedDict[Hashable, tuple]" = \
+            collections.OrderedDict()
+        self.budget_bytes = budget_bytes
+        self.resident_bytes = 0
+        self._init_generations()
+        _SCAN_INSTANCES.add(self)
+
+    def get(self, key: Hashable) -> Optional[List]:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                _count(_SCAN_STATS, "misses")
+                return None
+            self._entries.move_to_end(key)
+            _count(_SCAN_STATS, "hits")
+            return entry[0]
+
+    def put(self, key: Hashable, pages: List,
+            gen: Optional[int] = None) -> bool:
+        from trino_tpu.exec.memory import page_bytes
+        nbytes = sum(page_bytes(p) for p in pages)
+        if nbytes > self.budget_bytes:
+            return False    # one oversized scan must not evict everything
+        with self._lock:
+            if self._stale_locked((key[0],), gen):
+                return False    # the table changed while this scan ran
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.resident_bytes -= old[1]
+            self._entries[key] = (list(pages), nbytes)
+            self.resident_bytes += nbytes
+            while self.resident_bytes > self.budget_bytes and self._entries:
+                _, (_, freed) = self._entries.popitem(last=False)
+                self.resident_bytes -= freed
+                _count(_SCAN_STATS, "evictions")
+            return True
+
+    def invalidate(self, table: TableKey) -> int:
+        with self._lock:
+            self._bump_generation_locked(table)
+            stale = [k for k in self._entries if k[0] == table]
+            for k in stale:
+                _, nbytes = self._entries.pop(k)
+                self.resident_bytes -= nbytes
+        if stale:
+            _count(_SCAN_STATS, "invalidations", len(stale))
+        return len(stale)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.resident_bytes = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+def result_cache_stats() -> Dict[str, int]:
+    """Process-lifetime counters + resident entries across live caches
+    (obs/metrics.py gauges + system.runtime.caches)."""
+    with _STATS_LOCK:
+        out = dict(_RESULT_STATS)
+    caches = list(_RESULT_INSTANCES)
+    out["entries"] = sum(len(c) for c in caches)
+    out["max_entries"] = sum(c.max_entries for c in caches)
+    return out
+
+
+def scan_cache_stats() -> Dict[str, int]:
+    with _STATS_LOCK:
+        out = dict(_SCAN_STATS)
+    caches = list(_SCAN_INSTANCES)
+    out["entries"] = sum(len(c) for c in caches)
+    out["bytes"] = sum(c.resident_bytes for c in caches)
+    return out

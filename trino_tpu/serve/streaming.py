@@ -1,0 +1,205 @@
+"""Bounded result ring buffers: the streaming half of the statement
+protocol.
+
+Reference parity: the reference coordinator pages results to the client
+from per-query output buffers (QueuedStatementResource handing off to
+ExecutingStatementResource over ClientBuffer/PagesResponse) — the client
+follows `nextUri` and receives data as stages produce it, with
+backpressure propagating to the producers when the buffers fill. Here
+the buffer is a ResultStream: the executor thread converts device pages
+to client rows and `put`s fixed-size chunks into a bounded ring; the
+HTTP thread `get`s chunk `token` per page request. When the ring is
+full — the client lags — the producer BLOCKS inside `put`, which sits at
+a cooperative checkpoint: execution pauses (no further device dispatch,
+no further host buffering) until the client drains a chunk, and a
+cancel/deadline raised by the checkpoint unwinds the producer the same
+way it unwinds a running kernel loop.
+
+Token protocol: `get(token)` serves chunk `token` and treats it as an
+implicit ack of every earlier chunk (dropped from the ring — the client
+advanced past them). A RETRY of the most recent token therefore still
+works (the reference's client retries the same nextUri on transport
+errors), but a token behind the ack horizon is gone.
+
+Stall guard: a client that vanishes without DELETE would otherwise park
+the producer in `put` forever, pinning an executor slot. If no consumer
+progress happens for `stall_timeout_s`, `put` raises
+QueryCanceledError — the query unwinds as CANCELED and the slot frees.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+# live streams, for the /v1/metrics stream gauges
+_STREAMS: "weakref.WeakSet[ResultStream]" = weakref.WeakSet()
+
+DEFAULT_RING_CHUNKS = 16
+DEFAULT_CHUNK_ROWS = 1000
+DEFAULT_STALL_TIMEOUT_S = 300.0
+
+
+class ResultStream:
+    def __init__(self, max_chunks: int = DEFAULT_RING_CHUNKS,
+                 chunk_rows: int = DEFAULT_CHUNK_ROWS,
+                 stall_timeout_s: float = DEFAULT_STALL_TIMEOUT_S):
+        self._cond = threading.Condition()
+        self.max_chunks = max(1, int(max_chunks))
+        self.chunk_rows = max(1, int(chunk_rows))
+        self.stall_timeout_s = stall_timeout_s
+        self._chunks: Dict[int, List[tuple]] = {}   # token -> rows
+        # rows awaiting a full chunk: every published chunk except the
+        # LAST is exactly `chunk_rows` rows, so ring tokens stay
+        # aligned with the buffered path's rows[token*n:(token+1)*n]
+        # slicing — the server can switch delivery modes mid-drain
+        # without losing or duplicating rows
+        self._staged: List[tuple] = []
+        self._next_put = 0      # next token the producer writes
+        self._base = 0          # lowest retained token (ack horizon)
+        self.opened = False     # producer published column metadata
+        self.emitted = False    # at least one chunk left the producer
+        self.closed = False     # producer finished (or failed)
+        self.error: Optional[BaseException] = None
+        self.column_names: Optional[List[str]] = None
+        self.column_types: Optional[List[Any]] = None
+        self.total_rows = 0
+        self.high_watermark = 0     # max chunks ever resident (tests/gauges)
+        self._last_progress = time.monotonic()
+        _STREAMS.add(self)
+
+    # ---------------------------------------------------------- producer
+
+    def open(self, column_names: List[str], column_types: List[Any]) -> None:
+        with self._cond:
+            self.column_names = list(column_names)
+            self.column_types = list(column_types)
+            self.opened = True
+            self._cond.notify_all()
+
+    def put(self, rows: List[tuple], checkpoint=None) -> None:
+        """Append rows; FULL `chunk_rows`-sized chunks publish into the
+        ring, the remainder stages until more rows (or `flush`) arrive.
+        Blocks while the ring is full; `checkpoint` (the runner's
+        cancel/deadline check) runs between waits so a DELETE or timeout
+        unwinds a paused producer."""
+        self._staged.extend(rows)
+        while len(self._staged) >= self.chunk_rows:
+            chunk = self._staged[:self.chunk_rows]
+            del self._staged[:self.chunk_rows]
+            self._publish(chunk, checkpoint)
+
+    def flush(self, checkpoint=None) -> None:
+        """Publish the staged remainder as the (partial) final chunk —
+        the producer calls this after its last page, while still inside
+        execution, so the whole result is ring-visible before close."""
+        if self._staged:
+            chunk, self._staged = self._staged, []
+            self._publish(chunk, checkpoint)
+
+    def _publish(self, chunk: List[tuple], checkpoint) -> None:
+        from trino_tpu.errors import QueryCanceledError
+        with self._cond:
+            while self._next_put - self._base >= self.max_chunks:
+                if time.monotonic() - self._last_progress > \
+                        self.stall_timeout_s:
+                    raise QueryCanceledError(
+                        "streaming client made no progress for "
+                        f"{self.stall_timeout_s:.0f}s")
+                self._cond.wait(0.05)
+                if checkpoint is not None:
+                    # safe under the ring lock: the checkpoint only
+                    # reads deadline state / polls the node pool,
+                    # neither of which ever waits on a stream
+                    checkpoint()
+            self._chunks[self._next_put] = chunk
+            self._next_put += 1
+            self.emitted = True
+            self.total_rows += len(chunk)
+            self.high_watermark = max(self.high_watermark,
+                                      self._next_put - self._base)
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        self.flush()    # safety: a producer that skipped flush()
+        with self._cond:
+            self.closed = True
+            self._cond.notify_all()
+
+    def fail(self, exc: BaseException) -> None:
+        with self._cond:
+            self._staged = []   # never-published rows die with the query
+            self.error = exc
+            self.closed = True
+            self._cond.notify_all()
+
+    # ---------------------------------------------------------- consumer
+
+    def get(self, token: int, timeout: float = 0.2
+            ) -> Tuple[str, Optional[List[tuple]]]:
+        """('chunk', rows) when chunk `token` is (or becomes) available
+        within `timeout`; ('end', None) once the producer closed and
+        every chunk before `token` was served; ('pending', None) on
+        timeout — the server answers with the SAME token so the client
+        polls again; ('gone', None) for a token behind the ack horizon;
+        ('error', None) after a producer failure (read `self.error`)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            if token < self._base:
+                return "gone", None
+            # requesting token t ACKS every earlier chunk — free their
+            # ring slots NOW, so a full ring unblocks the producer even
+            # while the client is still waiting for t to be produced
+            # (ack-on-serve would deadlock a size-1 ring: the producer
+            # waits for the ack, the ack waits for the next chunk)
+            new_base = min(token, self._next_put)
+            if new_base > self._base:
+                for old in range(self._base, new_base):
+                    self._chunks.pop(old, None)
+                self._base = new_base
+                self._last_progress = time.monotonic()
+                self._cond.notify_all()
+            while True:
+                if token < self._base:
+                    # a concurrent get for a later token acked past us
+                    # while we waited (duplicate/retried request)
+                    return "gone", None
+                if token < self._next_put:
+                    self._last_progress = time.monotonic()
+                    return "chunk", self._chunks[token]
+                if self.closed:
+                    if self.error is not None:
+                        return "error", None
+                    # final ack: the ring is fully drained
+                    self._chunks.clear()
+                    self._base = self._next_put
+                    return "end", None
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return "pending", None
+                self._cond.wait(remaining)
+
+    # ----------------------------------------------------------- status
+
+    @property
+    def buffered(self) -> int:
+        with self._cond:
+            return self._next_put - self._base
+
+    @property
+    def drained(self) -> bool:
+        """Producer closed AND every chunk acked."""
+        with self._cond:
+            return self.closed and self._base >= self._next_put
+
+
+def stream_stats() -> Dict[str, int]:
+    """Live-stream rollup for the /v1/metrics gauges: open (undrained)
+    streams and total resident chunks across them."""
+    streams = [s for s in list(_STREAMS) if s.opened and not s.drained]
+    return {
+        "open": len(streams),
+        "buffered_chunks": sum(s.buffered for s in streams),
+    }

@@ -33,6 +33,33 @@ the runner observes it at the next cooperative checkpoint
 executor for the next queued query. `query_timeout_s` is the per-query
 wall-clock cap: one hung query fails with EXCEEDED_TIME_LIMIT instead of
 wedging an executor forever.
+
+Serving tier (trino_tpu/serve/): three layers above dispatch make the
+repeated-prepared-statement hot path approximately one HTTP round trip:
+
+- STREAMING statement lifecycle: each executing query writes result rows
+  into a bounded ring buffer (serve/streaming.ResultStream) as operators
+  produce them; `nextUri` paging serves chunk `token` straight off the
+  ring, so the client sees its first page BEFORE the query completes and
+  a slow client pauses the producer at a cooperative checkpoint instead
+  of forcing the server to buffer the full result. Wire states:
+  QUEUED -> RUNNING (producing) -> FINISHING (producer done, ring
+  draining) -> FINISHED.
+- RESULT-CACHE fast path: POST probes the runner's result-set cache
+  (serve/caches.py) on the HTTP thread before touching the dispatch
+  queue; a hit answers FINISHED — often with the data inline in the POST
+  response — with zero planning, zero compiles, zero execution, and no
+  executor handoff. INSERT/DDL evicts through the plan cache's
+  invalidation hooks, so a stale cached answer is impossible.
+- WEIGHTED CPU scheduling: each executor slice's wall charges to the
+  query's resource group (ResourceGroupManager.charge), advancing the
+  stride pass by seconds/weight — groups share executor time by weight,
+  not just by admission counts.
+
+A warmup manifest (`warmup_manifest=` / $TRINO_TPU_WARMUP_MANIFEST,
+serve/warmup.py) PREPAREs and pre-executes representative statements at
+startup so the first real request binds into a warm plan cache and warm
+kernels. OTLP span export (obs/otlp.py) wires in when configured.
 """
 
 from __future__ import annotations
@@ -43,15 +70,36 @@ import re
 import threading
 import time
 import uuid
+import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 from trino_tpu.errors import QueryCanceledError
 from trino_tpu.exec.resource_groups import ResourceGroupManager
 from trino_tpu.exec.runner import MaterializedResult
+from trino_tpu.serve.streaming import ResultStream
 from trino_tpu.server import protocol
 
 PAGE_ROWS = 1000
+
+# live servers, for the /v1/metrics serving-tier gauges (weak: a stopped
+# server's registry entry disappears with it)
+_SERVERS: "weakref.WeakSet[TrinoServer]" = weakref.WeakSet()
+
+
+def _server_gauges():
+    """Scrape-time gauges over every live server: registry depth by
+    state (the dispatch queue-depth signal alongside the per-group
+    queued/running gauges)."""
+    for srv in list(_SERVERS):
+        with srv._lock:
+            states: Dict[str, int] = {}
+            for q in srv._queries.values():
+                states[q.state] = states.get(q.state, 0) + 1
+        for state, n in sorted(states.items()):
+            yield ("trino_tpu_server_queries",
+                   "Registered server queries by protocol state.",
+                   n, {"state": state, "port": srv.port})
 
 _SET_SESSION = re.compile(r"^\s*set\s+session\s+(\w+)\s*=\s*(.+?)\s*$",
                           re.IGNORECASE | re.DOTALL)
@@ -83,6 +131,11 @@ class _Query:
         # echoes the name via X-Trino-Deallocated-Prepare
         self.added_prepare: Optional[tuple] = None
         self.deallocated_prepare: Optional[str] = None
+        # streaming result ring (serve/streaming.ResultStream): when the
+        # runner opens it, paging serves chunks off the ring instead of
+        # q.result; stays unopened for non-query statements, writers,
+        # retry-capable sessions, and result-cache hits
+        self.stream: Optional[ResultStream] = None
         self.cancelled = False
         # crossed by threads: DELETE (HTTP) sets it, the runner's
         # cooperative checkpoints (executor thread) observe it
@@ -96,7 +149,11 @@ class _Query:
 
     @property
     def done(self) -> bool:
-        return self.state in ("FINISHED", "FAILED", "CANCELED")
+        # FINISHING counts: execution is over (cancel is a no-op, the
+        # entry is prunable past `keep` — pruning an undrained stream
+        # loses its chunks exactly like pruning buffered results)
+        return self.state in ("FINISHED", "FINISHING", "FAILED",
+                              "CANCELED")
 
 
 class TrinoServer:
@@ -109,8 +166,40 @@ class TrinoServer:
                  resource_groups: Optional[ResourceGroupManager] = None,
                  resource_groups_path: Optional[str] = None,
                  compilation_cache_dir: Optional[str] = None,
-                 plan_cache_max_entries: Optional[int] = None):
+                 plan_cache_max_entries: Optional[int] = None,
+                 streaming: bool = True,
+                 result_cache: bool = True,
+                 scan_cache: bool = True,
+                 stream_ring_chunks: int = 16,
+                 stream_stall_timeout_s: float = 300.0,
+                 warmup_manifest=None,
+                 otlp_export: Optional[str] = None):
         self.runner = runner
+        # serving tier defaults: the server IS the production front door,
+        # so result/scan caching default ON for server sessions (clones
+        # inherit through the session property bag); direct runners keep
+        # the metadata.py defaults (off)
+        self.streaming_enabled = bool(streaming)
+        self.stream_ring_chunks = int(stream_ring_chunks)
+        self.stream_stall_timeout_s = float(stream_stall_timeout_s)
+        self.result_cache_enabled = bool(result_cache)
+        if result_cache:
+            runner.session.set("result_cache_enabled", True)
+        if scan_cache:
+            runner.session.set("scan_cache_enabled", True)
+        # warmup manifest (serve/warmup.py): held here, applied in
+        # start() BEFORE the executors spin up so the first real request
+        # finds a warm plan cache and warm kernels
+        import os as _os_env
+        if warmup_manifest is None:
+            warmup_manifest = _os_env.environ.get(
+                "TRINO_TPU_WARMUP_MANIFEST") or None
+        self._warmup_manifest = warmup_manifest
+        self.warmup_report: List[dict] = []
+        # OTLP span export (obs/otlp.py): off unless configured here or
+        # via $TRINO_TPU_OTLP_ENDPOINT / $TRINO_TPU_OTLP_FILE
+        from trino_tpu.obs.otlp import install_otlp_exporter
+        self.otlp_exporter = install_otlp_exporter(otlp_export)
         # server-level plan-cache sizing: per-request X-Trino-Session
         # headers land on `for_query()` clones, which never resize the
         # SHARED cache (one client must not evict everyone's warm plans),
@@ -166,6 +255,9 @@ class TrinoServer:
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None
         self._executors: List[threading.Thread] = []
+        _SERVERS.add(self)
+        from trino_tpu.obs.metrics import REGISTRY
+        REGISTRY.register_gauges(_server_gauges)   # idempotent
 
     # ---------------------------------------------------------- lifecycle
 
@@ -179,6 +271,13 @@ class TrinoServer:
         return f"http://{host}:{port}"
 
     def start(self) -> "TrinoServer":
+        if self._warmup_manifest is not None:
+            # synchronous, pre-executor: by the time start() returns, the
+            # manifest's shapes are PREPAREd (shared map), planned (plan
+            # cache), and compiled (jit cache, persistent-cache-backed)
+            from trino_tpu.serve.warmup import apply_warmup
+            self.warmup_report = apply_warmup(self.runner,
+                                              self._warmup_manifest)
         for i in range(self.max_running):
             th = threading.Thread(target=self._drain, daemon=True,
                                   name=f"query-executor-{i}")
@@ -197,6 +296,13 @@ class TrinoServer:
             th.join(timeout=10)
         if self._thread:
             self._thread.join(timeout=5)
+        if self.otlp_exporter is not None:
+            # the listener registry holds strong references: a stopped
+            # server's exporter would keep exporting (and a restarted
+            # one would double-export) every later query in the process
+            from trino_tpu.obs.otlp import uninstall_otlp_exporter
+            uninstall_otlp_exporter(self.otlp_exporter)
+            self.otlp_exporter = None
 
     # ---------------------------------------------------------- execution
 
@@ -225,13 +331,16 @@ class TrinoServer:
         except Exception:
             return "global"
 
+    def _new_query_id(self) -> str:
+        day = time.strftime("%Y%m%d")
+        return f"{day}_{next(self._seq):06d}_{uuid.uuid4().hex[:5]}"
+
     def _submit(self, sql: str, headers) -> _Query:
         """Admit + enqueue (DispatchManager.createQuery analog): returns
         immediately with the QUEUED query; an executor-pool worker runs
         it after weighted-fair selection from its resource group."""
         from trino_tpu.exec.query_tracker import TRACKER
-        day = time.strftime("%Y%m%d")
-        qid = f"{day}_{next(self._seq):06d}_{uuid.uuid4().hex[:5]}"
+        qid = self._new_query_id()
         # lower-cased snapshot: header lookup must stay case-insensitive
         # after leaving the email.Message (HTTP header names are)
         q = _Query(qid, uuid.uuid4().hex[:12], sql,
@@ -253,6 +362,76 @@ class TrinoServer:
                 error_code=131074, error_type="INSUFFICIENT_RESOURCES")
             TRACKER.fail(q.info, "Too many queued queries",
                          error_name="QUERY_QUEUE_FULL")
+        return q
+
+    def _try_cached(self, sql: str, headers) -> Optional[_Query]:
+        """POST-time result-cache probe — the serving tier's hot path.
+        A hit is answered on the HTTP thread: no dispatch queue, no
+        executor handoff, zero planning, zero compiles, zero execution
+        (admission control is skipped too: a cache hit consumes no
+        executor resources to admit). Any wrinkle — probe miss, parse
+        error, header trouble — returns None and the normal dispatch
+        path decides, so failures surface exactly as they always did."""
+        from trino_tpu.exec.query_tracker import TRACKER
+        if not self.result_cache_enabled:
+            return None
+        # cheap prefix gate: only statement kinds peek_cached_result can
+        # resolve are worth a probe — DDL/INSERT/SET/PREPARE skip the
+        # clone + parse entirely on the dispatch-bound path
+        head = sql.lstrip()[:8].upper()
+        if not head.startswith(("SELECT", "EXECUTE", "WITH", "VALUES",
+                                "(", "TABLE")):
+            return None
+        hdrs = {k.lower(): v for k, v in headers.items()}
+        try:
+            runner = self.runner.for_query()
+            session = runner.session
+            catalog = hdrs.get("x-trino-catalog")
+            schema = hdrs.get("x-trino-schema")
+            if catalog:
+                session.catalog = catalog
+            if schema:
+                session.schema = schema
+            from trino_tpu.metadata import SESSION_PROPERTY_DEFAULTS
+            for k, v in self._session_overrides(hdrs).items():
+                if k in SESSION_PROPERTY_DEFAULTS:
+                    session.set(k, v)
+            self._apply_prepared_header(runner, hdrs)
+            entry = runner.peek_cached_result(sql)
+        except Exception:   # noqa: BLE001 — defer to the dispatch path
+            return None
+        if entry is None:
+            return None
+        qid = self._new_query_id()
+        q = _Query(qid, uuid.uuid4().hex[:12], sql, hdrs)
+        user = hdrs.get("x-trino-user", "user")
+        group = self._group_for(hdrs)
+        info = TRACKER.begin(sql, user=user, query_id=qid,
+                             resource_group=group)
+        q.info = info
+        info.cpu_time_ms = 0
+        info.output_bytes = entry.output_bytes
+        # the delivery-mode-consistent stats contract: a hit reports the
+        # SAME output rows/bytes a real run would with the zero-work
+        # fields provably zero — built from a real collector snapshot so
+        # the key set never drifts from obs/stats.py
+        from trino_tpu.obs.stats import QueryStatsCollector
+        col = QueryStatsCollector(qid)
+        col.result_cache_hits = 1
+        col.add_output(entry.row_count, entry.output_bytes)
+        col.finish()
+        stats = col.snapshot()
+        stats["wall_s"] = 0.0
+        info.stats = stats
+        q.result = MaterializedResult(
+            list(entry.column_names), list(entry.column_types),
+            list(entry.rows), row_count=entry.row_count)
+        TRACKER.running(info)
+        TRACKER.finish(info, entry.row_count)
+        q.state = "FINISHED"
+        with self._lock:
+            self._queries[qid] = q
+            self._prune_locked()
         return q
 
     def _prune_locked(self) -> None:
@@ -280,6 +459,7 @@ class TrinoServer:
             if got is None:
                 continue
             group, q = got
+            slice_t0 = time.monotonic()
             try:
                 if q.cancelled:
                     q.state = "CANCELED"
@@ -290,14 +470,32 @@ class TrinoServer:
                     self._execute(q)
                     if q.cancelled and q.result is None:
                         q.state = "CANCELED"
+                    elif q.error is not None:
+                        q.state = "FAILED"
+                    elif q.stream is not None and q.stream.opened \
+                            and not q.stream.drained \
+                            and (q.result is None
+                                 or len(q.result.rows)
+                                 != q.result.reported_rows):
+                        # producer done, ring still draining AND the ring
+                        # is the only copy: paging flips it to FINISHED
+                        # on the final chunk (with a complete
+                        # materialized copy the buffered path serves and
+                        # the query is simply FINISHED)
+                        q.state = "FINISHING"
                     else:
-                        q.state = "FAILED" if q.error is not None \
-                            else "FINISHED"
+                        q.state = "FINISHED"
                 except BaseException as e:  # noqa: BLE001 — keep draining
                     q.error = protocol.error_from_exception(e)
                     q.state = "FAILED"
                     self._fail_tracker(q, e)
             finally:
+                # weighted CPU scheduling: this slice's wall charges to
+                # the group chain (stride advances by seconds/weight),
+                # so the next pick favors groups that consumed less
+                # executor time per unit weight
+                self.groups.charge(group, time.monotonic() - slice_t0,
+                                   query_id=q.query_id)
                 self.groups.finish(group, q.query_id)
 
     @staticmethod
@@ -350,6 +548,16 @@ class TrinoServer:
         # back to THIS client, which re-sends it via X-Trino-Session)
         runner = self.runner.for_query()
         session = runner.session
+        sink = None
+        if self.streaming_enabled:
+            # the runner opens it only for streaming-safe shapes (plain
+            # reads under retry_policy=NONE without chaos); unopened, the
+            # paging path falls back to the buffered result
+            sink = ResultStream(
+                max_chunks=self.stream_ring_chunks,
+                chunk_rows=PAGE_ROWS,
+                stall_timeout_s=self.stream_stall_timeout_s)
+            q.stream = sink
         try:
             catalog = headers.get("x-trino-catalog")
             schema = headers.get("x-trino-schema")
@@ -375,7 +583,7 @@ class TrinoServer:
             result = runner.execute(
                 q.sql, query_id=q.query_id, queued_at=q.started,
                 wall_cap_s=self.query_timeout_s,
-                cancel_event=q.cancel_event)
+                cancel_event=q.cancel_event, result_sink=sink)
             m = _SET_SESSION.match(q.sql)
             if m:
                 q.update_type = "SET SESSION"
@@ -407,10 +615,16 @@ class TrinoServer:
             # q.result must also see update_type/set_session (else the
             # X-Trino-Set-Session header is lost)
             q.result = result
-        except QueryCanceledError:
+            if sink is not None:
+                sink.close()    # producer done; ring drains to the client
+        except QueryCanceledError as e:
             q.cancelled = True         # surfaces as CANCELED, not FAILED
+            if sink is not None:
+                sink.fail(e)           # wake a blocked consumer
         except Exception as e:  # surface as QueryError, not HTTP 500
             q.error = protocol.error_from_exception(e)
+            if sink is not None:
+                sink.fail(e)
             # failures BEFORE runner.execute() (session-override coercion)
             # must still terminate the tracker entry; inside execute() the
             # runner already transitioned it (this is then a no-op)
@@ -451,6 +665,17 @@ class TrinoServer:
                     "Query was canceled", error_name="USER_CANCELED",
                     error_code=3, error_type="USER_ERROR"),
                 elapsed_ms=q.elapsed_ms)
+        stream = q.stream
+        res = q.result
+        if stream is not None and stream.opened and (
+                res is None or len(res.rows) != res.reported_rows):
+            # ring-only delivery: while executing (res is None) and for
+            # results whose materialized copy was dropped past the cache
+            # bound. Once a COMPLETE copy exists, the buffered path below
+            # serves instead — its 1000-row pages are chunk-identical to
+            # the ring's, and stay re-readable after the ring drains
+            # (the pre-streaming paging contract)
+            return self._stream_response(q, stream, token, info, peak)
         if q.result is None:
             # still queued/running: same token again (client poll loop)
             return protocol.query_results(
@@ -475,6 +700,73 @@ class TrinoServer:
             cpu_time_ms=info.cpu_time_ms if info is not None else None,
             processed_bytes=info.output_bytes if info is not None else 0,
             spilled_bytes=spilled,
+            warnings=self._warnings_for(q))
+
+    def _stream_response(self, q: _Query, stream: ResultStream,
+                         token: int, info, peak: int) -> dict:
+        """Incremental paging off the result ring: chunk `token` is
+        served the moment the producer writes it — the client's first
+        page arrives while the query is still RUNNING. A 'pending' get
+        (the producer hasn't reached this chunk yet) answers the SAME
+        token so the client polls; 'end' closes the protocol
+        (FINISHED, no nextUri, final stats)."""
+        status, chunk = stream.get(token, timeout=0.2)
+        cols = protocol.columns_json(stream.column_names,
+                                     stream.column_types)
+        state = q.state if q.state in ("RUNNING", "FINISHING") \
+            else "RUNNING"
+        if status == "error":
+            exc = stream.error
+            if isinstance(exc, QueryCanceledError) or q.cancelled:
+                return protocol.query_results(
+                    q.query_id, self.base_uri, state="CANCELED",
+                    error=protocol.error_json(
+                        "Query was canceled", error_name="USER_CANCELED",
+                        error_code=3, error_type="USER_ERROR"),
+                    elapsed_ms=q.elapsed_ms)
+            return protocol.query_results(
+                q.query_id, self.base_uri, state="FAILED",
+                error=q.error or protocol.error_from_exception(exc),
+                elapsed_ms=q.elapsed_ms, peak_memory_bytes=peak,
+                warnings=self._warnings_for(q))
+        if status == "gone":
+            # behind the ack horizon: the client advanced past this
+            # token, then came back — unservable, like a pruned query
+            return protocol.query_results(
+                q.query_id, self.base_uri, state="FAILED",
+                error=protocol.error_json(
+                    f"result page {token} was already consumed",
+                    error_name="PAGE_TRANSPORT_ERROR", error_code=65545,
+                    error_type="INTERNAL_ERROR"),
+                elapsed_ms=q.elapsed_ms)
+        if status == "pending":
+            return protocol.query_results(
+                q.query_id, self.base_uri, columns=cols,
+                next_uri=self._page_uri(q, token), state=state,
+                elapsed_ms=q.elapsed_ms, peak_memory_bytes=peak)
+        spilled = 0
+        cpu_ms = None
+        nbytes = 0
+        if info is not None and info.stats:
+            spilled = int(info.stats.get("spilled_bytes", 0))
+            cpu_ms = info.cpu_time_ms
+            nbytes = info.output_bytes
+        if status == "end":
+            q.state = "FINISHED"
+            return protocol.query_results(
+                q.query_id, self.base_uri, columns=cols,
+                state="FINISHED", update_type=q.update_type,
+                rows=stream.total_rows, elapsed_ms=q.elapsed_ms,
+                peak_memory_bytes=peak, cpu_time_ms=cpu_ms,
+                processed_bytes=nbytes, spilled_bytes=spilled,
+                warnings=self._warnings_for(q))
+        data = protocol.encode_rows(chunk, stream.column_types)
+        return protocol.query_results(
+            q.query_id, self.base_uri, columns=cols, data=data,
+            next_uri=self._page_uri(q, token + 1), state=state,
+            rows=stream.total_rows, elapsed_ms=q.elapsed_ms,
+            peak_memory_bytes=peak, cpu_time_ms=cpu_ms,
+            processed_bytes=nbytes, spilled_bytes=spilled,
             warnings=self._warnings_for(q))
 
     # ----------------------------------------------------------- handler
@@ -523,6 +815,13 @@ class TrinoServer:
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 sql = self.rfile.read(length).decode()
+                # result-cache fast path: a hit answers FINISHED right
+                # here — data inline when it fits the first page, else
+                # paged off q.result — without touching the dispatcher
+                q = server._try_cached(sql, self.headers)
+                if q is not None:
+                    self._send_json(server._response_for(q, 0), q)
+                    return
                 q = server._submit(sql, self.headers)
                 # first response: QUEUED with a nextUri (the dispatcher
                 # handshake the CLI expects), data starts at token 0
