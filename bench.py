@@ -439,6 +439,14 @@ def _stats_breakdown(stats):
         "output_rows": int(stats.get("output_rows", 0)),
         "output_bytes": int(stats.get("output_bytes", 0)),
         "spilled_bytes": int(stats.get("spilled_bytes", 0)),
+        # preemptible sliced execution (round 11): slices the measured
+        # run executed, bytes checkpointed for resume, and the measured
+        # cancel->unwind wall (0 on an unpreempted run — nonzero here
+        # means something canceled/killed the rung, worth seeing)
+        "slices_executed": int(stats.get("slices_executed", 0)),
+        "checkpoint_bytes": int(stats.get("checkpoint_bytes", 0)),
+        "preempt_latency_ms": float(
+            stats.get("preempt_latency_ms", 0) or 0),
     }
 
 
@@ -584,6 +592,87 @@ def run_qps(out_path=None) -> None:
             f.write(line + "\n")
 
 
+def run_preempt(out_path=None) -> None:
+    """`bench.py --preempt [OUT.json]`: the DELETE->executor-freed
+    smoke. Starts a long SF1 lineitem scan on a worker thread, cancels
+    it mid-flight through the SAME shared cancel event the server's
+    DELETE handler sets, and reports the measured cancel-to-freed wall
+    plus the slice counters of the preempted run. The acceptance shape:
+    `cancel_to_free_ms` is bounded by ~one slice, orders of magnitude
+    below `scan_wall_s_estimate` (what the scan had left). Like every
+    bench mode, the final JSON line ALWAYS prints — failures land in an
+    `error` field."""
+    import threading
+    platform = _ensure_backend()
+    payload = {"metric": "preempt_latency", "backend": platform}
+    try:
+        import trino_tpu
+        trino_tpu.enable_persistent_cache()
+        from trino_tpu.errors import QueryCanceledError
+        from trino_tpu.exec import LocalQueryRunner
+        from trino_tpu.exec.memory import NODE_POOL
+
+        schema = os.environ.get("TRINO_TPU_PREEMPT_SCHEMA", "sf1")
+        runner = LocalQueryRunner.tpch(schema)
+        long_scan = ("SELECT count(*), sum(l_extendedprice * "
+                     "(1 - l_discount)) FROM lineitem "
+                     "WHERE l_quantity >= 0")
+        # warm run: compiles + stages the table, and tells us what the
+        # full scan costs (the denominator of the latency claim)
+        t0 = time.perf_counter()
+        runner.execute(long_scan)
+        full_wall = time.perf_counter() - t0
+        payload["scan_wall_s_estimate"] = round(full_wall, 3)
+        payload["slice_target_rows"] = int(
+            runner.session.get("slice_target_rows"))
+
+        from trino_tpu.exec.deadline import CancelEvent
+        outcome = {}
+        cancel_event = CancelEvent()
+
+        def worker():
+            try:
+                runner.execute(long_scan, query_id="bench_preempt",
+                               cancel_event=cancel_event)
+                outcome["state"] = "finished-before-cancel"
+            except QueryCanceledError:
+                outcome["state"] = "canceled"
+            except BaseException as e:  # noqa: BLE001
+                outcome["state"] = f"error: {type(e).__name__}: {e}"
+            outcome["done_at"] = time.monotonic()
+
+        th = threading.Thread(target=worker)
+        th.start()
+        # cancel partway into the warm wall so the scan is mid-flight
+        time.sleep(max(min(full_wall * 0.3, 2.0), 0.02))
+        cancel_event.cancel()       # the DELETE handler's exact path
+        th.join(timeout=max(4 * full_wall, 60))
+        stats = runner.last_query_stats
+        canceled = outcome.get("state") == "canceled"
+        payload.update({
+            "outcome": outcome.get("state", "hung"),
+            # meaningful only when the cancel actually preempted the
+            # scan (a too-fast scan reports its outcome and no latency)
+            "cancel_to_free_ms": round(
+                (outcome["done_at"] - cancel_event.cancelled_at) * 1000,
+                1) if canceled and "done_at" in outcome else None,
+            "preempt_latency_ms": float(
+                stats.get("preempt_latency_ms", 0) or 0),
+            "slices_executed": int(stats.get("slices_executed", 0)),
+            "checkpoint_bytes": int(stats.get("checkpoint_bytes", 0)),
+            "pool_reserved_after": NODE_POOL.reserved,
+        })
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the line must print
+        payload["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+    line = json.dumps(payload)
+    print(line, flush=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+
+
 def main():
     """Always emits exactly one final JSON line: a backend-init or rung
     failure lands in an `"error"` field (value stays null) instead of a
@@ -714,5 +803,7 @@ if __name__ == "__main__":
         run_mesh(sys.argv[2] if len(sys.argv) >= 3 else None)
     elif len(sys.argv) >= 2 and sys.argv[1] == "--qps":
         run_qps(sys.argv[2] if len(sys.argv) >= 3 else None)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--preempt":
+        run_preempt(sys.argv[2] if len(sys.argv) >= 3 else None)
     else:
         main()

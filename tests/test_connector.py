@@ -135,7 +135,9 @@ def test_memory_connector_nulls():
         Column.from_numpy(np.array([7, 0], dtype=np.int64), T.BIGINT,
                           valid=np.array([True, False])),
     ), 2)
-    conn.page_sink(h).append_page(page)
+    sink = conn.page_sink(h)
+    sink.append_page(page)
+    sink.finish()   # two-phase sink: staged rows land at commit
     pages = list(conn.page_source.pages(
         h and conn.split_manager.get_splits(h)[0],
         conn.metadata.get_column_handles(h), 8))
@@ -150,7 +152,9 @@ def test_blackhole():
         name, (ColumnMetadata("x", T.BIGINT),)))
     h = conn.metadata.get_table_handle(name)
     page = Page((Column.from_numpy(np.arange(5, dtype=np.int64), T.BIGINT),), 5)
-    conn.page_sink(h).append_page(page)
+    sink = conn.page_sink(h)
+    sink.append_page(page)
+    sink.finish()   # two-phase sink: the counter lands at commit
     assert conn._metadata.rows_written == 5
     assert list(conn.page_source.pages(
         conn.split_manager.get_splits(h)[0],

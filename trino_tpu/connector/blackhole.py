@@ -21,6 +21,11 @@ class BlackHoleMetadata(ConnectorMetadata):
         self._tables: Dict[SchemaTableName, TableMetadata] = {}
         self.rows_written = 0
         self._lock = threading.Lock()
+        # write tokens already counted: a retried attempt's commit is a
+        # no-op, so rows_written stays exact under QUERY-level retry
+        # (bounded — see spi.WriteTokenLedger)
+        from trino_tpu.connector.spi import WriteTokenLedger
+        self._committed_tokens = WriteTokenLedger()
 
     def list_schemas(self) -> List[str]:
         return ["default"]
@@ -43,8 +48,11 @@ class BlackHoleMetadata(ConnectorMetadata):
     def drop_table(self, handle: ConnectorTableHandle):
         self._tables.pop(handle.name, None)
 
-    def count(self, n: int):
+    def count(self, n: int, token=None):
         with self._lock:
+            if token is not None and \
+                    not self._committed_tokens.commit(token):
+                return
             self.rows_written += n
 
 
@@ -60,22 +68,39 @@ class BlackHolePageSource(ConnectorPageSource):
 
 
 class BlackHolePageSink(ConnectorPageSink):
-    def __init__(self, metadata: BlackHoleMetadata):
+    """Staged counting sink: rows stage in the sink and hit the global
+    counter only at finish(), once per write token (the same
+    idempotent-write protocol as the memory connector, with a counter
+    where the table would be)."""
+
+    def __init__(self, metadata: BlackHoleMetadata, write_token=None):
         self._metadata = metadata
+        self._token = write_token
+        self._staged_rows = 0
 
     def append_page(self, page: Page):
-        self._metadata.count(int(page.num_rows))
+        self._staged_rows += int(page.num_rows)
+
+    def finish(self):
+        self._metadata.count(self._staged_rows, token=self._token)
+        self._staged_rows = 0
+
+    def abort(self):
+        self._staged_rows = 0
 
 
 class BlackHoleConnector(Connector):
+    idempotent_writes = True
+
     def __init__(self):
         metadata = BlackHoleMetadata()
         super().__init__("blackhole", metadata, BlackHoleSplitManager(),
                          BlackHolePageSource())
         self._metadata = metadata
 
-    def page_sink(self, handle: ConnectorTableHandle) -> ConnectorPageSink:
-        return BlackHolePageSink(self._metadata)
+    def page_sink(self, handle: ConnectorTableHandle,
+                  write_token: Optional[str] = None) -> ConnectorPageSink:
+        return BlackHolePageSink(self._metadata, write_token)
 
 
 def create_connector() -> Connector:

@@ -34,6 +34,12 @@ class _StoredTable:
             None for _ in metadata.columns]
         self.dictionaries: List[Optional[Dictionary]] = [
             None for _ in metadata.columns]
+        # write tokens whose staged rows already committed: a retried
+        # attempt re-staging under the same token commits as a NO-OP,
+        # so QUERY-level retry of INSERT/CTAS is duplicate-free
+        # (bounded — see spi.WriteTokenLedger)
+        from trino_tpu.connector.spi import WriteTokenLedger
+        self.committed_tokens = WriteTokenLedger()
 
     @property
     def row_count(self) -> int:
@@ -144,46 +150,84 @@ class MemoryPageSource(ConnectorPageSource):
 
 
 class MemoryPageSink(ConnectorPageSink):
-    def __init__(self, stored: _StoredTable, lock: threading.Lock):
+    """Staged, token-deduplicated sink (MemoryPageSinkProvider rethought
+    for retried writes): appended pages decode to host columns in the
+    SINK, not the table — finish() commits the whole staging atomically
+    under the table lock, once per write token. A failed attempt's
+    abort() (or simply dropping the sink) leaves the table untouched,
+    and a token that already committed commits again as a no-op — the
+    two halves of duplicate-free QUERY-level write retry."""
+
+    def __init__(self, stored: _StoredTable, lock: threading.Lock,
+                 write_token: Optional[str] = None):
         self._stored = stored
         self._lock = lock
+        self._token = write_token
+        # staged per column: (filled values, nulls mask) chunks
+        self._staged: List[List] = [[] for _ in stored.metadata.columns]
 
     def append_page(self, page: Page):
         stored = self._stored
         n = int(page.num_rows)
+        if n == 0:
+            return
+        for i, col in enumerate(page.columns):
+            vals = col.to_numpy(n)  # decoded objects incl. None
+            typ = stored.metadata.columns[i].type
+            nulls = np.array([v is None for v in vals], dtype=bool)
+            if T.is_string(typ):
+                filled = np.asarray(
+                    ["" if v is None else v for v in vals], dtype=object)
+            else:
+                filled = np.asarray(
+                    [0 if v is None else v for v in vals],
+                    dtype=T.to_numpy_dtype(typ))
+            self._staged[i].append((filled, nulls))
+
+    def finish(self):
+        stored = self._stored
+        staged, self._staged = self._staged, [
+            [] for _ in stored.metadata.columns]
         with self._lock:
-            for i, col in enumerate(page.columns):
-                vals = col.to_numpy(n)  # decoded objects incl. None
+            if self._token is not None and \
+                    not stored.committed_tokens.commit(self._token):
+                return   # an earlier attempt already committed
+            for i, chunks in enumerate(staged):
+                if not chunks:
+                    continue
                 typ = stored.metadata.columns[i].type
-                nulls = np.array([v is None for v in vals], dtype=bool)
+                filled = np.concatenate([c[0] for c in chunks])
+                nulls = np.concatenate([c[1] for c in chunks])
                 if T.is_string(typ):
-                    filled = np.asarray(
-                        ["" if v is None else v for v in vals], dtype=object)
-                    stored.dictionaries[i] = None  # pool changes; rebuild lazily
-                else:
-                    filled = np.asarray(
-                        [0 if v is None else v for v in vals],
-                        dtype=T.to_numpy_dtype(typ))
+                    stored.dictionaries[i] = None  # pool changes; lazy
                 stored.arrays[i] = np.concatenate(
                     [stored.arrays[i], filled])
                 if nulls.any() or stored.valids[i] is not None:
                     old_valid = stored.valids[i]
                     if old_valid is None:
                         old_valid = np.ones(
-                            len(stored.arrays[i]) - len(filled), dtype=bool)
+                            len(stored.arrays[i]) - len(filled),
+                            dtype=bool)
                     stored.valids[i] = np.concatenate([old_valid, ~nulls])
+
+    def abort(self):
+        self._staged = [[] for _ in self._stored.metadata.columns]
 
 
 class MemoryConnector(Connector):
+    # staged write-token sink above: the engine may retry writes here
+    idempotent_writes = True
+
     def __init__(self):
         metadata = MemoryMetadata()
         super().__init__("memory", metadata, MemorySplitManager(metadata),
                          MemoryPageSource(metadata))
         self._metadata = metadata
 
-    def page_sink(self, handle: ConnectorTableHandle) -> ConnectorPageSink:
+    def page_sink(self, handle: ConnectorTableHandle,
+                  write_token: Optional[str] = None) -> ConnectorPageSink:
         return MemoryPageSink(self._metadata.stored(handle.name),
-                              self._metadata._lock)
+                              self._metadata._lock, write_token)
 
 
 def create_connector() -> Connector:

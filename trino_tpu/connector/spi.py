@@ -181,8 +181,47 @@ class ConnectorPageSource:
         raise NotImplementedError
 
 
+class WriteTokenLedger:
+    """Bounded memory of committed write tokens (the idempotent-sink
+    dedup set). A token only needs to outlive its own query's retries,
+    so a few thousand most-recent entries is far beyond any live retry
+    window — the bound exists so a long-lived serving process under
+    sustained write traffic doesn't accrete one token string per write
+    forever. Callers hold their own lock."""
+
+    def __init__(self, max_tokens: int = 4096):
+        import collections
+        self._seen: "collections.OrderedDict" = collections.OrderedDict()
+        self.max_tokens = max_tokens
+
+    def commit(self, token) -> bool:
+        """True exactly once per token: the first commit wins, replays
+        are no-ops."""
+        if token in self._seen:
+            return False
+        self._seen[token] = None
+        while len(self._seen) > self.max_tokens:
+            self._seen.popitem(last=False)
+        return True
+
+    def __contains__(self, token) -> bool:
+        return token in self._seen
+
+
 class ConnectorPageSink:
-    """spi/connector/ConnectorPageSink.java — two-phase append target."""
+    """spi/connector/ConnectorPageSink.java — two-phase append target.
+
+    Idempotent-write protocol (the FTE write contract the reference asks
+    of connectors before allowing retried writes): a sink created with a
+    `write_token` STAGES appended rows under that token and commits them
+    atomically in `finish()` — and a token that already committed never
+    commits again, so replaying a whole write attempt (QUERY-level
+    retry, a fragment re-run after a mid-slice failure) is duplicate-
+    free by construction. `abort()` drops the staging of a failed
+    attempt. Sinks without a token keep the legacy append-as-you-go
+    semantics, and connectors advertise the staged protocol with
+    `Connector.idempotent_writes` — the engine only opens retry scopes
+    around writes when every target connector declares it."""
 
     def append_page(self, page: Page):
         raise NotImplementedError
@@ -190,9 +229,18 @@ class ConnectorPageSink:
     def finish(self):
         pass
 
+    def abort(self):
+        """Drop this attempt's staged rows (failed/abandoned write)."""
+        pass
+
 
 class Connector:
     """One catalog instance (spi/connector/Connector.java)."""
+
+    # True when page_sink() implements the staged write-token protocol
+    # (commit-on-finish, token-deduplicated): the engine may then retry
+    # write plans — chaos included — without double-write risk
+    idempotent_writes = False
 
     def __init__(self, name: str, metadata: ConnectorMetadata,
                  split_manager: ConnectorSplitManager,
@@ -202,7 +250,8 @@ class Connector:
         self.split_manager = split_manager
         self.page_source = page_source
 
-    def page_sink(self, handle: ConnectorTableHandle) -> ConnectorPageSink:
+    def page_sink(self, handle: ConnectorTableHandle,
+                  write_token: Optional[str] = None) -> ConnectorPageSink:
         raise NotImplementedError(
             f"connector {self.name} does not support writes")
 

@@ -49,6 +49,7 @@ TABLES: Dict[str, tuple] = {
         ("name", T.VarcharType()), ("parent", T.VarcharType()),
         ("queued", T.BIGINT), ("running", T.BIGINT),
         ("started", T.BIGINT), ("finished", T.BIGINT),
+        ("served_from_cache", T.BIGINT),
         ("hard_concurrency", T.BIGINT), ("max_queued", T.BIGINT),
         ("soft_memory_limit_bytes", T.BIGINT),
         ("scheduling_weight", T.BIGINT),
@@ -115,6 +116,7 @@ def _rows_for(table: str) -> List[tuple]:
         return [(g.name,
                  g.parent.name if g.parent is not None else None,
                  g.queued, len(g.running), g.started, g.finished,
+                 g.served_from_cache,
                  g.hard_concurrency, g.max_queued,
                  g.soft_memory_limit_bytes if
                  g.soft_memory_limit_bytes is not None else 0,

@@ -45,6 +45,25 @@ def parse_duration(value) -> Optional[float]:
     return float(text) or None
 
 
+class CancelEvent(threading.Event):
+    """A cancel-request token: threading.Event plus the monotonic time
+    the FIRST cancel landed — the numerator of `preempt_latency_ms`
+    (request -> unwind). Stamping lives HERE, in one place: callers
+    (the server's DELETE handler, bench --preempt) call `cancel()`
+    instead of hand-ordering a timestamp write before `set()`. Plain
+    Events are still accepted everywhere a cancel_event is taken; they
+    just degrade the latency stamp to first observation."""
+
+    def __init__(self):
+        super().__init__()
+        self.cancelled_at: Optional[float] = None
+
+    def cancel(self) -> None:
+        if self.cancelled_at is None:
+            self.cancelled_at = time.monotonic()
+        self.set()
+
+
 class QueryDeadline:
     """Wall-clock limits + cancel flag for one query."""
 
@@ -54,6 +73,13 @@ class QueryDeadline:
                  cancel_event: Optional[threading.Event] = None):
         now = time.monotonic()
         self._cancel = cancel_event or threading.Event()
+        # when the FIRST cancel request landed (monotonic): the
+        # preemption-latency numerator — cancel-request to unwind is the
+        # slice-bounded wall the sliced executor promises (obs surfaces
+        # it as `preempt_latency_ms`). DELETE handlers setting the
+        # shared cancel_event directly are also covered: check() stamps
+        # it on first observation if cancel() was bypassed.
+        self.cancelled_at: Optional[float] = None
         self.queued_at = queued_at if queued_at is not None else now
         self.exec_started = now
         self.max_run_s = max_run_s
@@ -77,6 +103,13 @@ class QueryDeadline:
         return cls(max_run, max_exec, queued_at, cancel_event)
 
     def cancel(self) -> None:
+        if self.cancelled_at is None:
+            self.cancelled_at = time.monotonic()
+        # stamp the Event too: the server's DELETE handler shares this
+        # Event and sets it directly — whichever side cancels first, the
+        # request time survives on the shared object
+        if getattr(self._cancel, "cancelled_at", None) is None:
+            self._cancel.cancelled_at = self.cancelled_at
         self._cancel.set()
 
     @property
@@ -86,6 +119,12 @@ class QueryDeadline:
     def check(self) -> None:
         """Cooperative checkpoint: raises if canceled or past a limit."""
         if self._cancel.is_set():
+            if self.cancelled_at is None:
+                # event set externally (the server's DELETE handler owns
+                # the Event and stamps `cancelled_at` on it); an unknown
+                # external setter degrades to observation time
+                self.cancelled_at = getattr(
+                    self._cancel, "cancelled_at", None) or time.monotonic()
             raise QueryCanceledError("Query was canceled by user")
         now = time.monotonic()
         if self._run_deadline is not None and now > self._run_deadline:

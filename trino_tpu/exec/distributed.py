@@ -102,10 +102,15 @@ class ShardExecutionPlanner(LocalExecutionPlanner):
                     if self.device is not None:
                         page = jax.device_put(page, self.device)
                     yield page
-        return PageStream(gen(), tuple(s for s, _ in node.assignments))
+        return PageStream(self._sliced(gen()),
+                          tuple(s for s, _ in node.assignments))
 
     def _split_capacity(self, conn, node: TableScanNode, splits) -> int:
-        return split_scan_capacity(self.session, conn, node, splits)
+        cap = split_scan_capacity(self.session, conn, node, splits)
+        if self.slices is not None:
+            # same bound as the local scan: one page <= one slice
+            cap = min(cap, self.slices.capacity_cap(self.page_capacity))
+        return cap
 
     def _exec_ValuesNode(self, node: ValuesNode) -> PageStream:
         if self.shard != 0:
@@ -275,6 +280,7 @@ class DistributedQueryRunner(LocalQueryRunner):
         executor.deadline = self._deadline
         executor.collector = self._collector
         executor.exec_params = self._exec_params
+        executor.slices = self._slices
         if self._memory is not None:
             executor.memory = self._memory   # query-level shared ledger
         root_stream = executor.execute(frag.root)
@@ -399,17 +405,32 @@ class DistributedQueryRunner(LocalQueryRunner):
 
     def _fragment_attempt(self, frag: PlanFragment, exchange_inputs
                           ) -> List[Optional[Page]]:
+        from trino_tpu.exec.sliced.checkpoint import OperatorCheckpoint
+        from trino_tpu.obs.stats import maybe_span
         self._check_deadline()
         shards = [0] if frag.partitioning == "single" else \
             list(range(self.mesh.n))
-        # dispatch every shard's pipeline before the batched result sync.
-        # Leaf pages are device_put onto mesh device `shard`, so each
-        # task's kernels queue on ITS device's stream: STREAMING fragments
-        # (scan/filter/partial-agg) overlap across the mesh, while a
-        # fragment with a blocking operator still serializes at that
-        # operator's internal count fetch — full overlap needs the
-        # per-fragment shard_map program (SURVEY §7 step 7, next round).
+        # per-shard checkpoints (exec/sliced/checkpoint.py): a fragment
+        # retry resumes from the shards that already completed instead
+        # of re-running the whole fragment — each attempt checkpoints
+        # every shard it finishes (raw page list at dispatch, merged
+        # output at merge), so progress across attempts is monotonic:
+        # slices re-executed < slices total, and an attempt that finds
+        # every shard checkpointed executes nothing at all.
+        store = getattr(self, "_ckpts", None)
+
+        def scope_of(shard: int) -> str:
+            return f"fragment-{frag.fragment_id}/shard-{shard}"
+
+        # dispatch every non-checkpointed shard's pipeline before the
+        # batched result sync. Leaf pages are device_put onto mesh device
+        # `shard`, so each task's kernels queue on ITS device's stream:
+        # STREAMING fragments (scan/filter/partial-agg) overlap across
+        # the mesh, while a fragment with a blocking operator still
+        # serializes at that operator's internal count fetch — full
+        # overlap needs the per-fragment shard_map program.
         # Reference: SqlQueryScheduler.java:538 concurrent stage tasks.
+        restored: List[Tuple[int, ShardExecutionPlanner, object]] = []
         dispatched: List[Tuple[int, ShardExecutionPlanner, list]] = []
         for shard in shards:
             self._check_deadline()
@@ -420,16 +441,56 @@ class DistributedQueryRunner(LocalQueryRunner):
             executor.deadline = self._deadline
             executor.collector = self._collector
             executor.exec_params = self._exec_params
+            executor.slices = self._slices
             if self._memory is not None:
                 executor.memory = self._memory  # shards share the ledger
-            dispatched.append(
-                (shard, executor, list(executor.execute(frag.root)
-                                       .iter_pages())))
-        if self._faults is not None:
-            self._faults.site("fragment", f"fragment-{frag.fragment_id}")
+            ck = store.load(scope_of(shard)) if store is not None else None
+            if ck is not None:
+                # durable state from a previous attempt: skip execution
+                # (complete -> reuse the merged output; raw -> merge the
+                # already-produced pages below, without re-running)
+                with maybe_span(self._collector, "checkpoint-restore",
+                                kind="checkpoint", scope=scope_of(shard),
+                                complete=ck.complete):
+                    restored.append((shard, executor, ck))
+                continue
+            pages = list(executor.execute(frag.root).iter_pages())
+            dispatched.append((shard, executor, pages))
+            if store is not None:
+                # transient staging (count=False): replaced by the
+                # merged output below — the saved/bytes counters track
+                # durable per-shard state once, not this intermediate
+                store.save(scope_of(shard), OperatorCheckpoint(
+                    scope=scope_of(shard), cursor=len(pages),
+                    pages=list(pages)), count=False)
         out: List[Optional[Page]] = [None] * self.mesh.n
+        for shard, executor, ck in restored:
+            if ck.complete:
+                out[shard] = ck.pages[0] if ck.pages else None
+            else:
+                out[shard] = executor.merge_counted(ck.pages)
+                if store is not None:
+                    store.save(scope_of(shard), OperatorCheckpoint(
+                        scope=scope_of(shard), cursor=ck.cursor,
+                        pages=[] if out[shard] is None else [out[shard]],
+                        complete=True))
         for shard, executor, pages in dispatched:
             out[shard] = executor.merge_counted(pages)
+            if store is not None:
+                # merged output replaces the raw page list: the retry
+                # restores ONE page per shard, and the raw staging dies
+                store.save(scope_of(shard), OperatorCheckpoint(
+                    scope=scope_of(shard), cursor=len(pages),
+                    pages=[] if out[shard] is None else [out[shard]],
+                    complete=True))
+            if self._faults is not None:
+                # per-shard site AFTER the shard's checkpoint landed: an
+                # injected fragment fault costs the remaining shards,
+                # never the completed ones (restored shards do no work
+                # and pass no site)
+                self._faults.site(
+                    "fragment",
+                    f"fragment-{frag.fragment_id}/shard-{shard}")
         return out
 
     # ------------------------------------------------------ exchange plane

@@ -18,6 +18,10 @@ same per-task semantics the reference's retry policy reasons about.
 Installed via session properties (SystemSessionProperties analogs):
 `fault_injection_rate` (0 disables), `fault_injection_seed`,
 `fault_injection_sites` (comma list; empty = all of SITES).
+
+Site `slice` fires at slice BOUNDARIES of the preemptible executor loop
+(exec/sliced/): a mid-operator kill between two bounded-work slices,
+the failure mode the checkpoint/resume machinery exists for.
 """
 
 from __future__ import annotations
@@ -27,7 +31,7 @@ from typing import Dict, Optional, Tuple
 
 from trino_tpu.errors import CLUSTER_OUT_OF_MEMORY, InjectedFault
 
-SITES = ("fragment", "exchange", "scan", "spill", "memory")
+SITES = ("fragment", "exchange", "scan", "spill", "memory", "slice")
 
 
 class InjectedMemoryPressure(InjectedFault):

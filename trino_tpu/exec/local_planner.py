@@ -199,6 +199,16 @@ class LocalExecutionPlanner:
         # from this tuple, so one cached (value-free) plan re-executes
         # with fresh values through the same warm kernels
         self.exec_params: tuple = ()
+        # preemptible sliced execution (exec/sliced/SliceScheduler),
+        # installed by the owning runner: leaf page production runs as
+        # bounded-work slices with the cooperative boundary (cancel /
+        # kill / chaos site `slice`) between them, and scan page
+        # capacity is bounded by the slice budget. None = unsliced.
+        self.slices = None
+        # idempotent-write token (the query id), installed by the owning
+        # runner: connector page sinks stage under it and commit on
+        # finish, so a retried write attempt can never double-commit
+        self.write_token: Optional[str] = None
 
     def _checkpoint(self) -> None:
         """Cooperative cancellation/deadline point (page-batch boundary);
@@ -216,6 +226,16 @@ class LocalExecutionPlanner:
         (QueryStats.spilledDataSize analog)."""
         if self.collector is not None:
             self.collector.add_spill(nbytes)
+
+    def _sliced(self, pages):
+        """Wrap a leaf page iterator in the slice loop (exec/sliced/):
+        every downstream operator — fused streaming chains and blocking
+        collects alike — pulls through the leaf, so a boundary here
+        preempts the whole pipeline between device dispatches."""
+        if self.slices is None:
+            return pages
+        return self.slices.run(pages, checkpoint=self._checkpoint,
+                               fault_site=self._fault_site)
 
     # ------------------------------------------------- literal hoisting
 
@@ -310,7 +330,7 @@ class LocalExecutionPlanner:
                     for page in pages:
                         self._checkpoint()
                         yield page
-                return PageStream(gen_hit(), symbols)
+                return PageStream(self._sliced(gen_hit()), symbols)
             if self.collector is not None:
                 self.collector.scan_cache_miss()
         gen_seen = None if key is None else cache.generation()
@@ -330,7 +350,7 @@ class LocalExecutionPlanner:
                 # scan that started pre-change must not publish post-
                 # invalidation (same discipline as PlanCache.put)
                 cache.put(key, staging, gen=gen_seen)
-        return PageStream(gen(), symbols)
+        return PageStream(self._sliced(gen()), symbols)
 
     def _scan_capacity(self, conn, node: TableScanNode) -> int:
         """Size scan pages to the table: one big page per split keeps the
@@ -347,6 +367,11 @@ class LocalExecutionPlanner:
         if rows > cap:
             max_cap = int(self.session.get("scan_page_capacity"))
             cap = min(_next_pow2(rows), max_cap)
+        if self.slices is not None:
+            # one scan page must never exceed a slice: a bigger page is
+            # a single un-preemptible kernel launch, exactly what the
+            # sliced executor exists to bound
+            cap = min(cap, self.slices.capacity_cap(self.page_capacity))
         return cap
 
     def _exec_ValuesNode(self, node: ValuesNode) -> PageStream:
@@ -1851,18 +1876,29 @@ class LocalExecutionPlanner:
         lay, _ = _layout(src.symbols)
         order = [lay[s.name] for s in node.column_symbols]
         conn = self.metadata.connector(node.catalog)
-        sink = conn.page_sink(node.table)
+        sink = conn.page_sink(node.table, write_token=self.write_token)
 
         def gen():
+            # idempotent-write protocol (connector/spi.py): pages STAGE
+            # under the write token; finish() commits once per token.
+            # Any failure — an injected fault, a slice-boundary cancel,
+            # a killed victim, even generator abandonment — aborts the
+            # staging, so a retried attempt starts from zero staged rows
+            # and a committed token never commits twice.
             written = 0
-            for page in src.iter_pages():
-                n = int(page.num_rows)
-                if n == 0:
-                    continue
-                out = Page(tuple(page.column(c) for c in order), n)
-                sink.append_page(out)
-                written += n
-            sink.finish()
+            try:
+                for page in src.iter_pages():
+                    self._checkpoint()
+                    n = int(page.num_rows)
+                    if n == 0:
+                        continue
+                    out = Page(tuple(page.column(c) for c in order), n)
+                    sink.append_page(out)
+                    written += n
+                sink.finish()
+            except BaseException:   # GeneratorExit included: an
+                sink.abort()        # abandoned writer must not leak
+                raise               # staged rows into a later commit
             col = Column(jnp.asarray(np.array([written] * 8,
                                               dtype=np.int64)),
                          None, T.BIGINT, None)

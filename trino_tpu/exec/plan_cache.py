@@ -57,13 +57,41 @@ PLAN_PROPERTIES = ("join_distribution_type", "join_reordering_strategy",
 TableKey = Tuple[str, str, str]   # (catalog, schema, table)
 
 
+class _GenerationGuard:
+    """The put-generation race discipline every table-keyed cache layer
+    shares (plan cache here; result/scan caches in serve/caches.py):
+    `generation()` snapshots BEFORE the work whose output will be
+    cached; `put` rejects when any referenced table was invalidated
+    since — so a value computed against pre-change state can never land
+    after the invalidation that should have dropped it. Single-sourced
+    here so a fix to the discipline cannot silently miss one cache."""
+
+    def _init_generations(self) -> None:
+        self._gen = 0
+        self._invalidated_at: Dict[TableKey, int] = {}
+
+    def generation(self) -> int:
+        """Snapshot taken BEFORE planning/executing; hand it to `put`
+        so a value built against pre-invalidation state never lands."""
+        with self._lock:
+            return self._gen
+
+    def _bump_generation_locked(self, table: TableKey) -> None:
+        self._gen += 1
+        self._invalidated_at[table] = self._gen
+
+    def _stale_locked(self, tables, gen: Optional[int]) -> bool:
+        return gen is not None and any(
+            self._invalidated_at.get(tk, 0) > gen for tk in tables)
+
+
 @dataclasses.dataclass
 class PlanEntry:
     plan: Any                       # the optimized OutputNode
     tables: FrozenSet[TableKey]     # referenced tables, for invalidation
 
 
-class PlanCache:
+class PlanCache(_GenerationGuard):
     """LRU of optimized plans with table-keyed invalidation.
 
     `max_entries` is a property of the CACHE, set by the runner that owns
@@ -77,13 +105,13 @@ class PlanCache:
         self._entries: "collections.OrderedDict[Hashable, PlanEntry]" = \
             collections.OrderedDict()
         self.max_entries = max_entries
-        # invalidation generations: `invalidate` can only drop entries
-        # already PRESENT, but a planner that started before a concurrent
-        # DDL/INSERT may put its (stale) plan afterwards — so `put`
-        # carries the generation read before planning and is rejected if
-        # any referenced table was invalidated since
-        self._gen = 0
-        self._invalidated_at: Dict[TableKey, int] = {}
+        # invalidation generations (_GenerationGuard): `invalidate` can
+        # only drop entries already PRESENT, but a planner that started
+        # before a concurrent DDL/INSERT may put its (stale) plan
+        # afterwards — so `put` carries the generation read before
+        # planning and is rejected if any referenced table was
+        # invalidated since
+        self._init_generations()
         # invalidation fan-out (trino_tpu/serve/caches.py): the result
         # and scan caches register here so the ONE invalidate() call a
         # DDL/INSERT drives evicts plans, cached answers, and staged
@@ -106,20 +134,12 @@ class PlanCache:
             _count("hits")
             return entry.plan
 
-    def generation(self) -> int:
-        """Snapshot taken BEFORE planning; hand it to `put` so a plan
-        built against pre-invalidation catalog state never lands."""
-        with self._lock:
-            return self._gen
-
     def put(self, key: Hashable, plan: Any, tables: FrozenSet[TableKey],
             gen: Optional[int] = None) -> None:
         if self.max_entries <= 0:
             return
         with self._lock:
-            if gen is not None and any(
-                    self._invalidated_at.get(tk, 0) > gen
-                    for tk in tables):
+            if self._stale_locked(tables, gen):
                 # a referenced table changed while this plan was being
                 # built: its handles/statistics are pre-change, and the
                 # invalidation that should have dropped it already ran
@@ -144,8 +164,7 @@ class PlanCache:
         """Drop every entry whose plan references `table` (DDL/INSERT
         against it changed handles, data, or statistics)."""
         with self._lock:
-            self._gen += 1
-            self._invalidated_at[table] = self._gen
+            self._bump_generation_locked(table)
             stale = [k for k, e in self._entries.items()
                      if table in e.tables]
             for k in stale:

@@ -67,6 +67,13 @@ class ResourceGroup:
         self.running: set = set()  # subtree running query ids
         self.started = 0
         self.finished = 0
+        # completed queries the serving tier answered from the result
+        # cache WITHOUT dispatching (the POST-time fast path): they
+        # consume no executor slot but they ARE this group's traffic —
+        # group QPS quotas and dashboards must see them. Counted into
+        # started/finished too, with this column splitting out how many
+        # of those completions were zero-cost.
+        self.served_from_cache = 0
         self.scheduled_wall_s = 0.0   # execution wall charged to subtree
         # EWMA of observed execution-slice wall: the stride quantum a
         # start pre-charges (reconciled by `charge` when the real slice
@@ -329,6 +336,25 @@ class ResourceGroupManager:
                 a.running.discard(query_id)
                 a.finished += 1
             self._cond.notify_all()
+
+    def record_cache_hit(self, group_name: str) -> ResourceGroup:
+        """Account a result-cache fast-path completion to its group
+        chain: the POST-time hit bypasses submit/take/finish entirely
+        (zero executor cost to admit — that stays true), but without
+        this the group's completed-query counters would under-read its
+        real traffic and a group QPS quota would never see cached load.
+        No stride/pass movement: the hit consumed no executor wall."""
+        with self._cond:
+            if group_name.strip() not in self._by_name \
+                    and len(self._by_name) >= self.max_groups:
+                group_name = "global"   # same bound as submit(): an
+                # untrusted header name must not mint server state
+            g = self._get_or_create_locked(group_name)
+            for a in g._chain():
+                a.started += 1
+                a.finished += 1
+                a.served_from_cache += 1
+            return g
 
     def charge(self, group: ResourceGroup, seconds: float,
                query_id: Optional[str] = None) -> None:

@@ -130,6 +130,17 @@ class LocalQueryRunner:
         self._faults = None
         self._memory = None
         self._retries = 0
+        # preemptible sliced execution (exec/sliced/): the per-query
+        # SliceScheduler (bounded-work slices + boundary protocol), the
+        # per-query CheckpointStore fragment retries resume from, the
+        # idempotent-write token (the query id — stable across attempts,
+        # so a retried INSERT can never double-commit), and the tables
+        # THIS query created (a QUERY-level CTAS retry re-creates its
+        # own table without tripping "already exists")
+        self._slices = None
+        self._ckpts = None
+        self._write_token = None
+        self._created_tables = set()
         # the per-query QueryStatsCollector (obs/stats.py): phases,
         # output rows/bytes, jit hit/miss, spill bytes, operator stats
         self._collector = None
@@ -167,6 +178,10 @@ class LocalQueryRunner:
         clone._memory = None
         clone._retries = 0
         clone._collector = None
+        clone._slices = None
+        clone._ckpts = None
+        clone._write_token = None
+        clone._created_tables = set()
         clone.stats = {"retries": 0, "faults_injected": 0}
         clone.last_query_stats = {"retries": 0, "faults_injected": 0}
         return clone
@@ -255,6 +270,21 @@ class LocalQueryRunner:
                 info.mem = self._memory
                 info.resource_group = str(
                     self.session.get("resource_group"))
+                # preemptible sliced execution: one scheduler + one
+                # checkpoint store per query, shared by every executor
+                # (local pipeline, distributed shard tasks) it runs.
+                # The store exists only under TASK retry — the ONLY
+                # policy whose fragment re-runs can restore from it
+                # (NONE never retries; QUERY re-plans, which clears) —
+                # so the default path never pins shard outputs for a
+                # resume that cannot happen
+                from trino_tpu.exec.sliced import (CheckpointStore,
+                                                   SliceScheduler)
+                self._slices = SliceScheduler.from_session(self.session)
+                self._ckpts = CheckpointStore(info.query_id) \
+                    if policy == "TASK" else None
+                self._write_token = info.query_id
+                self._created_tables = set()
             except (TypeError, ValueError) as e:
                 from trino_tpu.errors import InvalidSessionPropertyError
                 raise InvalidSessionPropertyError(
@@ -295,11 +325,29 @@ class LocalQueryRunner:
                     # duplicate (or, after id reuse, misattribute) — the
                     # rendered stats are the surviving attempt's
                     self._collector.operators.clear()
+                    # checkpoints die with the plan too: a concurrent
+                    # invalidation (or the degrade re-run's forced spill)
+                    # can change the re-planned shape, and a colliding
+                    # fragment id would silently restore the DEAD plan's
+                    # pages as the new plan's output
+                    if self._ckpts is not None:
+                        self._ckpts.clear()
                     self._backoff(attempt)
         except BaseException as e:
             # BaseException too: a KeyboardInterrupt/SystemExit escaping
             # mid-query must not leave a forever-RUNNING phantom row in
             # system.runtime.queries
+            if isinstance(e, QueryCanceledError) \
+                    and self._deadline is not None \
+                    and self._deadline.cancelled_at is not None \
+                    and self._collector is not None:
+                # preemption latency: cancel-request (DELETE / stall
+                # guard / direct cancel) to unwind — the slice-bounded
+                # wall the sliced executor promises
+                import time as _time
+                self._collector.preempt_latency_ms = round(
+                    (_time.monotonic() - self._deadline.cancelled_at)
+                    * 1000, 3)
             self._finish_query_stats(info)
             self._close_memory(info, failed=True)
             if isinstance(e, QueryCanceledError):
@@ -352,6 +400,18 @@ class LocalQueryRunner:
         info.retries = self._retries
         info.faults_injected = faults
         col = self._collector
+        if col is not None and self._slices is not None:
+            col.slices_executed = self._slices.slices_executed
+        if col is not None and self._ckpts is not None:
+            col.checkpoints_saved = self._ckpts.saved
+            col.checkpoints_restored = self._ckpts.restored
+            col.checkpoint_bytes = self._ckpts.bytes_saved
+        if self._ckpts is not None:
+            # release every checkpointed page with the query
+            self._ckpts.clear()
+        self._slices = None
+        self._ckpts = None
+        self._write_token = None
         if col is not None:
             # stamp the rollup BEFORE the terminal tracker transition:
             # event listeners receive info.stats/info.trace with the
@@ -795,10 +855,34 @@ class LocalQueryRunner:
         # for write retry — this engine's memory connector has none)
         with self._phase("execution"):
             if _contains_writer(plan):
+                if self._writer_retry_safe(plan):
+                    # idempotent sink (write token + commit-on-finish):
+                    # a retried attempt stages fresh and a committed
+                    # token never commits twice, so the write joins the
+                    # normal retry scope — chaos included
+                    return self._retry_task(
+                        "local-plan",
+                        lambda: self._run_plan_attempt(plan))
                 self._check_deadline()
                 return self._run_plan_attempt(plan, chaos=False)
             return self._retry_task("local-plan",
                                     lambda: self._run_plan_attempt(plan))
+
+    def _writer_retry_safe(self, plan: OutputNode) -> bool:
+        """True when every writer target's connector declares idempotent
+        writes (staged tokens + commit-on-finish) — the condition under
+        which re-running a TableWriterNode cannot double-write."""
+        writers = _find_writers(plan)
+        if not writers:
+            return False
+        for node in writers:
+            try:
+                conn = self.catalogs.get(node.catalog)
+            except Exception:
+                return False
+            if not getattr(conn, "idempotent_writes", False):
+                return False
+        return True
 
     def _streaming_safe(self) -> bool:
         """Streaming is only safe when NO re-run is possible: a retry
@@ -816,6 +900,8 @@ class LocalQueryRunner:
         executor.deadline = self._deadline
         executor.collector = self._collector
         executor.exec_params = self._exec_params
+        executor.slices = self._slices
+        executor.write_token = self._write_token
         if bool(self.session.get("scan_cache_enabled")) \
                 and self._faults is None:
             # chaos runs bypass the scan cache: the `scan` fault site
@@ -903,8 +989,16 @@ class LocalQueryRunner:
         cols = tuple(
             ColumnMetadata(name, sym.type)
             for name, sym in zip(plan.column_names, plan.symbols))
+        # a QUERY-level retry replays the whole statement: a table THIS
+        # query already created must not trip "already exists" on the
+        # re-run (the idempotent sink makes the data half exactly-once;
+        # this makes the DDL half replayable)
+        table_key = (qname.catalog, qname.schema, qname.table)
+        replay = table_key in self._created_tables
         conn.metadata.create_table(
-            TableMetadata(qname.schema_table, cols), stmt.not_exists)
+            TableMetadata(qname.schema_table, cols),
+            stmt.not_exists or replay)
+        self._created_tables.add(table_key)
         self._invalidate_plans(qname)
         if not stmt.with_data:
             return MaterializedResult(["rows"], [T.BIGINT], [(0,)])
@@ -1006,6 +1100,8 @@ class LocalQueryRunner:
         executor.collector = col
         executor.deadline = self._deadline
         executor.exec_params = self._exec_params
+        executor.slices = self._slices
+        executor.write_token = self._write_token
         if self._memory is not None:
             executor.memory = self._memory
         t0 = time.perf_counter()
@@ -1063,10 +1159,19 @@ def _is_memory_pressure(exc: BaseException) -> bool:
     return isinstance(exc, TrinoError) and exc.code is CLUSTER_OUT_OF_MEMORY
 
 
-def _contains_writer(node) -> bool:
+def _find_writers(node) -> List[TableWriterNode]:
+    out = []
     if isinstance(node, TableWriterNode):
-        return True
-    return any(_contains_writer(s) for s in node.sources)
+        out.append(node)
+    for s in node.sources:
+        out.extend(_find_writers(s))
+    return out
+
+
+def _contains_writer(node) -> bool:
+    # derived from the single walker so the retry-exemption branch and
+    # _writer_retry_safe can never disagree about what a plan writes
+    return bool(_find_writers(node))
 
 
 def _literal_value(e: t.Expression):

@@ -1,0 +1,31 @@
+"""Preemptible sliced execution: bounded-work slices + checkpoints.
+
+The subsystem behind ROADMAP item 5: long operators execute as
+row-budgeted SLICES driven by a resumable executor loop, so the engine
+can act BETWEEN slices without any cooperation from the kernel body —
+DELETE cancels within one slice, the low-memory killer reclaims a
+victim's HBM at the next slice boundary instead of waiting out the
+query, serve-tier backpressure parks the producer at a boundary, and
+fragment retry resumes from the last durable per-shard checkpoint
+instead of re-running whole fragments.
+
+  scheduler.SliceScheduler    the per-query slice driver: row budget
+                              (slice_target_rows) tuned by a wall-clock
+                              EWMA toward slice_target_ms, slice
+                              counters, and the boundary protocol
+                              (fault site `slice`, budget retune)
+  checkpoint.OperatorCheckpoint / CheckpointStore
+                              explicit operator state between slices:
+                              consumed cursors, partial output pages,
+                              emitted watermarks — what a retry resumes
+                              from instead of starting over
+
+The matching write-side half lives in the connector SPI: idempotent
+page sinks (write tokens + commit-on-finish, connector/spi.py) make
+QUERY-level retry safe for INSERT/CTAS.
+"""
+
+from trino_tpu.exec.sliced.checkpoint import (CheckpointStore,  # noqa: F401
+                                              OperatorCheckpoint,
+                                              checkpoint_stats)
+from trino_tpu.exec.sliced.scheduler import SliceScheduler  # noqa: F401

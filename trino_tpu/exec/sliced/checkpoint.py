@@ -1,0 +1,150 @@
+"""Checkpointed operator state: what a slice-level retry resumes from.
+
+Reference parity: the reference's fault-tolerant execution persists task
+OUTPUT (the exchange spooling layer — trino-exchange-filesystem) so a
+failed task re-fetches its inputs instead of re-running its producers;
+intra-operator state is never durable, so a task retry always re-runs
+the whole task. Here the single-controller engine can do better: per
+retry scope (a fragment attempt's shard, a writer's emitted watermark)
+an `OperatorCheckpoint` records the cursor the slice loop reached and
+the pages it already produced, and the scope's NEXT attempt resumes
+from the checkpoint — slices re-executed < slices total, proven by the
+`checkpoints_restored` counter.
+
+The store is per-query (checkpoints reference device pages and plan
+scopes that die with the query) and cleared at query end and on
+QUERY-level re-plans (a rebuilt plan's fragment ids must not collide
+with a dead plan's checkpoints). Byte accounting feeds the
+`checkpoint_bytes` stats/metrics surface: checkpointed pages pin HBM
+until the consuming exchange (or the query) releases them, so the
+budget they hold is an operator-visible number, not a hidden cost.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional
+
+# process-lifetime counters across every query's store (obs/metrics.py
+# exports these next to the cache counter families; byte accounting is
+# per-query — the runner rolls it into stats, which feeds
+# trino_tpu_checkpoint_bytes_total at query end)
+_STATS = {"saved": 0, "restored": 0, "dropped": 0}
+_STATS_LOCK = threading.Lock()
+
+
+def _count(name: str, n: int = 1) -> None:
+    with _STATS_LOCK:
+        _STATS[name] += n
+
+
+def checkpoint_stats() -> Dict[str, int]:
+    """Process-lifetime checkpoint counters (/v1/metrics gauges)."""
+    with _STATS_LOCK:
+        return dict(_STATS)
+
+
+@dataclasses.dataclass
+class OperatorCheckpoint:
+    """One scope's durable state between slices.
+
+    `cursor` is the consumed position in the scope's own units (pages of
+    a shard's output, slices of a writer's input); `rows` is the emitted
+    watermark — what downstream consumers have already seen and a resume
+    must NOT re-emit; `pages` is the produced state itself (per-shard
+    output pages, partial-agg state). `complete` marks a scope whose
+    work finished: a retry reuses its pages outright instead of
+    executing anything."""
+
+    scope: str
+    cursor: int = 0
+    rows: int = 0
+    pages: List = dataclasses.field(default_factory=list)
+    nbytes: int = 0
+    complete: bool = False
+    attempt: int = 0
+    # whether this entry was counted into the saved/bytes counters
+    # (set by CheckpointStore.save; transient staging is not) — drops
+    # mirror it, so saved/dropped stay a consistent ledger
+    counted: bool = True
+
+
+class CheckpointStore:
+    """Per-query scope -> OperatorCheckpoint registry.
+
+    Thread-safe because the server's DELETE handler (HTTP thread) can
+    race a query's executor thread at cleanup; within one query the
+    executor writes sequentially."""
+
+    def __init__(self, query_id: str = ""):
+        self.query_id = query_id
+        self._lock = threading.Lock()
+        self._entries: Dict[str, OperatorCheckpoint] = {}
+        # this query's counters (rolled into the stats snapshot by the
+        # runner; the module counters aggregate process-wide)
+        self.saved = 0
+        self.restored = 0
+        self.bytes_saved = 0
+
+    def save(self, scope: str, ckpt: OperatorCheckpoint,
+             count: bool = True) -> None:
+        """Publish a scope's checkpoint. `count=False` marks transient
+        staging (e.g. a shard's raw page list, replaced by its merged
+        output moments later) — it is restorable like any checkpoint
+        but stays out of the saved/bytes counters, so those reflect
+        durable per-scope state once, not every intermediate write."""
+        from trino_tpu.exec.memory import page_bytes
+        if not ckpt.nbytes and ckpt.pages:
+            ckpt.nbytes = sum(page_bytes(p) for p in ckpt.pages
+                              if p is not None)
+        ckpt.counted = count
+        with self._lock:
+            prev = self._entries.get(scope)
+            self._entries[scope] = ckpt
+            if count:
+                self.saved += 1
+                self.bytes_saved += ckpt.nbytes
+        if count:
+            _count("saved")
+        if prev is not None and prev.counted:
+            # drops mirror counted saves only: replacing an uncounted
+            # transient must not make `dropped` outrun `saved`
+            _count("dropped")
+
+    def load(self, scope: str) -> Optional[OperatorCheckpoint]:
+        with self._lock:
+            ckpt = self._entries.get(scope)
+            if ckpt is not None:
+                self.restored += 1
+        if ckpt is not None:
+            _count("restored")
+        return ckpt
+
+    def peek(self, scope: str) -> Optional[OperatorCheckpoint]:
+        """load() without counting a restore (introspection/tests)."""
+        with self._lock:
+            return self._entries.get(scope)
+
+    def drop(self, scope: str) -> None:
+        with self._lock:
+            prev = self._entries.pop(scope, None)
+        if prev is not None and prev.counted:
+            _count("dropped")
+
+    def clear(self) -> None:
+        """Release every checkpoint (query end / QUERY-level re-plan):
+        the pages they pin go back to the allocator with them."""
+        with self._lock:
+            n = sum(1 for c in self._entries.values() if c.counted)
+            self._entries.clear()
+        if n:
+            _count("dropped", n)
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(c.nbytes for c in self._entries.values())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

@@ -140,6 +140,20 @@ SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     # static top-k candidate slots per shard for in-program heavy-hitter
     # detection (per-shard top-k -> all_gather -> global counts)
     "skew_heavy_key_limit": 8,
+    # preemptible sliced execution (exec/sliced/): long operators run as
+    # row-budgeted slices with a cooperative boundary between them —
+    # DELETE cancels within one slice, the low-memory killer reclaims a
+    # victim's HBM at the next boundary, and fragment retry resumes from
+    # per-shard checkpoints instead of re-running whole fragments. Scan
+    # page capacity is bounded by the slice budget so no single kernel
+    # launch exceeds a slice. Set false to pin a query back to
+    # unbounded operator runs (debugging).
+    "sliced_execution": True,
+    # initial rows-per-slice budget; the wall EWMA retunes it toward
+    # slice_target_ms per slice (0 disables wall tuning — the static
+    # row budget binds)
+    "slice_target_rows": 1 << 20,
+    "slice_target_ms": 250,
 }
 
 

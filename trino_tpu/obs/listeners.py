@@ -145,6 +145,17 @@ def _record_terminal_metrics(info) -> None:
                               mode="fused")
         m.EXCHANGES_TOTAL.inc(info.stats.get("exchanges_staged", 0),
                               mode="staged")
+        m.SLICES_TOTAL.inc(info.stats.get("slices_executed", 0))
+        m.CHECKPOINTS_TOTAL.inc(info.stats.get("checkpoints_saved", 0),
+                                op="saved")
+        m.CHECKPOINTS_TOTAL.inc(
+            info.stats.get("checkpoints_restored", 0), op="restored")
+        m.CHECKPOINT_BYTES_TOTAL.inc(
+            info.stats.get("checkpoint_bytes", 0))
+        preempt_ms = float(info.stats.get("preempt_latency_ms", 0) or 0)
+        if preempt_ms > 0:
+            m.PREEMPTIONS_TOTAL.inc()
+            m.PREEMPT_LATENCY_SECONDS.observe(preempt_ms / 1000.0)
     if info.wall_ms is not None:
         m.QUERY_WALL_SECONDS.observe(info.wall_ms / 1000.0)
 

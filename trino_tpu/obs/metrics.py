@@ -24,8 +24,30 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 LabelSet = Tuple[Tuple[str, str], ...]
 
 # query wall-clock histogram buckets (seconds): spans compile-dominated
-# millisecond queries to SF100 multi-minute rungs
-WALL_BUCKETS = (0.005, 0.025, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0, 600.0)
+# millisecond queries to SF100 multi-minute rungs. The DEFAULT is
+# session-independent and overridable process-wide via
+# $TRINO_TPU_METRICS_WALL_BUCKETS (comma-separated seconds) or per
+# deployment via TrinoServer(metrics_wall_buckets=...) -> set_wall_buckets
+DEFAULT_WALL_BUCKETS = (0.005, 0.025, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0,
+                        600.0)
+
+
+def _env_wall_buckets() -> Tuple[float, ...]:
+    import os
+    raw = os.environ.get("TRINO_TPU_METRICS_WALL_BUCKETS", "")
+    try:
+        out = tuple(sorted(float(x) for x in raw.split(",") if x.strip()))
+    except ValueError:
+        return DEFAULT_WALL_BUCKETS
+    return out or DEFAULT_WALL_BUCKETS
+
+
+WALL_BUCKETS = _env_wall_buckets()
+
+# preemption-latency buckets (seconds): cancel-request -> unwind is
+# slice-bounded, so the interesting range is milliseconds to a few
+# seconds, far below query walls
+PREEMPT_BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 5.0, 30.0)
 
 
 def _labels(kw: Dict[str, Any]) -> LabelSet:
@@ -96,6 +118,20 @@ class Histogram:
                     counts[i] += 1
             self._sums[key] = self._sums.get(key, 0.0) + float(value)
             self._totals[key] = self._totals.get(key, 0) + 1
+
+    def set_buckets(self, buckets: Tuple[float, ...]) -> None:
+        """Re-bucket the family (deployment configuration — TrinoServer
+        metrics_wall_buckets). Bucket counts are per-observation
+        cumulative, so prior observations cannot be re-binned: the
+        family RESETS (counts, sums, totals) — same visible effect as a
+        process restart with the new buckets, which is when bucket
+        boundaries legitimately change. A scrape-side monitor sees a
+        counter reset, the semantics Prometheus defines for restarts."""
+        with self._registry._lock:
+            self.buckets = tuple(sorted(buckets))
+            self._counts.clear()
+            self._sums.clear()
+            self._totals.clear()
 
     def samples(self) -> Iterable[Tuple[str, LabelSet, float]]:
         with self._registry._lock:
@@ -262,6 +298,34 @@ EXCHANGES_TOTAL = REGISTRY.counter(
     "inlined in a co-scheduled mesh program (pages never leave the "
     "producing XLA program); 'staged' = standalone collective over "
     "host-staged per-shard fragment outputs.", labeled=True)
+SLICES_TOTAL = REGISTRY.counter(
+    "trino_tpu_slices_total",
+    "Bounded-work execution slices completed across all queries "
+    "(preemptible sliced execution, exec/sliced/).")
+CHECKPOINTS_TOTAL = REGISTRY.counter(
+    "trino_tpu_checkpoints_total",
+    "Operator checkpoints by operation: 'saved' = durable state written "
+    "at a slice/shard boundary; 'restored' = a retry resumed from one "
+    "instead of re-executing.", labeled=True)
+CHECKPOINT_BYTES_TOTAL = REGISTRY.counter(
+    "trino_tpu_checkpoint_bytes_total",
+    "Bytes of operator state checkpointed across all queries.")
+PREEMPTIONS_TOTAL = REGISTRY.counter(
+    "trino_tpu_preemptions_total",
+    "Queries preempted (canceled/killed between slices) across the "
+    "process lifetime.")
+PREEMPT_LATENCY_SECONDS = REGISTRY.histogram(
+    "trino_tpu_preempt_latency_seconds",
+    "Cancel-request to unwind wall per preempted query — bounded by "
+    "one slice's wall under sliced execution.",
+    buckets=PREEMPT_BUCKETS)
+
+
+def set_wall_buckets(buckets) -> None:
+    """Deployment-time bucket configuration for the query wall
+    histogram (TrinoServer(metrics_wall_buckets=...)); resets the
+    family — see Histogram.set_buckets."""
+    QUERY_WALL_SECONDS.set_buckets(tuple(float(b) for b in buckets))
 
 
 def _engine_gauges():
@@ -307,6 +371,11 @@ def _engine_gauges():
         yield ("trino_tpu_resource_group_running",
                "Running queries per resource group.", len(g.running),
                labels)
+        yield ("trino_tpu_resource_group_served_from_cache",
+               "Completed queries answered from the result cache per "
+               "resource group (zero-dispatch fast path; counted so "
+               "group QPS quotas see cached traffic).",
+               g.served_from_cache, labels)
 
     from trino_tpu.exec import jit_cache
     js = jit_cache.stats()
@@ -376,6 +445,18 @@ def _engine_gauges():
     yield ("trino_tpu_scan_cache_misses",
            "Scan cache misses (scans staged from the connector) since "
            "process start.", ss["misses"], {})
+
+    from trino_tpu.exec.sliced.checkpoint import checkpoint_stats
+    cs = checkpoint_stats()
+    yield ("trino_tpu_checkpoints_saved",
+           "Operator checkpoints saved since process start (sliced "
+           "execution slice/shard boundaries).", cs["saved"], {})
+    yield ("trino_tpu_checkpoints_restored",
+           "Operator checkpoints a retry resumed from since process "
+           "start (work NOT re-executed).", cs["restored"], {})
+    yield ("trino_tpu_checkpoints_dropped",
+           "Operator checkpoints released since process start.",
+           cs["dropped"], {})
 
     from trino_tpu.serve.streaming import stream_stats
     st = stream_stats()

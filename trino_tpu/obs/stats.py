@@ -103,6 +103,17 @@ class QueryStatsCollector:
         self.exchange_bytes = 0
         # mesh shape the query executed over (0 = single-device)
         self.mesh_devices = 0
+        # preemptible sliced execution (exec/sliced/): bounded-work
+        # slices the query executed, operator checkpoints saved/restored
+        # (restored > 0 on a retried query = the retry RESUMED instead
+        # of re-running — slices re-executed < slices total), bytes
+        # checkpointed, and the measured cancel-request -> unwind wall
+        # when the query was preempted (0.0 = never preempted)
+        self.slices_executed = 0
+        self.checkpoints_saved = 0
+        self.checkpoints_restored = 0
+        self.checkpoint_bytes = 0
+        self.preempt_latency_ms = 0.0
 
     # ----------------------------------------------------------- spans
 
@@ -254,6 +265,11 @@ class QueryStatsCollector:
             "exchange_rows": self.exchange_rows,
             "exchange_bytes": self.exchange_bytes,
             "mesh_devices": self.mesh_devices,
+            "slices_executed": self.slices_executed,
+            "checkpoints_saved": self.checkpoints_saved,
+            "checkpoints_restored": self.checkpoints_restored,
+            "checkpoint_bytes": self.checkpoint_bytes,
+            "preempt_latency_ms": self.preempt_latency_ms,
         }
         if self.operators:
             snap["operators"] = self.operator_rows()

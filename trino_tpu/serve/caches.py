@@ -42,6 +42,10 @@ import threading
 import weakref
 from typing import Any, Dict, FrozenSet, Hashable, List, Optional, Tuple
 
+# the put-generation race discipline is single-sourced with the plan
+# cache (the base of the invalidation fan-out): one mixin, three caches
+from trino_tpu.exec.plan_cache import _GenerationGuard  # noqa: F401
+
 TableKey = Tuple[str, str, str]   # (catalog, schema, table)
 
 # process-lifetime counters across every runner's caches (obs/metrics.py
@@ -100,32 +104,6 @@ class CachedResult:
     row_count: int
     output_bytes: int               # live-row device bytes of the answer
     tables: FrozenSet[TableKey]     # referenced tables, for invalidation
-
-
-class _GenerationGuard:
-    """The put-generation race discipline every cache layer shares (the
-    same guard as exec/plan_cache.PlanCache): `generation()` snapshots
-    BEFORE the work whose output will be cached; `put` rejects when any
-    referenced table was invalidated since — so a value computed against
-    pre-change state can never land after the invalidation that should
-    have dropped it. Single-sourced here so a fix to the discipline
-    cannot silently miss one cache."""
-
-    def _init_generations(self) -> None:
-        self._gen = 0
-        self._invalidated_at: Dict[TableKey, int] = {}
-
-    def generation(self) -> int:
-        with self._lock:
-            return self._gen
-
-    def _bump_generation_locked(self, table: TableKey) -> None:
-        self._gen += 1
-        self._invalidated_at[table] = self._gen
-
-    def _stale_locked(self, tables, gen: Optional[int]) -> bool:
-        return gen is not None and any(
-            self._invalidated_at.get(tk, 0) > gen for tk in tables)
 
 
 class ResultSetCache(_GenerationGuard):

@@ -137,9 +137,12 @@ class _Query:
         # retry-capable sessions, and result-cache hits
         self.stream: Optional[ResultStream] = None
         self.cancelled = False
-        # crossed by threads: DELETE (HTTP) sets it, the runner's
-        # cooperative checkpoints (executor thread) observe it
-        self.cancel_event = threading.Event()
+        # crossed by threads: DELETE (HTTP) cancels it, the runner's
+        # cooperative checkpoints (executor thread) observe it; the
+        # CancelEvent carries the request timestamp the runner turns
+        # into preempt_latency_ms
+        from trino_tpu.exec.deadline import CancelEvent
+        self.cancel_event = CancelEvent()
         self.info = None               # QueryTracker entry
         self.started = time.monotonic()
 
@@ -173,7 +176,8 @@ class TrinoServer:
                  stream_ring_chunks: int = 16,
                  stream_stall_timeout_s: float = 300.0,
                  warmup_manifest=None,
-                 otlp_export: Optional[str] = None):
+                 otlp_export: Optional[str] = None,
+                 metrics_wall_buckets=None):
         self.runner = runner
         # serving tier defaults: the server IS the production front door,
         # so result/scan caching default ON for server sessions (clones
@@ -200,6 +204,14 @@ class TrinoServer:
         # via $TRINO_TPU_OTLP_ENDPOINT / $TRINO_TPU_OTLP_FILE
         from trino_tpu.obs.otlp import install_otlp_exporter
         self.otlp_exporter = install_otlp_exporter(otlp_export)
+        # deployment-tuned wall histogram buckets: the process default
+        # is session-independent ($TRINO_TPU_METRICS_WALL_BUCKETS or the
+        # static obs/metrics.DEFAULT_WALL_BUCKETS); a server that knows
+        # its workload's latency envelope re-buckets here (the family
+        # resets — restart semantics, see Histogram.set_buckets)
+        if metrics_wall_buckets is not None:
+            from trino_tpu.obs.metrics import set_wall_buckets
+            set_wall_buckets(metrics_wall_buckets)
         # server-level plan-cache sizing: per-request X-Trino-Session
         # headers land on `for_query()` clones, which never resize the
         # SHARED cache (one client must not evict everyone's warm plans),
@@ -426,6 +438,11 @@ class TrinoServer:
         q.result = MaterializedResult(
             list(entry.column_names), list(entry.column_types),
             list(entry.rows), row_count=entry.row_count)
+        # group accounting: the fast path skips submit/take/finish (a
+        # hit costs no executor resources to admit), but the completion
+        # still charges the group's completed/served-from-cache counters
+        # so group QPS quotas see cached traffic
+        self.groups.record_cache_hit(group)
         TRACKER.running(info)
         TRACKER.finish(info, entry.row_count)
         q.state = "FINISHED"
@@ -864,7 +881,11 @@ class TrinoServer:
                     # picks this query up LATER, the already-set event
                     # cancels it at its first checkpoint
                     q.cancelled = True
-                    q.cancel_event.set()
+                    # CancelEvent.cancel() stamps the DELETE time with
+                    # the set: the runner's deadline reads it to report
+                    # `preempt_latency_ms` (DELETE -> unwind, the
+                    # slice-bounded cancellation wall)
+                    q.cancel_event.cancel()
                 self.send_response(204)
                 self.send_header("Content-Length", "0")
                 self.end_headers()
