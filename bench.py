@@ -673,6 +673,186 @@ def run_preempt(out_path=None) -> None:
             f.write(line + "\n")
 
 
+Q18_LADDER = """
+SELECT c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+       sum(l_quantity)
+FROM customer, orders, lineitem
+WHERE o_orderkey IN (SELECT l_orderkey FROM lineitem
+                     GROUP BY l_orderkey
+                     HAVING sum(l_quantity) > 300)
+  AND c_custkey = o_custkey AND o_orderkey = l_orderkey
+GROUP BY c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+ORDER BY o_totalprice DESC, o_orderdate LIMIT 100
+"""
+
+# a deliberately skewed duplicate-key join: both sides of the self-join
+# carry ~4 rows per orderkey, so the build is never unique — the shape
+# that exercises the partitioned hybrid join's recursion/heavy paths
+# (TPC-H's own joins are all FK->PK unique builds)
+SKEW_LADDER = """
+SELECT count(*), sum(l2.l_extendedprice)
+FROM lineitem l1 JOIN lineitem l2 ON l1.l_orderkey = l2.l_orderkey
+"""
+
+# NDV == rows: partial aggregation collapses NOTHING, so the adaptive
+# controller must downgrade (full -> shrunken -> bypass) — q9/q18's own
+# GROUP BYs genuinely reduce, which the consistent raw-row ratio now
+# correctly keeps in full mode
+HIGH_NDV_LADDER = """
+SELECT l_orderkey, l_linenumber, sum(l_extendedprice), avg(l_quantity)
+FROM lineitem GROUP BY l_orderkey, l_linenumber
+"""
+
+LADDER_FRACTIONS = (1.0, 0.5, 0.25, 0.125)
+LADDER_COUNTERS = ("spilled_bytes", "agg_mode_downgrades",
+                   "agg_mode_upgrades", "agg_recursions",
+                   "join_recursions", "heavy_key_splits",
+                   "spill_fallbacks", "retries")
+
+
+def run_memory_ladder(out_path=None) -> None:
+    """`bench.py --memory-ladder [OUT.json]`: the no-cliff proof. Runs
+    q9 / q18 / a skewed self-join under a shrinking forced node pool
+    (1x, 1/2, 1/4, 1/8 of each query's measured working set) with
+    retry_policy=QUERY, so an over-pool attempt is killed by the
+    low-memory killer and the degrade re-run — inheriting the failed
+    attempt's adaptive state — finishes under the spill ladder. Emits
+    per-rung wall, spilled bytes, and the adaptive counters, plus a
+    `no_cliff` boolean: every rung completed (no OOM, no unbounded
+    recursion) and wall degrades smoothly (no rung blows up past
+    NO_CLIFF_STEP x its predecessor). The final JSON line ALWAYS
+    prints — failures land in `error` fields, never a silent rc=1."""
+    platform = _ensure_backend()
+    payload = {"metric": "memory_ladder", "backend": platform,
+               "queries": {}}
+    no_cliff = True
+    step_tol = float(os.environ.get("TRINO_TPU_LADDER_STEP_TOL", 8.0))
+    try:
+        import trino_tpu
+        trino_tpu.enable_persistent_cache()
+        from trino_tpu.exec import LocalQueryRunner
+        from trino_tpu.exec.memory import NODE_POOL
+        from trino_tpu.exec.query_tracker import TRACKER
+
+        schema = os.environ.get("TRINO_TPU_LADDER_SCHEMA", "tiny")
+        payload["schema"] = schema
+        runner = LocalQueryRunner.tpch(schema)
+        # small pages so buffers/compactions actually stream (one giant
+        # fused scan page would hide every adaptive boundary), QUERY
+        # retry so the killer's victim gets its spill-forced degrade run
+        for k, v in (("page_capacity", 4096),
+                     ("scan_page_capacity", 8192),
+                     ("spill_partition_count", 8),
+                     ("retry_policy", "QUERY")):
+            runner.session.set(k, v)
+
+        ladder = {"tpch_q9": Q9, "tpch_q18": Q18_LADDER,
+                  "skew_join": SKEW_LADDER,
+                  "high_ndv_agg": HIGH_NDV_LADDER}
+        for tag, sql in ladder.items():
+            qinfo = {"rungs": []}
+            payload["queries"][tag] = qinfo
+            # working set = the unconstrained run's peak pool
+            # reservation (also the warm-compile run)
+            wsid = f"ladder_ws_{tag}"
+            try:
+                t0 = time.perf_counter()
+                runner.execute(sql, query_id=wsid)
+                base_wall = time.perf_counter() - t0
+            except BaseException as e:  # noqa: BLE001
+                qinfo["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+                no_cliff = False
+                continue
+            peak = max((q.pool_peak_bytes for q in TRACKER.list()
+                        if q.query_id == wsid), default=0)
+            ws = max(int(peak), 1 << 20)
+            qinfo["working_set_bytes"] = ws
+            qinfo["unconstrained_wall_s"] = round(base_wall, 4)
+            # warm the spill/recursion kernels at the TIGHTEST rung's
+            # config (untimed): the rung walls must measure the adaptive
+            # ladder's steady state, not first-spill XLA compiles
+            try:
+                tight = max(int(ws * LADDER_FRACTIONS[-1]) // 4, 1 << 16)
+                for prop in ("join_spill_threshold_bytes",
+                             "agg_spill_threshold_bytes",
+                             "sort_spill_threshold_bytes"):
+                    runner.session.set(prop, tight)
+                runner.execute(sql)
+            except BaseException:  # noqa: BLE001 — warming is best-effort
+                pass
+            finally:
+                for prop in ("join_spill_threshold_bytes",
+                             "agg_spill_threshold_bytes",
+                             "sort_spill_threshold_bytes"):
+                    runner.session.properties.pop(prop, None)
+            prev_wall = None
+            for frac in LADDER_FRACTIONS:
+                limit = max(int(ws * frac), 1 << 18)
+                rung = {"fraction": frac, "pool_limit_bytes": limit}
+                qinfo["rungs"].append(rung)
+                # the query ledger tracks the pool (mid-collect overflow
+                # hands builds to the streaming partitioned join) and
+                # the spill thresholds shrink proportionally so blocking
+                # operators flush instead of materializing over the rung
+                runner.session.set("query_max_memory", limit)
+                spill_t = max(limit // 4, 1 << 16)
+                for prop in ("join_spill_threshold_bytes",
+                             "agg_spill_threshold_bytes",
+                             "sort_spill_threshold_bytes"):
+                    runner.session.set(prop, spill_t)
+                try:
+                    with NODE_POOL.limited(limit):
+                        t0 = time.perf_counter()
+                        runner.execute(sql)
+                        rung["wall_s"] = round(
+                            time.perf_counter() - t0, 4)
+                except KeyboardInterrupt:
+                    raise
+                except BaseException as e:  # noqa: BLE001
+                    rung["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+                    no_cliff = False
+                    continue
+                finally:
+                    for prop in ("query_max_memory",
+                                 "join_spill_threshold_bytes",
+                                 "agg_spill_threshold_bytes",
+                                 "sort_spill_threshold_bytes"):
+                        runner.session.properties.pop(prop, None)
+                stats = runner.last_query_stats
+                for key in LADDER_COUNTERS:
+                    rung[key] = int(stats.get(key, 0))
+                if prev_wall is not None and \
+                        rung["wall_s"] > step_tol * max(prev_wall, 1e-3):
+                    # a cliff: one halving of memory blew the wall up
+                    # by more than the tolerated degradation step
+                    rung["cliff"] = True
+                    no_cliff = False
+                prev_wall = rung["wall_s"]
+            totals = {k: sum(r.get(k, 0) for r in qinfo["rungs"])
+                      for k in LADDER_COUNTERS}
+            qinfo["totals"] = totals
+        all_counters = {
+            k: sum(q.get("totals", {}).get(k, 0)
+                   for q in payload["queries"].values())
+            for k in LADDER_COUNTERS}
+        payload["counters"] = all_counters
+        payload["adaptive_paths_fired"] = bool(
+            all_counters.get("agg_mode_downgrades", 0)
+            and all_counters.get("join_recursions", 0)
+            and all_counters.get("spilled_bytes", 0))
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the line must print
+        payload["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+        no_cliff = False
+    payload["no_cliff"] = no_cliff
+    line = json.dumps(payload)
+    print(line, flush=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+
+
 def main():
     """Always emits exactly one final JSON line: a backend-init or rung
     failure lands in an `"error"` field (value stays null) instead of a
@@ -805,5 +985,7 @@ if __name__ == "__main__":
         run_qps(sys.argv[2] if len(sys.argv) >= 3 else None)
     elif len(sys.argv) >= 2 and sys.argv[1] == "--preempt":
         run_preempt(sys.argv[2] if len(sys.argv) >= 3 else None)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--memory-ladder":
+        run_memory_ladder(sys.argv[2] if len(sys.argv) >= 3 else None)
     else:
         main()

@@ -1,0 +1,313 @@
+"""Adaptive aggregation/join strategies + recursive hybrid spill.
+
+Covers the PR-10 robustness surface: the reduction-ratio mode
+controller's exact-threshold transitions (downgrade -> bypass ->
+re-upgrade), the end-to-end high-NDV GROUP BY downgrade with
+sqlite-oracle parity, the skewed-build partitioned hybrid join
+(recursion fires, depth stays bounded, heavy keys split, max-depth
+fallback), the host-side spill ledger (budget -> classified
+EXCEEDED_SPILL_LIMIT, drains to zero), and the degrade-re-run
+inheritance contract (the spill-forced retry starts in the mode the
+failed attempt observed, not cold).
+"""
+
+import pytest
+
+from trino_tpu.exec import LocalQueryRunner
+from trino_tpu.exec.adaptive import (AdaptiveQueryState, AggMode,
+                                     AggModeController, BYPASS_PROBE_EVERY,
+                                     DOWNGRADE_RATIO, UPGRADE_RATIO)
+from trino_tpu.exec.spill import SPILL_LEDGER
+
+from oracle import assert_same, load_tpch_sqlite
+
+AGG_SQL = ("SELECT l_orderkey, l_linenumber, sum(l_extendedprice) AS s "
+           "FROM lineitem GROUP BY l_orderkey, l_linenumber")
+SKEW_JOIN_SQL = ("SELECT count(*), sum(l2.l_extendedprice) "
+                 "FROM lineitem l1 JOIN lineitem l2 "
+                 "ON l1.l_orderkey = l2.l_orderkey")
+
+
+def _tight_session(runner, **extra):
+    props = {"page_capacity": 2048, "scan_page_capacity": 2048,
+             "spill_partition_count": 4,
+             "agg_spill_threshold_bytes": 1 << 15,
+             "join_spill_threshold_bytes": 1 << 14,
+             "spill_max_recursion": 2}
+    props.update(extra)
+    for k, v in props.items():
+        runner.session.set(k, v)
+    return runner
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    conn = load_tpch_sqlite(0.01)
+    yield conn
+    conn.close()
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    """Spill-free reference results (default thresholds, same engine)."""
+    r = LocalQueryRunner.tpch("tiny")
+    return {
+        "agg": sorted(r.execute(AGG_SQL).rows),
+        "join": r.execute(SKEW_JOIN_SQL).rows,
+    }
+
+
+# ------------------------------------------------------------- controller
+
+
+def test_controller_downgrades_at_exact_threshold():
+    ctl = AggModeController()
+    assert ctl.mode == AggMode.FULL
+    # just below the threshold: no transition
+    assert ctl.observe(1000, int(1000 * DOWNGRADE_RATIO) - 1) is None
+    assert ctl.mode == AggMode.FULL
+    # exactly at the threshold: one lattice step down
+    assert ctl.observe(1000, int(1000 * DOWNGRADE_RATIO)) == "downgrade"
+    assert ctl.mode == AggMode.SHRUNKEN
+    assert ctl.observe(1000, 1000) == "downgrade"
+    assert ctl.mode == AggMode.BYPASS
+    # already at the bottom: stays
+    assert ctl.observe(1000, 1000) is None
+    assert ctl.downgrades == 2
+
+
+def test_controller_reupgrades_with_hysteresis():
+    ctl = AggModeController(mode=AggMode.BYPASS)
+    # in the hysteresis band: no transition either way
+    mid = int(1000 * (DOWNGRADE_RATIO + UPGRADE_RATIO) / 2)
+    assert ctl.observe(1000, mid) is None
+    assert ctl.mode == AggMode.BYPASS
+    # at the upgrade threshold: one step back up per observation
+    assert ctl.observe(1000, int(1000 * UPGRADE_RATIO)) == "upgrade"
+    assert ctl.mode == AggMode.SHRUNKEN
+    assert ctl.observe(1000, 1) == "upgrade"
+    assert ctl.mode == AggMode.FULL
+    assert ctl.observe(1000, 1) is None     # already at the top
+    assert ctl.upgrades == 2
+    assert ctl.history == [AggMode.BYPASS, AggMode.SHRUNKEN, AggMode.FULL]
+
+
+def test_controller_bypass_gated_by_spill():
+    ctl = AggModeController(mode=AggMode.SHRUNKEN, allow_bypass=False)
+    assert ctl.observe(100, 100) is None    # bypass unreachable
+    assert ctl.mode == AggMode.SHRUNKEN
+    ctl.allow_bypass = True                 # degrade re-run forces spill on
+    assert ctl.observe(100, 100) == "downgrade"
+    assert ctl.mode == AggMode.BYPASS
+
+
+def test_controller_bypass_probe_cadence():
+    ctl = AggModeController(mode=AggMode.BYPASS)
+    probes = []
+    for _ in range(2 * BYPASS_PROBE_EVERY):
+        probes.append(ctl.should_probe())
+        ctl.note_flush()
+    assert probes.count(True) == 2          # one probe per cadence window
+    assert probes[0] is True                # first flush measures
+
+
+def test_controller_initial_mode_from_cbo():
+    assert AggModeController.initial_mode(None, None) == AggMode.FULL
+    assert AggModeController.initial_mode(10.0, 1000.0) == AggMode.FULL
+    # estimated NDV ~ rows: start shrunken (never straight to bypass)
+    assert AggModeController.initial_mode(900.0, 1000.0) == AggMode.SHRUNKEN
+
+
+def test_adaptive_state_attempt_history():
+    state = AdaptiveQueryState()
+    ctl = state.agg_controller(7, ndv=None, rows=None)
+    ctl.observe(100, 100)                   # downgrade to shrunken
+    again = state.agg_controller(7)         # the retry attempt
+    assert again is ctl                     # same controller, same mode
+    assert state.attempt_initial_modes[7] == [AggMode.FULL,
+                                              AggMode.SHRUNKEN]
+
+
+# ------------------------------------------------------- end-to-end: agg
+
+
+def test_high_ndv_groupby_downgrades_oracle_green(oracle, baseline):
+    r = _tight_session(LocalQueryRunner.tpch("tiny"))
+    got = r.execute(AGG_SQL)
+    stats = r.last_query_stats
+    assert stats["agg_mode_downgrades"] > 0, \
+        "high-NDV GROUP BY must downgrade the partial-agg mode"
+    assert stats["spilled_bytes"] > 0
+    expected = oracle.execute(
+        "SELECT l_orderkey, l_linenumber, sum(l_extendedprice) "
+        "FROM lineitem GROUP BY l_orderkey, l_linenumber").fetchall()
+    assert_same(got.rows, expected, ordered=False)
+    assert sorted(got.rows) == baseline["agg"]
+    assert SPILL_LEDGER.reserved == 0       # stores drained with the query
+
+
+def test_adaptive_off_pins_full_mode(baseline):
+    r = _tight_session(LocalQueryRunner.tpch("tiny"),
+                       adaptive_partial_agg=False)
+    got = r.execute(AGG_SQL)
+    assert r.last_query_stats["agg_mode_downgrades"] == 0
+    assert sorted(got.rows) == baseline["agg"]
+
+
+def test_agg_recursion_and_explain_analyze_footer(baseline):
+    r = _tight_session(LocalQueryRunner.tpch("tiny"))
+    got = r.execute(AGG_SQL)
+    stats = r.last_query_stats
+    assert stats["agg_recursions"] > 0
+    assert sorted(got.rows) == baseline["agg"]
+    text = r.execute("EXPLAIN ANALYZE " + AGG_SQL).only_value()
+    assert "adaptive:" in text and "spill recursions" in text
+
+
+def test_agg_fallback_at_zero_recursion(baseline):
+    """spill_max_recursion=0: over-budget partitions go straight to the
+    bounded chunked fold — still correct, fallbacks counted."""
+    r = _tight_session(LocalQueryRunner.tpch("tiny"),
+                       spill_max_recursion=0)
+    got = r.execute(AGG_SQL)
+    stats = r.last_query_stats
+    assert stats["spill_fallbacks"] > 0
+    assert stats["agg_recursions"] == 0
+    assert sorted(got.rows) == baseline["agg"]
+
+
+# ------------------------------------------------------ end-to-end: join
+
+
+def test_skewed_build_join_recursion_bounded(oracle, baseline):
+    r = _tight_session(LocalQueryRunner.tpch("tiny"))
+    got = r.execute(SKEW_JOIN_SQL)
+    stats = r.last_query_stats
+    assert stats["join_recursions"] > 0, \
+        "a duplicate-key over-threshold build must repartition recursively"
+    # bounded depth: with npart=4 and max_recursion=2 a full recursion
+    # tree has at most npart + npart^2 recursion events per side-store
+    # pair; far under that in practice, but the bound is the contract
+    npart = 4
+    assert stats["join_recursions"] <= npart + npart * npart
+    expected = oracle.execute(
+        "SELECT count(*), sum(l2.l_extendedprice) FROM lineitem l1 "
+        "JOIN lineitem l2 ON l1.l_orderkey = l2.l_orderkey").fetchall()
+    assert_same(got.rows, expected, ordered=False)
+    assert got.rows == baseline["join"]
+    assert SPILL_LEDGER.reserved == 0
+
+
+def test_heavy_key_split_fires():
+    """One dominant build key: recursion can never split it (every row
+    of one key re-hashes together at any salt) — the heavy-key path
+    must split it out and still produce exact results."""
+    r = _tight_session(LocalQueryRunner.tpch("tiny"))
+    r.execute("DROP TABLE IF EXISTS memory.default.hk")
+    r.execute("CREATE TABLE memory.default.hk AS SELECT "
+              "CASE WHEN l_orderkey % 2 = 0 THEN 7 ELSE l_orderkey END "
+              "AS k, l_partkey AS v FROM lineitem")
+    sql = ("SELECT count(*), sum(b.v) FROM lineitem l "
+           "JOIN memory.default.hk b ON l.l_orderkey = b.k")
+    base = LocalQueryRunner.tpch("tiny")
+    base.execute("DROP TABLE IF EXISTS memory.default.hk")
+    base.execute("CREATE TABLE memory.default.hk AS SELECT "
+                 "CASE WHEN l_orderkey % 2 = 0 THEN 7 ELSE l_orderkey END "
+                 "AS k, l_partkey AS v FROM lineitem")
+    expected = base.execute(sql).rows
+    got = r.execute(sql)
+    stats = r.last_query_stats
+    assert stats["heavy_key_splits"] > 0
+    assert got.rows == expected
+
+
+def test_join_fallback_when_heavy_detection_disabled():
+    """spill_heavy_key_limit=0 + a dominant key: recursion exhausts its
+    depth without shrinking and the bounded chunked-build fallback must
+    finish the partition — no unbounded recursion, no OOM."""
+    r = _tight_session(LocalQueryRunner.tpch("tiny"),
+                       spill_heavy_key_limit=0, spill_max_recursion=1)
+    r.execute("DROP TABLE IF EXISTS memory.default.hk2")
+    r.execute("CREATE TABLE memory.default.hk2 AS SELECT "
+              "CAST(7 AS bigint) AS k, l_partkey AS v FROM lineitem "
+              "WHERE l_orderkey % 4 = 0")
+    sql = ("SELECT count(*), sum(b.v) FROM lineitem l "
+           "JOIN memory.default.hk2 b ON l.l_orderkey = b.k")
+    base = LocalQueryRunner.tpch("tiny")
+    base.execute("DROP TABLE IF EXISTS memory.default.hk2")
+    base.execute("CREATE TABLE memory.default.hk2 AS SELECT "
+                 "CAST(7 AS bigint) AS k, l_partkey AS v FROM lineitem "
+                 "WHERE l_orderkey % 4 = 0")
+    expected = base.execute(sql).rows
+    got = r.execute(sql)
+    stats = r.last_query_stats
+    assert stats["spill_fallbacks"] > 0
+    assert got.rows == expected
+
+
+# ------------------------------------------------------------ spill ledger
+
+
+def test_spill_budget_exceeded_is_classified():
+    r = _tight_session(LocalQueryRunner.tpch("tiny"),
+                       spill_max_bytes=8192)
+    from trino_tpu.errors import TrinoError
+    with pytest.raises(TrinoError) as ei:
+        r.execute(AGG_SQL)
+    assert ei.value.error_name == "EXCEEDED_SPILL_LIMIT"
+    assert not ei.value.retryable
+    # the failed query's stores released everything on unwind
+    assert SPILL_LEDGER.reserved == 0
+    assert SPILL_LEDGER.denials > 0
+
+
+def test_spill_gauges_and_queries_column():
+    r = _tight_session(LocalQueryRunner.tpch("tiny"))
+    r.execute(AGG_SQL, query_id="spill_gauge_probe")
+    rows = r.execute(
+        "SELECT query_id, spilled_bytes FROM system.runtime.queries "
+        "WHERE query_id = 'spill_gauge_probe'").rows
+    assert rows and rows[0][1] > 0
+    from trino_tpu.obs.metrics import REGISTRY
+    text = REGISTRY.render()
+    assert "trino_tpu_spill_bytes" in text
+    assert "trino_tpu_spill_peak_bytes" in text
+    assert "trino_tpu_adaptive_events_total" in text
+
+
+# --------------------------------------------- degrade-re-run inheritance
+
+
+def test_degrade_rerun_inherits_adaptive_state(monkeypatch, baseline):
+    """The OOM degrade path re-runs once with spill forced; the re-run
+    must START in the downgraded mode the failed attempt observed —
+    not cold in FULL (the PR-10 bugfix)."""
+    from trino_tpu.exec.memory import (ClusterOutOfMemoryError,
+                                       QueryMemoryContext)
+    r = _tight_session(LocalQueryRunner.tpch("tiny"),
+                       retry_policy="QUERY")
+    orig = QueryMemoryContext.reserve
+    fired = {"n": 0}
+
+    def boom(self, nbytes, tag="operator", device=None):
+        # synthetic killer verdict at the FIRST finalize restage: by
+        # then the streaming loop has already observed and downgraded
+        if tag == "agg-restage" and fired["n"] == 0:
+            fired["n"] = 1
+            raise ClusterOutOfMemoryError(
+                "synthetic node pressure (degrade-inheritance test)")
+        return orig(self, nbytes, tag, device)
+
+    monkeypatch.setattr(QueryMemoryContext, "reserve", boom)
+    got = r.execute(AGG_SQL)
+    assert fired["n"] == 1                  # first attempt died mid-finalize
+    assert sorted(got.rows) == baseline["agg"]
+    state = r._adaptive
+    histories = [h for h in state.attempt_initial_modes.values()
+                 if len(h) >= 2]
+    assert histories, "the re-run must reuse the query's adaptive state"
+    first, second = histories[0][0], histories[0][1]
+    # the second attempt starts where the first one's observations left
+    # off — strictly below FULL on the lattice
+    assert second != AggMode.FULL
+    assert AggMode.LATTICE.index(second) >= AggMode.LATTICE.index(first)

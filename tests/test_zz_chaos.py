@@ -310,3 +310,92 @@ def test_zz_concurrent_all22_two_lanes(oracle):
             _, oracle_sql, ordered = QUERIES[name]
             expected = oracle.execute(oracle_sql).fetchall()
             assert_same(got_rows[lane][name], expected, ordered)
+
+
+# -------------------------------------- adaptive spill paths under chaos
+#
+# PR-10 acceptance: fault site `spill` must provably fire INSIDE the
+# recursive-repartition / heavy-key / chunked-fallback paths — not just
+# at the first streaming flush. The injector's site entries accept a
+# pass-skip suffix ("spill@K" fires on the (K+1)-th pass), and site
+# passes are deterministic per config, so the proof protocol is:
+# count the passes with an unreachable skip, target the LAST pass (the
+# deepest recursion-side event), show it is FATAL under NONE with the
+# path name in the error, and oracle-GREEN under TASK retry.
+
+ADAPTIVE_AGG_SQL = (
+    "SELECT l_orderkey, l_linenumber, sum(l_extendedprice) AS s "
+    "FROM lineitem GROUP BY l_orderkey, l_linenumber")
+ADAPTIVE_AGG_ORACLE = (
+    "SELECT l_orderkey, l_linenumber, sum(l_extendedprice) "
+    "FROM lineitem GROUP BY l_orderkey, l_linenumber")
+ADAPTIVE_JOIN_SQL = (
+    "SELECT count(*), sum(l2.l_extendedprice) FROM lineitem l1 "
+    "JOIN lineitem l2 ON l1.l_orderkey = l2.l_orderkey")
+ADAPTIVE_JOIN_ORACLE = ADAPTIVE_JOIN_SQL
+
+
+def _adaptive_chaos_runner(policy, sites, seed=11, rate=1.0, attempts=8):
+    runner = LocalQueryRunner.tpch("tiny")
+    for k, v in {"page_capacity": 2048, "scan_page_capacity": 2048,
+                 "spill_partition_count": 4,
+                 "agg_spill_threshold_bytes": 1 << 15,
+                 "join_spill_threshold_bytes": 1 << 14,
+                 "spill_max_recursion": 2,
+                 "retry_policy": policy,
+                 "retry_attempts": attempts,
+                 "fault_injection_seed": seed,
+                 "fault_injection_rate": rate,
+                 "fault_injection_sites": sites}.items():
+        runner.session.set(k, v)
+    return runner
+
+
+def _count_spill_passes(sql):
+    """Deterministic spill-site pass count for one query under the
+    adaptive-chaos config: arm `spill` with an unreachable skip and read
+    how far the skip counter ran down."""
+    runner = _adaptive_chaos_runner("NONE", "spill@1000000")
+    runner.execute(sql)
+    return 1000000 - runner._faults._skip
+
+
+def _spill_chaos_proof(oracle, sql, oracle_sql, inside_tags):
+    passes = _count_spill_passes(sql)
+    assert passes > 0
+    target = f"spill@{passes - 1}"
+    # fatal under NONE, with the recursion-side path named in the error
+    runner = _adaptive_chaos_runner("NONE", target, rate=1.0)
+    with pytest.raises(InjectedFault) as ei:
+        runner.execute(sql)
+    msg = str(ei.value)
+    assert any(tag in msg for tag in inside_tags), \
+        f"fault fired outside the adaptive paths: {msg}"
+    assert is_retryable(ei.value)
+    # oracle-green under TASK with the SAME deep targeting; at least one
+    # seed must actually inject (and then retry through) the deep fault
+    injected_inside = False
+    for seed in range(6):
+        green = _adaptive_chaos_runner("TASK", target, seed=seed,
+                                       rate=0.45, attempts=8)
+        got = green.execute(sql)
+        expected = oracle.execute(oracle_sql).fetchall()
+        assert_same(got.rows, expected, False)
+        if green.stats["faults_injected"] > 0:
+            details = green._faults.by_detail
+            assert any(k[0] == "spill" and
+                       any(t in k[1] for t in inside_tags)
+                       for k in details), details
+            injected_inside = True
+            break
+    assert injected_inside, "no TASK seed injected the deep spill fault"
+
+
+def test_chaos_spill_fires_inside_agg_recursion(oracle):
+    _spill_chaos_proof(oracle, ADAPTIVE_AGG_SQL, ADAPTIVE_AGG_ORACLE,
+                       ("agg-recurse", "agg-heavy", "agg-fallback"))
+
+
+def test_chaos_spill_fires_inside_join_recursion(oracle):
+    _spill_chaos_proof(oracle, ADAPTIVE_JOIN_SQL, ADAPTIVE_JOIN_ORACLE,
+                       ("join-recurse", "join-heavy", "join-fallback"))

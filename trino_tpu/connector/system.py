@@ -31,7 +31,8 @@ TABLES: Dict[str, tuple] = {
         ("retries", T.BIGINT), ("faults_injected", T.BIGINT),
         ("resource_group", T.VarcharType()),
         ("pool_reserved_bytes", T.BIGINT), ("pool_peak_bytes", T.BIGINT),
-        ("memory_kills", T.BIGINT), ("leaked_bytes", T.BIGINT)),
+        ("memory_kills", T.BIGINT), ("leaked_bytes", T.BIGINT),
+        ("spilled_bytes", T.BIGINT)),
     "tasks": (
         ("query_id", T.VarcharType()), ("task_id", T.VarcharType()),
         ("state", T.VarcharType()), ("rows", T.BIGINT),
@@ -83,7 +84,8 @@ def _rows_for(table: str) -> List[tuple]:
                      q.mem.peak if q.mem is not None else 0),
                  max(q.memory_kills,
                      q.mem.kills if q.mem is not None else 0),
-                 q.leaked_bytes)
+                 q.leaked_bytes,
+                 (q.stats or {}).get("spilled_bytes", 0))
                 for q in TRACKER.list()]
     if table == "tasks":
         # single-controller engine: one task per query (the mesh's shards

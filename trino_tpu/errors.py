@@ -75,6 +75,11 @@ CLUSTER_OUT_OF_MEMORY = ErrorCode("CLUSTER_OUT_OF_MEMORY", 131076,
                                   INSUFFICIENT_RESOURCES, retryable=True)
 EXCEEDED_LOCAL_MEMORY_LIMIT = ErrorCode(
     "EXCEEDED_LOCAL_MEMORY_LIMIT", 131079, INSUFFICIENT_RESOURCES)
+# spill partition stores exhausted their host-RAM byte budget
+# (`spill_max_bytes`): NOT retryable — a re-run would spill the same
+# bytes again (the reference's ExceededSpillLimitException contract)
+EXCEEDED_SPILL_LIMIT = ErrorCode(
+    "EXCEEDED_SPILL_LIMIT", 131078, INSUFFICIENT_RESOURCES)
 
 
 class TrinoError(Exception):

@@ -281,6 +281,7 @@ class DistributedQueryRunner(LocalQueryRunner):
         executor.collector = self._collector
         executor.exec_params = self._exec_params
         executor.slices = self._slices
+        executor.adaptive = getattr(self, "_adaptive", None)
         if self._memory is not None:
             executor.memory = self._memory   # query-level shared ledger
         root_stream = executor.execute(frag.root)
@@ -442,6 +443,7 @@ class DistributedQueryRunner(LocalQueryRunner):
             executor.collector = self._collector
             executor.exec_params = self._exec_params
             executor.slices = self._slices
+            executor.adaptive = getattr(self, "_adaptive", None)
             if self._memory is not None:
                 executor.memory = self._memory  # shards share the ledger
             ck = store.load(scope_of(shard)) if store is not None else None

@@ -52,14 +52,30 @@ class FaultInjector:
                  sites: Optional[Tuple[str, ...]] = None):
         self.seed = int(seed)
         self.rate = float(rate)
+        # a site entry may carry a pass-skip suffix "name@K": when armed,
+        # the fault fires on the (K+1)-th pass of that site instead of
+        # the first — chaos can target DEEP code paths (a spill site
+        # inside a recursive repartition round) that always sit behind
+        # earlier passes of the same site. Bare names keep skip 0, so
+        # historical seeds replay identically.
         self.sites = tuple(sites) if sites else SITES
+        self._site_skips = tuple(
+            (s.split("@", 1)[0], int(s.split("@", 1)[1]))
+            if "@" in s else (s, 0)
+            for s in self.sites)
         self.config = (self.seed, self.rate, self.sites)
         self._rng = random.Random(self.seed)
         self._armed: Optional[str] = None
+        self._skip = 0
         self._label: object = None
         self.draws = 0
         self.injected = 0
         self.by_site: Dict[str, int] = {}
+        # (site, detail) injection counts, CUMULATIVE across queries —
+        # the proof surface that a fault fired inside a specific path
+        # (e.g. ("spill", "join-recurse")); the runner clears by_site
+        # per query but leaves this ledger for chaos assertions
+        self.by_detail: Dict[Tuple[str, str], int] = {}
 
     @classmethod
     def from_session(cls, session) -> Optional["FaultInjector"]:
@@ -93,15 +109,24 @@ class FaultInjector:
         self._armed = None
         self._label = label
         if self._rng.random() < self.rate:
-            self._armed = self.sites[self._rng.randrange(len(self.sites))]
+            name, skip = self._site_skips[
+                self._rng.randrange(len(self._site_skips))]
+            self._armed = name
+            self._skip = skip
 
     def site(self, site: str, detail: str = "") -> None:
-        """Execution passes a named fault site; raises iff armed for it."""
+        """Execution passes a named fault site; raises iff armed for it
+        (after skipping the armed entry's configured pass count)."""
         if self._armed != site:
+            return
+        if self._skip > 0:
+            self._skip -= 1
             return
         self._armed = None
         self.injected += 1
         self.by_site[site] = self.by_site.get(site, 0) + 1
+        self.by_detail[(site, detail)] = \
+            self.by_detail.get((site, detail), 0) + 1
         exc = InjectedMemoryPressure if site == "memory" else InjectedFault
         raise exc(
             f"injected fault at {site}"

@@ -209,6 +209,12 @@ class LocalExecutionPlanner:
         # runner: connector page sinks stage under it and commit on
         # finish, so a retried write attempt can never double-commit
         self.write_token: Optional[str] = None
+        # adaptive strategy state (exec/adaptive.AdaptiveQueryState),
+        # installed by the owning runner and SHARED across retry
+        # attempts: the memory-degrade re-run starts from the modes and
+        # heavy keys the failed attempt observed. None = per-execution
+        # throwaway state (direct executor use).
+        self.adaptive = None
 
     def _checkpoint(self) -> None:
         """Cooperative cancellation/deadline point (page-batch boundary);
@@ -226,6 +232,31 @@ class LocalExecutionPlanner:
         (QueryStats.spilledDataSize analog)."""
         if self.collector is not None:
             self.collector.add_spill(nbytes)
+
+    def _new_spill_store(self, npart: int):
+        """A HostPartitionStore charged against the process SpillLedger
+        under this query's `spill_max_bytes` budget — spill can no
+        longer silently exhaust host RAM (EXCEEDED_SPILL_LIMIT)."""
+        from trino_tpu.exec.spill import (SPILL_LEDGER, HostPartitionStore,
+                                          resolve_spill_limit)
+        return HostPartitionStore(
+            npart, ledger=SPILL_LEDGER, query_id=self.memory.query_id,
+            limit=resolve_spill_limit(self.session))
+
+    def _adaptive_event(self, name: str, n: int = 1) -> None:
+        """Count one adaptive strategy event on the query's collector
+        (agg_mode_downgrades / join_recursions / heavy_key_splits /
+        spill_fallbacks ... — obs/stats.py)."""
+        col = self.collector
+        if col is not None:
+            setattr(col, name, getattr(col, name) + n)
+
+    def _adaptive_span(self, name: str, **attrs) -> None:
+        """Emit an instantaneous strategy-switch trace span: every
+        adaptive re-decision is a first-class observable event."""
+        from trino_tpu.obs.stats import maybe_span
+        with maybe_span(self.collector, name, kind="adaptive", **attrs):
+            pass
 
     def _sliced(self, pages):
         """Wrap a leaf page iterator in the slice loop (exec/sliced/):
@@ -496,15 +527,23 @@ class LocalExecutionPlanner:
         first so the concat transient is O(live rows), not O(sum of scan
         capacities). Shared by blocking collects and the distributed
         runner's per-shard fragment outputs."""
+        page, _ = self.merge_counted_rows(pages)
+        return page
+
+    def merge_counted_rows(self, pages: List[Page]
+                           ) -> Tuple[Optional[Page], int]:
+        """merge_counted plus the live total it already fetched — the
+        adaptive aggregation's reduction-ratio denominator, free at
+        every compaction boundary."""
         if not pages:
-            return None
+            return None, 0
         counts = [int(c) for c in jax.device_get(
             [p.num_rows for p in pages])]
         total = sum(counts)
         if total == 0:
-            return None
+            return None, 0
         live = [self._tight(p, c) for p, c in zip(pages, counts) if c > 0]
-        return self._merge_buf(live, total)
+        return self._merge_buf(live, total), total
 
     @staticmethod
     def _tight(page: Page, n: int) -> Page:
@@ -681,6 +720,14 @@ class LocalExecutionPlanner:
         partial_op = compose_chain(
             src.pending, ("agg-partial", key_channels_t, specs_t),
             lambda: hash_aggregate(key_channels, specs, Step.PARTIAL))
+        # the adaptive bypass kernel: same fused chain, but the tail maps
+        # each row to a PARTIAL-layout state row with NO sort (O(n) — the
+        # "Partial Partial Aggregates" bypass for effectively-high NDV);
+        # layout-identical to partial_op's output so both mix in one buffer
+        from trino_tpu.ops.aggregate import passthrough_partial
+        bypass_op = compose_chain(
+            src.pending, ("agg-bypass", key_channels_t, specs_t),
+            lambda: passthrough_partial(key_channels, specs))
 
         # FINAL consumes the partial layout: keys first, then each agg's
         # state columns in sequence
@@ -712,86 +759,293 @@ class LocalExecutionPlanner:
             # high-cardinality GROUP BY) the compacted states spill to host
             # hash partitions and finalize one bounded partition at a time
             # (SpillableHashAggregationBuilder.java:47 re-thought — see
-            # exec/spill.py).
+            # exec/spill.py). ADAPTIVE: an AggModeController watches the
+            # observed reduction ratio at every compaction boundary and
+            # walks full -> shrunken -> bypass (exec/adaptive.py) when NDV
+            # turns out effectively high, re-upgrading when it recovers;
+            # decisions happen only between device dispatches, so the
+            # sliced executor's cooperative boundary stays responsive.
+            from trino_tpu.exec.adaptive import (AdaptiveQueryState,
+                                                 AggMode)
             from trino_tpu.exec.memory import page_bytes
-            from trino_tpu.exec.spill import (HostPartitionStore,
-                                              partition_by_hash)
+            from trino_tpu.exec.spill import partition_by_hash
             threshold = int(self.session.get("agg_spill_threshold_bytes"))
             npart = int(self.session.get("spill_partition_count"))
             spillable = bool(self.session.get("spill_enabled")) \
                 and bool(key_channels)
+            ctl = None
+            # adaptive modes only when spill can absorb them: without a
+            # flush boundary there is no observation to correct a wrong
+            # CBO estimate, and shrunken/bypass states would accumulate
+            # O(rows) with nothing bounding them
+            if bool(self.session.get("adaptive_partial_agg")) \
+                    and spillable:
+                state = self.adaptive if self.adaptive is not None \
+                    else AdaptiveQueryState()
+                # STRUCTURAL key (group-by + aggregate output symbol
+                # names), not node id: a degrade re-run that re-plans
+                # past a missed plan cache must still find the failed
+                # attempt's controller; the output symbols disambiguate
+                # two operators grouping by the same keys
+                ctl = state.agg_controller(
+                    ("agg", tuple(s.name for s in node.group_by),
+                     tuple(s.name for s, _ in node.aggregations)),
+                    ndv=getattr(node, "ndv_estimate", None),
+                    rows=getattr(node, "rows_estimate", None),
+                    allow_bypass=spillable)
             store = None
-            part_op = None
+            part_ops: Dict[int, object] = {}
             buf: List[Page] = []
             buf_bytes = 0
             any_pages = False
+            # the ratio denominator is RAW input rows in EVERY mode
+            # (full's per-page partial must not shrink it, or
+            # key-clustered input oscillates between metrics): raw page
+            # counts batch-fetch at the compaction boundary, and a
+            # re-buffered compacted page carries its history forward
+            raw_counts: List[object] = []
+            raw_carry = 0
+
+            def part_op_for(salt: int):
+                op = part_ops.get(salt)
+                if op is None:
+                    op = part_ops[salt] = cached_kernel(
+                        ("agg-spill-part", nkeys, npart, salt),
+                        lambda: partition_by_hash(final_keys, npart,
+                                                  salt=salt))
+                return op
 
             def compact_buffer():
                 nonlocal buf, buf_bytes
-                merged = self.merge_counted(buf)
+                merged, rows_in = self.merge_counted_rows(buf)
                 buf, buf_bytes = [], 0
                 if merged is None:
-                    return None
+                    return None, rows_in, 0
                 out = intermediate_op(merged)
                 n = int(jax.device_get(out.num_rows))
                 if n == 0:
-                    return None
-                return self._tight(out, n)
+                    return None, rows_in, 0
+                return self._tight(out, n), rows_in, n
+
+            def raw_rows_in():
+                nonlocal raw_counts, raw_carry
+                total = raw_carry + sum(
+                    int(c) for c in jax.device_get(raw_counts)) \
+                    if raw_counts else raw_carry
+                raw_counts = []
+                return total
+
+            def observe(rows_in, groups_out):
+                if ctl is None or rows_in <= 0:
+                    return
+                transition = ctl.observe(rows_in, groups_out)
+                if transition is not None:
+                    self._adaptive_event(
+                        "agg_mode_downgrades" if transition == "downgrade"
+                        else "agg_mode_upgrades")
+                    self._adaptive_span(
+                        "agg-mode-switch", transition=transition,
+                        mode=ctl.mode,
+                        ratio=round(ctl.last_ratio or 0.0, 4))
 
             def spill(combined):
-                nonlocal store, part_op
+                nonlocal store
                 self._fault_site("spill", "agg")
                 self._record_spill(page_bytes(combined))
                 if store is None:
-                    store = HostPartitionStore(npart)
-                    part_op = cached_kernel(
-                        ("agg-spill-part", nkeys, npart),
-                        lambda: partition_by_hash(final_keys, npart))
-                sorted_pg, counts = part_op(combined)
-                store.spill_partitioned(sorted_pg, jax.device_get(counts))
+                    store = self._new_spill_store(npart)
+                sorted_pg, counts = part_op_for(0)(combined)
+                store.spill_partitioned(sorted_pg,
+                                        jax.device_get(counts))
 
-            for page in src.pages:
-                self._checkpoint()
-                any_pages = True
-                pp = partial_op(page)
-                buf.append(pp)
-                buf_bytes += page_bytes(pp)
-                if spillable and buf_bytes >= threshold:
-                    combined = compact_buffer()
+            try:
+                for page in src.pages:
+                    self._checkpoint()
+                    any_pages = True
+                    mode = ctl.mode if ctl is not None else AggMode.FULL
+                    pp = partial_op(page) if mode == AggMode.FULL \
+                        else bypass_op(page)
+                    buf.append(pp)
+                    raw_counts.append(page.num_rows)
+                    buf_bytes += page_bytes(pp)
+                    if not (spillable and buf_bytes >= threshold):
+                        continue
+                    if mode == AggMode.BYPASS:
+                        probe = ctl.should_probe()
+                        ctl.note_flush()
+                        if not probe:
+                            # full bypass: raw per-row states straight to
+                            # host partitions — zero reduction work (the
+                            # per-partition finalize groups ONCE)
+                            merged, _ = self.merge_counted_rows(buf)
+                            buf, buf_bytes = [], 0
+                            raw_counts, raw_carry = [], 0
+                            if merged is not None:
+                                spill(merged)
+                            continue
+                    elif ctl is not None:
+                        ctl.note_flush()
+                    rows_raw = raw_rows_in()
+                    combined, _rows_states, groups_out = compact_buffer()
+                    observe(rows_raw, groups_out)
                     if combined is None:
+                        raw_carry = 0
                         continue
                     cb = page_bytes(combined)
                     if cb >= threshold // 2:
                         spill(combined)        # groups aren't collapsing
+                        raw_carry = 0
                     else:
                         buf, buf_bytes = [combined], cb
+                        raw_carry = rows_raw   # history rides along
 
-            if store is None:
-                if not any_pages:
-                    if not key_channels:
-                        yield self._empty_global_agg(node, specs)
+                if store is None:
+                    if not any_pages:
+                        if not key_channels:
+                            yield self._empty_global_agg(node, specs)
+                        return
+                    merged, _ = self.merge_counted_rows(buf)
+                    if merged is None:
+                        # every input page was empty (grouped agg -> no
+                        # output; global agg partials always carry one
+                        # state row, so a None merge implies zero rows)
+                        if not key_channels:
+                            yield self._empty_global_agg(node, specs)
+                        return
+                    yield final_op(merged)
                     return
-                merged = self.merge_counted(buf)
-                if merged is None:
-                    # every input page was empty (grouped agg -> no output;
-                    # global agg partials always carry one state row, so
-                    # merge_counted returning None implies zero rows total)
-                    if not key_channels:
-                        yield self._empty_global_agg(node, specs)
-                    return
-                yield final_op(merged)
-                return
-            combined = compact_buffer()
-            if combined is not None:
-                spill(combined)
-            for p in range(npart):
-                nrows = store.partition_rows(p)
-                if nrows == 0:
-                    continue
-                pg = store.restage(p, _next_pow2(max(nrows, 1)))
-                store.drop(p)
-                yield final_op(pg)
+                rows_raw = raw_rows_in()
+                combined, _rows_states, groups_out = compact_buffer()
+                observe(rows_raw, groups_out)
+                if combined is not None:
+                    spill(combined)
+                yield from self._finalize_agg_spill(
+                    store, 0, final_op, intermediate_op, part_op_for,
+                    final_keys, threshold)
+            finally:
+                if store is not None:
+                    store.close()
         return PageStream(gen(), node.outputs)
+
+    def _finalize_agg_spill(self, store, depth: int, final_op,
+                            intermediate_op, part_op_for, key_idxs,
+                            threshold: int) -> Iterator[Page]:
+        """Finalize spilled hash partitions with the robust dynamic
+        hybrid discipline: a partition within budget restages and
+        finalizes in one kernel; one still over budget first splits out
+        heavy-hitter keys (re-hashing can NEVER separate one key's rows
+        — they fold chunk-wise instead, INTERMEDIATE collapses a heavy
+        key to ONE state row per chunk), then recursively repartitions
+        with a fresh hash salt up to `spill_max_recursion`, and at max
+        depth falls back to the bounded chunked fold — graceful
+        degradation instead of an over-budget restage OOM."""
+        from trino_tpu.exec.memory import page_bytes
+        from trino_tpu.exec.spill import (detect_partition_heavy_keys,
+                                          partition_key_hashes,
+                                          split_partition)
+        threshold = self._spill_budget(threshold)
+        max_rec = int(self.session.get("spill_max_recursion"))
+        heavy_limit = int(self.session.get("spill_heavy_key_limit"))
+        npart = store.npart
+
+        def stage_final(p: int, nrows: int) -> Iterator[Page]:
+            pg = store.restage(p, _next_pow2(max(nrows, 1)))
+            store.drop(p)
+            held = page_bytes(pg)
+            self.memory.reserve(held, "agg-restage",
+                                device=self.mem_device)
+            try:
+                yield final_op(pg)
+            finally:
+                self.memory.free(held, "agg-restage",
+                                 device=self.mem_device)
+
+        for p in range(npart):
+            self._checkpoint()
+            nrows = store.partition_rows(p)
+            if nrows == 0:
+                continue
+            if store.partition_bytes(p) <= max(threshold, 1):
+                yield from stage_final(p, nrows)
+                continue
+            chunk_rows = store.chunk_rows_for(p, threshold)
+            if heavy_limit > 0 and depth < max_rec and npart > 1:
+                hashes = partition_key_hashes(store, p, key_idxs)
+                heavy = detect_partition_heavy_keys(
+                    store, p, key_idxs, heavy_limit,
+                    max(2, nrows // (2 * max(npart, 2))),
+                    piece_hashes=hashes)
+                if len(heavy):
+                    self._fault_site("spill", "agg-heavy")
+                    self._adaptive_event("heavy_key_splits")
+                    self._adaptive_span("agg-heavy-split", depth=depth,
+                                        keys=int(len(heavy)))
+                    sub = split_partition(store, p, key_idxs, heavy,
+                                          piece_hashes=hashes)
+                    try:
+                        yield from self._agg_chunk_fold(
+                            sub, 0, final_op, intermediate_op,
+                            chunk_rows)
+                    finally:
+                        sub.close()
+                    nrows = store.partition_rows(p)
+                    if nrows == 0:
+                        continue
+                    if store.partition_bytes(p) <= max(threshold, 1):
+                        yield from stage_final(p, nrows)
+                        continue
+            if depth >= max_rec or npart <= 1:
+                # bounded-depth guarantee: an irreducible partition
+                # folds in bounded chunks instead of recursing forever
+                # (npart <= 1: re-hashing cannot redistribute at all)
+                self._fault_site("spill", "agg-fallback")
+                self._adaptive_event("spill_fallbacks")
+                self._adaptive_span("agg-spill-fallback", depth=depth)
+                yield from self._agg_chunk_fold(
+                    store, p, final_op, intermediate_op, chunk_rows)
+                continue
+            # recursive repartition under a fresh hash salt: the same
+            # keys REDISTRIBUTE across the child partitions
+            self._fault_site("spill", "agg-recurse")
+            self._adaptive_event("agg_recursions")
+            self._adaptive_span("agg-spill-recurse", depth=depth + 1)
+            child = self._new_spill_store(npart)
+            try:
+                op = part_op_for(depth + 1)
+                # drain: each transferred piece releases before the
+                # child charges the next — no transient double-hold of
+                # the partition against the spill budget
+                for chunk in store.drain_partition_chunks(p, chunk_rows):
+                    self._checkpoint()
+                    sorted_pg, counts = op(chunk)
+                    child.spill_partitioned(sorted_pg,
+                                            jax.device_get(counts))
+                store.drop(p)
+                yield from self._finalize_agg_spill(
+                    child, depth + 1, final_op, intermediate_op,
+                    part_op_for, key_idxs, threshold)
+            finally:
+                child.close()
+
+    def _agg_chunk_fold(self, store, p: int, final_op, intermediate_op,
+                        chunk_rows: int) -> Iterator[Page]:
+        """Bounded chunked merge of one partition: restage <= chunk_rows
+        at a time, INTERMEDIATE-fold into the carried state, finalize
+        once — the device transient is one chunk plus the state (which
+        is the partition's true group count, the output-size floor no
+        strategy can beat). The heavy-key path and the max-recursion
+        fallback both bottom out here."""
+        state = None
+        for chunk in store.drain_partition_chunks(p, chunk_rows):
+            self._checkpoint()
+            merged = chunk if state is None \
+                else self._device_concat([state, chunk])
+            out = intermediate_op(merged)
+            n = int(jax.device_get(out.num_rows))
+            state = self._tight(out, n) if n else None
+        store.drop(p)
+        if state is not None:
+            yield final_op(state)
 
     def _empty_global_agg(self, node: AggregationNode, specs) -> Page:
         cols = []
@@ -847,8 +1101,7 @@ class LocalExecutionPlanner:
             # exec/spill.py leading_rank), then each partition re-stages,
             # fully sorts, and emits in partition order == global order.
             from trino_tpu.exec.memory import page_bytes
-            from trino_tpu.exec.spill import (HostPartitionStore,
-                                              partition_by_range,
+            from trino_tpu.exec.spill import (partition_by_range,
                                               rank_bounds, leading_rank)
             threshold = int(self.session.get("sort_spill_threshold_bytes"))
             npart = int(self.session.get("spill_partition_count"))
@@ -869,7 +1122,7 @@ class LocalExecutionPlanner:
                     return
                 self._record_spill(page_bytes(merged))
                 if bounds is None:
-                    store = HostPartitionStore(npart)
+                    store = self._new_spill_store(npart)
                     nf = k0.resolved_nulls_first()
                     rank_op = cached_kernel(
                         ("sort-spill-rank", k0.channel, k0.ascending, nf),
@@ -887,34 +1140,38 @@ class LocalExecutionPlanner:
                 sorted_pg, counts = part_op(merged, bounds)
                 store.spill_partitioned(sorted_pg, jax.device_get(counts))
 
-            for page in src.iter_pages():
-                self._checkpoint()
-                buf.append(page)
-                buf_bytes += page_bytes(page)
-                if spillable and buf_bytes >= threshold:
-                    flush()
+            try:
+                for page in src.iter_pages():
+                    self._checkpoint()
+                    buf.append(page)
+                    buf_bytes += page_bytes(page)
+                    if spillable and buf_bytes >= threshold:
+                        flush()
 
-            if store is None:
-                page = self.merge_counted(buf)
-                if page is None:
+                if store is None:
+                    page = self.merge_counted(buf)
+                    if page is None:
+                        return
+                    from trino_tpu.exec.memory import page_bytes as _pb
+                    self.memory.reserve(_pb(page), "collect",
+                                        device=self.mem_device)
+                    try:
+                        yield sort_op(page)
+                    finally:
+                        self._free_collected(page)
                     return
-                from trino_tpu.exec.memory import page_bytes as _pb
-                self.memory.reserve(_pb(page), "collect",
-                                    device=self.mem_device)
-                try:
-                    yield sort_op(page)
-                finally:
-                    self._free_collected(page)
-                return
-            if buf:
-                flush()
-            for p in range(npart):
-                nrows = store.partition_rows(p)
-                if nrows == 0:
-                    continue
-                pg = store.restage(p, _next_pow2(max(nrows, 1)))
-                store.drop(p)
-                yield sort_op(pg)
+                if buf:
+                    flush()
+                for p in range(npart):
+                    nrows = store.partition_rows(p)
+                    if nrows == 0:
+                        continue
+                    pg = store.restage(p, _next_pow2(max(nrows, 1)))
+                    store.drop(p)
+                    yield sort_op(pg)
+            finally:
+                if store is not None:
+                    store.close()
         return PageStream(gen(), src.symbols)
 
     def _exec_TopNNode(self, node: TopNNode) -> PageStream:
@@ -969,7 +1226,25 @@ class LocalExecutionPlanner:
         build_lay, _ = _layout(build_stream.symbols)
         probe_keys = [probe_lay[c.left.name] for c in node.criteria]
         build_keys = [build_lay[c.right.name] for c in node.criteria]
-        build_page = self._collect(build_stream)
+        # adaptive build collection (HashBuilderOperator's revoke-during-
+        # build, re-thought): an INNER spillable build with non-string
+        # keys collects with INCREMENTAL reservation — memory pressure
+        # mid-collect switches to the streaming partitioned hybrid join
+        # (build pages partition to host one at a time, never
+        # materialized whole), so an underestimated build is a strategy
+        # switch, not an OOM cliff. String keys keep the classic collect:
+        # co-partition hashing compares dictionary CODES, which only
+        # align after the full build pool is known.
+        build_iter = None
+        if node.kind == JoinKind.INNER \
+                and bool(self.session.get("spill_enabled")) \
+                and int(self.session.get("spill_partition_count")) > 1 \
+                and not any(T.is_string(build_stream.symbols[bk].type)
+                            for bk in build_keys):
+            build_page, build_iter = \
+                self._collect_build_resilient(build_stream)
+        else:
+            build_page = self._collect(build_stream)
         # PruneJoinColumns: node.outputs may be a subset of left+right
         # (optimizer sets output_symbols) — emit only those channels, so
         # probe/build gathers skip dropped columns entirely
@@ -1045,6 +1320,17 @@ class LocalExecutionPlanner:
             return probe_op, attach_op
 
         def gen():
+            if build_iter is not None:
+                # the build overflowed its reservation mid-collect: the
+                # streaming partitioned hybrid consumes the remaining
+                # pages without ever materializing the whole side
+                yield from self._run_partitioned_inner(
+                    probe_stream, build_iter, probe_keys, build_keys,
+                    join_op,
+                    node_id=("join",
+                             tuple(c.left.name for c in node.criteria),
+                             tuple(c.right.name for c in node.criteria)))
+                return
             collected = build_page   # only the _collect'ed page was reserved
             bp = build_page
             if bp is None:
@@ -1069,7 +1355,11 @@ class LocalExecutionPlanner:
                 yield from self._run_spilled_inner(
                     aligned, build_page, probe_keys, build_keys,
                     post_pred, post_params, probe_keep, build_keep,
-                    join_op)
+                    join_op,
+                    skew_hint=getattr(node, "build_skew_estimate", None),
+                    node_id=("join",
+                             tuple(c.left.name for c in node.criteria),
+                             tuple(c.right.name for c in node.criteria)))
                 return
             try:
                 prepared, max_run, dense = self._prepare_with_dense(
@@ -1110,14 +1400,23 @@ class LocalExecutionPlanner:
     def _run_spilled_inner(self, probe_stream, build_page,
                            probe_keys, build_keys, post_pred, post_params,
                            probe_keep, build_keep,
-                           fallback_join_op) -> Iterator[Page]:
+                           fallback_join_op, skew_hint=None,
+                           node_id=None) -> Iterator[Page]:
         """Spill-mode INNER join (HashBuilderOperator spill states +
         SpillingJoinProcessor analog): sort the build keys on device, move
         the build's payload columns to HOST RAM, keep only (sorted keys,
         permutation) in HBM (~12B/row), probe streams against the key
-        array, and gather build columns host-side at match count. Falls
-        back to the in-memory path for duplicate-key builds (rare for the
-        >threshold case: big builds are fact/dimension primary keys)."""
+        array, and gather build columns host-side at match count.
+
+        Duplicate-key and string-keyed builds — the shapes the unique
+        key-array probe cannot serve — route to the robust dynamic
+        HYBRID partitioned join (`_run_partitioned_inner`): both sides
+        hash-partition to host, partitions join in memory, over-budget
+        partitions recursively repartition, heavy keys split out. The
+        CBO's `build_skew_estimate` (> 2 expected duplicates per key)
+        pre-routes there without paying a wasted unique-prep; the
+        runtime observation still decides when the estimate is absent
+        or wrong."""
         from trino_tpu.exec.memory import page_bytes
         from trino_tpu.ops.join import (attach_build_host,
                                         build_dense_table_rows,
@@ -1125,14 +1424,21 @@ class LocalExecutionPlanner:
                                         spilled_dense_probe,
                                         spilled_unique_probe)
         self._fault_site("spill", "join-build")
+        npart = int(self.session.get("spill_partition_count"))
+        partitioned_ok = npart > 1
         # varchar join keys compare by per-dictionary code — the spilled
         # probe never sees the build dictionaries, so it cannot apply the
-        # shared-dictionary guard the in-memory kernels enforce; route
-        # string-keyed builds through the in-memory path (which verifies)
+        # shared-dictionary guard the in-memory kernels enforce; the
+        # partitioned path restages full pages (dictionaries ride along
+        # in store meta) and runs the verifying in-memory kernels per
+        # partition, so string keys go there too
         string_keyed = any(
             build_page.columns[bk].dictionary is not None
             for bk in build_keys)
-        if not string_keyed:
+        is_unique = False
+        cbo_partitioned = (partitioned_ok and skew_hint is not None
+                           and skew_hint > 2.0)
+        if not string_keyed and not cbo_partitioned:
             try:
                 prep = cached_kernel(
                     ("spill-prep", tuple(build_keys)),
@@ -1148,8 +1454,14 @@ class LocalExecutionPlanner:
             except Exception:
                 self._free_collected(build_page)
                 raise
-        if string_keyed or not is_unique:
-            # duplicate keys need the expansion kernel; run in-memory
+        if string_keyed or cbo_partitioned or not is_unique:
+            if partitioned_ok:
+                yield from self._run_partitioned_inner(
+                    probe_stream, build_page, probe_keys, build_keys,
+                    fallback_join_op, node_id=node_id)
+                return
+            # partitioning disabled (spill_partition_count <= 1):
+            # legacy in-memory expansion join
             try:
                 prepared, _max_run, dense = self._prepare_with_dense(
                     build_keys, build_page)
@@ -1259,6 +1571,305 @@ class LocalExecutionPlanner:
         finally:
             self.memory.free(held_bytes, "join-spill-keys",
                              device=self.mem_device)
+
+    def _collect_build_resilient(self, stream: PageStream):
+        """Collect a join build side with INCREMENTAL reservation: each
+        page reserves before the next materializes, so memory pressure
+        surfaces mid-collect — where it is a STRATEGY SWITCH (return the
+        pages-so-far chained with the rest of the stream for the
+        streaming partitioned join) instead of a terminal OOM after the
+        whole side sat in HBM. Returns (page, None) when the build fit
+        (classic paths, reservation swapped to the merged page) or
+        (None, iterator) on pressure; (None, None) = empty build."""
+        from trino_tpu.exec.memory import (ClusterOutOfMemoryError,
+                                           ExceededMemoryLimitError,
+                                           page_bytes)
+        self._fault_site("memory", "collect")
+        pages: List[Page] = []
+        held = 0
+        it = stream.iter_pages()
+        try:
+            for page in it:
+                self._checkpoint()
+                b = page_bytes(page)
+                try:
+                    self.memory.reserve(b, "collect",
+                                        device=self.mem_device)
+                except (ExceededMemoryLimitError,
+                        ClusterOutOfMemoryError):
+                    # hand every held byte back (a killer victim's
+                    # release) and clear a self-kill mark: the pressure
+                    # is relieved by NOT materializing this build
+                    self.memory.free(held, "collect",
+                                     device=self.mem_device)
+                    self.memory.clear_kill()
+                    self._adaptive_span("join-build-overflow",
+                                        held_bytes=held + b)
+                    pages.append(page)
+                    return None, _drain_then(pages, it)
+                held += b
+                pages.append(page)
+        except BaseException:
+            self.memory.free(held, "collect", device=self.mem_device)
+            raise
+        merged = self.merge_counted(pages)
+        # swap the per-page reservations for the merged page's bytes
+        # (merge shrinks to the live pow2): free FIRST — holding both
+        # transiently would double-reserve and trip a limit the merged
+        # page alone fits under
+        self.memory.free(held, "collect", device=self.mem_device)
+        if merged is None:
+            return None, None
+        try:
+            self.memory.reserve(page_bytes(merged), "collect",
+                                device=self.mem_device)
+        except (ExceededMemoryLimitError, ClusterOutOfMemoryError):
+            # even the merged page is over the line: degrade with it as
+            # the (single-page) streaming build
+            self.memory.clear_kill()
+            self._adaptive_span("join-build-overflow",
+                                held_bytes=page_bytes(merged))
+            return None, iter([merged])
+        return merged, None
+
+    def _spill_budget(self, threshold: int) -> int:
+        """The per-partition device budget for restaging/recursion
+        decisions: the configured spill threshold, shrunk under an
+        active memory limit so a restaged partition's reservation can
+        always be granted (a budget above the limit would turn the
+        ladder's graceful degradation back into a reservation
+        failure)."""
+        budget = int(threshold)
+        limit = getattr(self.memory, "limit", None)
+        if limit:
+            budget = min(budget, max(int(limit) // 4, 1 << 16))
+        pool = getattr(self.memory, "pool", None)
+        if pool is not None and pool.limit:
+            budget = min(budget, max(int(pool.limit) // 4, 1 << 16))
+        return max(budget, 1)
+
+    def _run_partitioned_inner(self, probe_stream, build_source,
+                               probe_keys, build_keys, join_op,
+                               node_id=None) -> Iterator[Page]:
+        """Robust dynamic hybrid hash join for duplicate-key / skewed /
+        string-keyed over-threshold builds (the shapes that previously
+        fell back to an UNBOUNDED in-memory build): both sides
+        hash-partition into host stores with one device partition-sort
+        each, then every co-partition joins with the normal in-memory
+        kernels when its build fits the spill budget — and degrades
+        gracefully when it doesn't (`_join_partitions`: salted recursive
+        repartition, heavy-key splitting, bounded chunked-build
+        fallback). No cliff: device footprint is bounded by one
+        partition's build plus one probe chunk at every depth."""
+        from trino_tpu.exec.memory import page_bytes
+        from trino_tpu.exec.spill import partition_by_hash
+        npart = int(self.session.get("spill_partition_count"))
+        threshold = self._spill_budget(
+            int(self.session.get("join_spill_threshold_bytes")))
+        bkeys_t, pkeys_t = tuple(build_keys), tuple(probe_keys)
+        build_is_page = isinstance(build_source, Page)
+
+        def part_op(keys, salt):
+            return cached_kernel(
+                ("join-spill-part", keys, npart, salt),
+                lambda: partition_by_hash(keys, npart, salt=salt))
+
+        try:
+            bstore = self._new_spill_store(npart)
+            pstore = self._new_spill_store(npart)
+        except BaseException:
+            if build_is_page:
+                self._free_collected(build_source)
+            raise
+        try:
+            self._fault_site("spill", "join-part")
+            bop = part_op(bkeys_t, 0)
+            if build_is_page:
+                self._record_spill(page_bytes(build_source))
+                try:
+                    sorted_pg, counts = bop(build_source)
+                    bstore.spill_partitioned(sorted_pg,
+                                             jax.device_get(counts))
+                finally:
+                    self._free_collected(build_source)
+            else:
+                # streaming build (mid-collect overflow handoff): pages
+                # partition to host one at a time — the whole side is
+                # never resident on device
+                for bpage in build_source:
+                    self._checkpoint()
+                    sorted_pg, counts = bop(bpage)
+                    bstore.spill_partitioned(sorted_pg,
+                                             jax.device_get(counts))
+                self._record_spill(bstore.bytes)
+            it = probe_stream if isinstance(probe_stream, Iterator) \
+                else self._coalesce_stream(probe_stream).iter_pages()
+            pop = part_op(pkeys_t, 0)
+            for page in it:
+                self._checkpoint()
+                sorted_pg, counts = pop(page)
+                pstore.spill_partitioned(sorted_pg,
+                                         jax.device_get(counts))
+            self._record_spill(pstore.bytes)
+            yield from self._join_partitions(
+                bstore, pstore, 0, bkeys_t, pkeys_t, join_op, part_op,
+                threshold, node_id)
+        finally:
+            bstore.close()
+            pstore.close()
+
+    def _join_partitions(self, bstore, pstore, depth: int, bkeys, pkeys,
+                         join_op, part_op, threshold: int,
+                         node_id=None) -> Iterator[Page]:
+        """One round of the hybrid join over co-partitioned stores. Per
+        partition, in order: in-budget -> in-memory join; heavy build
+        keys (unsplittable by ANY re-hash) -> split both sides out into
+        the dedicated chunked-build pass (the replicate/spread analog of
+        parallel/exchange's JSPIM handling: build chunks replicate, the
+        probe partition streams — spreads — through each); still over
+        budget -> recursive salted repartition of BOTH sides up to
+        `spill_max_recursion`; at max depth -> bounded chunked-build
+        fallback. Every switch counts and spans."""
+        from trino_tpu.exec.spill import (detect_partition_heavy_keys,
+                                          partition_key_hashes,
+                                          split_partition)
+        max_rec = int(self.session.get("spill_max_recursion"))
+        heavy_limit = int(self.session.get("spill_heavy_key_limit"))
+        npart = bstore.npart
+        for p in range(npart):
+            self._checkpoint()
+            brows = bstore.partition_rows(p)
+            prows = pstore.partition_rows(p)
+            if brows == 0 or prows == 0:
+                bstore.drop(p)
+                pstore.drop(p)
+                continue
+            if bstore.partition_bytes(p) <= max(threshold, 1):
+                yield from self._join_one_partition(
+                    bstore, pstore, p, bkeys, join_op, threshold)
+                continue
+            if heavy_limit > 0 and depth < max_rec and npart > 1:
+                bhashes = partition_key_hashes(bstore, p, bkeys)
+                heavy = detect_partition_heavy_keys(
+                    bstore, p, bkeys, heavy_limit,
+                    max(2, brows // (2 * max(npart, 2))),
+                    piece_hashes=bhashes)
+                if len(heavy):
+                    self._fault_site("spill", "join-heavy")
+                    self._adaptive_event("heavy_key_splits")
+                    self._adaptive_span("join-heavy-split", depth=depth,
+                                        keys=int(len(heavy)))
+                    if self.adaptive is not None and node_id is not None:
+                        self.adaptive.record_join_heavy(node_id, heavy)
+                    hb = split_partition(bstore, p, bkeys, heavy,
+                                         piece_hashes=bhashes)
+                    hp = split_partition(pstore, p, pkeys, heavy)
+                    try:
+                        yield from self._join_chunked_build(
+                            hb, hp, 0, bkeys, join_op, threshold)
+                    finally:
+                        hb.close()
+                        hp.close()
+                    if bstore.partition_rows(p) == 0 or \
+                            pstore.partition_rows(p) == 0:
+                        bstore.drop(p)
+                        pstore.drop(p)
+                        continue
+                    if bstore.partition_bytes(p) <= max(threshold, 1):
+                        yield from self._join_one_partition(
+                            bstore, pstore, p, bkeys, join_op, threshold)
+                        continue
+            if depth >= max_rec or npart <= 1:
+                self._fault_site("spill", "join-fallback")
+                self._adaptive_event("spill_fallbacks")
+                self._adaptive_span("join-spill-fallback", depth=depth)
+                yield from self._join_chunked_build(
+                    bstore, pstore, p, bkeys, join_op, threshold)
+                continue
+            self._fault_site("spill", "join-recurse")
+            self._adaptive_event("join_recursions")
+            self._adaptive_span("join-spill-recurse", depth=depth + 1)
+            childb = self._new_spill_store(npart)
+            childp = self._new_spill_store(npart)
+            try:
+                bop = part_op(bkeys, depth + 1)
+                # drain both transfers: the recursion must never hold
+                # parent AND child copies of one side against the budget
+                for chunk in bstore.drain_partition_chunks(
+                        p, bstore.chunk_rows_for(p, threshold)):
+                    self._checkpoint()
+                    spg, cnt = bop(chunk)
+                    childb.spill_partitioned(spg, jax.device_get(cnt))
+                bstore.drop(p)
+                pop = part_op(pkeys, depth + 1)
+                for chunk in pstore.drain_partition_chunks(
+                        p, pstore.chunk_rows_for(p, threshold)):
+                    self._checkpoint()
+                    spg, cnt = pop(chunk)
+                    childp.spill_partitioned(spg, jax.device_get(cnt))
+                pstore.drop(p)
+                yield from self._join_partitions(
+                    childb, childp, depth + 1, bkeys, pkeys, join_op,
+                    part_op, threshold, node_id)
+            finally:
+                childb.close()
+                childp.close()
+
+    def _join_one_partition(self, bstore, pstore, p: int, bkeys,
+                            join_op, threshold: int) -> Iterator[Page]:
+        """In-memory join of one co-partition: restage the build side
+        (reserved against the query ledger), prepare once, stream the
+        probe partition through in bounded chunks."""
+        from trino_tpu.exec.memory import page_bytes
+        nrows = bstore.partition_rows(p)
+        bpage = bstore.restage(p, _next_pow2(max(nrows, 1)))
+        bstore.drop(p)
+        held = page_bytes(bpage)
+        self.memory.reserve(held, "join-part-build",
+                            device=self.mem_device)
+        try:
+            prepared, _max_run, dense = self._prepare_with_dense(
+                list(bkeys), bpage)
+            yield from _run_with_overflow(
+                pstore.drain_partition_chunks(
+                    p, pstore.chunk_rows_for(p, threshold)),
+                prepared, lambda cap: join_op(cap, dense),
+                self.page_capacity)
+            pstore.drop(p)
+        finally:
+            self.memory.free(held, "join-part-build",
+                             device=self.mem_device)
+
+    def _join_chunked_build(self, bstore, pstore, p: int, bkeys,
+                            join_op, threshold: int) -> Iterator[Page]:
+        """Bounded chunked-build join: INNER join distributes over
+        DISJOINT build chunks (each probe row meets each of its key's
+        build rows in exactly one chunk), so joining the probe partition
+        against budget-sized build chunks is correct at ANY build size —
+        the bounded-memory floor under both the heavy-key path and the
+        max-recursion fallback. More passes, never more memory."""
+        from trino_tpu.exec.memory import page_bytes
+        pchunk_rows = pstore.chunk_rows_for(p, threshold)
+        # build chunks drain (single pass); the probe partition must
+        # stay resident — it re-streams once per build chunk
+        for bchunk in bstore.drain_partition_chunks(
+                p, bstore.chunk_rows_for(p, threshold)):
+            self._checkpoint()
+            held = page_bytes(bchunk)
+            self.memory.reserve(held, "join-chunk-build",
+                                device=self.mem_device)
+            try:
+                prepared, _mr, dense = self._prepare_with_dense(
+                    list(bkeys), bchunk)
+                yield from _run_with_overflow(
+                    pstore.iter_partition_chunks(p, pchunk_rows),
+                    prepared, lambda cap, d=dense: join_op(cap, d),
+                    self.page_capacity)
+            finally:
+                self.memory.free(held, "join-chunk-build",
+                                 device=self.mem_device)
+        bstore.drop(p)
+        pstore.drop(p)
 
     def _compact_probe(self, pre: Page, found, total: int,
                        live: int) -> Page:
@@ -1951,8 +2562,10 @@ def _run_with_overflow(probe_stream: PageStream, build_page: Page,
     remote TPUs, but dispatching the whole stream before the first sync
     would pin every intermediate output in HBM simultaneously); only pages
     that actually overflowed re-run at the next capacity bucket (SURVEY §7
-    contract)."""
-    it = probe_stream.iter_pages()
+    contract). Accepts a PageStream or a bare page iterator (the
+    partitioned join streams restaged probe chunks directly)."""
+    it = probe_stream.iter_pages() \
+        if hasattr(probe_stream, "iter_pages") else iter(probe_stream)
     for probe_pages in _byte_bounded_batches(it, 1 << 29):
         results = []
         for page in probe_pages:
@@ -1977,6 +2590,16 @@ def _run_with_overflow(probe_stream: PageStream, build_page: Page,
 def _chain_first(first: Optional[Page], rest: Iterator[Page]) -> Iterator[Page]:
     if first is not None:
         yield first
+    yield from rest
+
+
+def _drain_then(pages: List[Page], rest: Iterator[Page]) -> Iterator[Page]:
+    """Yield the buffered pages DROPPING each reference as it is
+    consumed (itertools.chain would pin the whole list — and its HBM —
+    until exhaustion; this path exists precisely because memory is
+    tight), then continue with the live stream."""
+    while pages:
+        yield pages.pop(0)
     yield from rest
 
 

@@ -141,6 +141,12 @@ class LocalQueryRunner:
         self._ckpts = None
         self._write_token = None
         self._created_tables = set()
+        # per-query adaptive strategy state (exec/adaptive.py): shared
+        # across retry ATTEMPTS so the once-per-query spill-forced
+        # degrade re-run inherits the failed attempt's observed agg
+        # modes and heavy join keys instead of restarting cold. Kept
+        # until the next execute() so tests/diagnostics can inspect it.
+        self._adaptive = None
         # the per-query QueryStatsCollector (obs/stats.py): phases,
         # output rows/bytes, jit hit/miss, spill bytes, operator stats
         self._collector = None
@@ -182,6 +188,7 @@ class LocalQueryRunner:
         clone._ckpts = None
         clone._write_token = None
         clone._created_tables = set()
+        clone._adaptive = None
         clone.stats = {"retries": 0, "faults_injected": 0}
         clone.last_query_stats = {"retries": 0, "faults_injected": 0}
         return clone
@@ -285,6 +292,11 @@ class LocalQueryRunner:
                     if policy == "TASK" else None
                 self._write_token = info.query_id
                 self._created_tables = set()
+                # fresh per query, shared across its retry attempts:
+                # the degrade re-run must START where the failed
+                # attempt's observations left off
+                from trino_tpu.exec.adaptive import AdaptiveQueryState
+                self._adaptive = AdaptiveQueryState()
             except (TypeError, ValueError) as e:
                 from trino_tpu.errors import InvalidSessionPropertyError
                 raise InvalidSessionPropertyError(
@@ -902,6 +914,7 @@ class LocalQueryRunner:
         executor.exec_params = self._exec_params
         executor.slices = self._slices
         executor.write_token = self._write_token
+        executor.adaptive = self._adaptive
         if bool(self.session.get("scan_cache_enabled")) \
                 and self._faults is None:
             # chaos runs bypass the scan cache: the `scan` fault site
@@ -1102,6 +1115,7 @@ class LocalQueryRunner:
         executor.exec_params = self._exec_params
         executor.slices = self._slices
         executor.write_token = self._write_token
+        executor.adaptive = self._adaptive
         if self._memory is not None:
             executor.memory = self._memory
         t0 = time.perf_counter()

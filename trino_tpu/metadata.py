@@ -45,6 +45,35 @@ SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     # sort spill: buffered input over this flushes as host runs, finished
     # by range partitions of the leading sort key
     "sort_spill_threshold_bytes": 2 << 30,
+    # adaptive partial aggregation ("Partial Partial Aggregates"): the
+    # partial-aggregation step monitors its observed reduction ratio at
+    # every buffer-compaction boundary and walks the mode lattice
+    # full (per-page sort partial) -> shrunken (per-row pass-through
+    # states, compaction only per buffer) -> bypass (states straight to
+    # spill partitions; the per-partition finalize does ALL grouping)
+    # when NDV turns out effectively high — re-upgrading when the ratio
+    # recovers. Initial mode comes from the CBO NDV hint; transitions
+    # count as agg_mode_downgrades / agg_mode_upgrades. Set false to pin
+    # the classic always-full partial aggregation.
+    "adaptive_partial_agg": True,
+    # recursive hybrid spill ("Robust Dynamic Hybrid Hash Join"): a
+    # spill partition still over its byte budget after a round
+    # repartitions with a FRESH hash salt up to this depth, then falls
+    # back to bounded chunked processing (spill_fallbacks counter).
+    # 0 = no recursion, straight to the chunked fallback.
+    "spill_max_recursion": 3,
+    # per-partition heavy-hitter splitting: up to this many heavy keys
+    # (top-k over host partition pieces — detect_heavy_keys' discipline
+    # applied to spilled data) are split into dedicated bounded paths
+    # instead of recursing forever (re-hashing can never separate one
+    # key's rows). 0 disables detection. Counted as heavy_key_splits.
+    "spill_heavy_key_limit": 8,
+    # host-RAM byte budget for a query's spill partition stores, charged
+    # through the process SpillLedger (trino_tpu_spill_bytes gauge);
+    # an over-budget spill fails classified EXCEEDED_SPILL_LIMIT instead
+    # of silently exhausting host RAM. 0 = default: half of physical
+    # host RAM (exec/spill.default_spill_limit_bytes).
+    "spill_max_bytes": 0,
     # fault-tolerant execution (RetryPolicy / SystemSessionProperties
     # retry_policy + task_retry_attempts_per_task analogs): TASK retries
     # individual fragments, QUERY re-runs the whole statement, NONE fails

@@ -156,6 +156,12 @@ def _record_terminal_metrics(info) -> None:
         if preempt_ms > 0:
             m.PREEMPTIONS_TOTAL.inc()
             m.PREEMPT_LATENCY_SECONDS.observe(preempt_ms / 1000.0)
+        for kind in ("agg_mode_downgrades", "agg_mode_upgrades",
+                     "agg_recursions", "join_recursions",
+                     "heavy_key_splits", "spill_fallbacks"):
+            n = info.stats.get(kind, 0)
+            if n:
+                m.ADAPTIVE_EVENTS_TOTAL.inc(n, kind=kind)
     if info.wall_ms is not None:
         m.QUERY_WALL_SECONDS.observe(info.wall_ms / 1000.0)
 

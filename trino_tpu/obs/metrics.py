@@ -314,6 +314,14 @@ PREEMPTIONS_TOTAL = REGISTRY.counter(
     "trino_tpu_preemptions_total",
     "Queries preempted (canceled/killed between slices) across the "
     "process lifetime.")
+ADAPTIVE_EVENTS_TOTAL = REGISTRY.counter(
+    "trino_tpu_adaptive_events_total",
+    "Adaptive operator strategy events by kind: partial-aggregation "
+    "mode transitions (agg_mode_downgrades/agg_mode_upgrades), "
+    "recursive spill repartition rounds (agg_recursions/"
+    "join_recursions), heavy-hitter key splits (heavy_key_splits), and "
+    "bounded chunked fallbacks at max recursion depth "
+    "(spill_fallbacks).", labeled=True)
 PREEMPT_LATENCY_SECONDS = REGISTRY.histogram(
     "trino_tpu_preempt_latency_seconds",
     "Cancel-request to unwind wall per preempted query — bounded by "
@@ -362,6 +370,19 @@ def _engine_gauges():
         yield ("trino_tpu_pool_device_peak_bytes",
                pool + "peak reservation attributed per mesh device.",
                NODE_POOL.device_peak.get(d, 0), labels)
+
+    from trino_tpu.exec.spill import SPILL_LEDGER
+    spill = "Spill partition stores: "
+    yield ("trino_tpu_spill_bytes",
+           spill + "host RAM currently held by spilled partitions.",
+           SPILL_LEDGER.reserved, {})
+    yield ("trino_tpu_spill_peak_bytes",
+           spill + "peak host RAM held since process start.",
+           SPILL_LEDGER.peak, {})
+    yield ("trino_tpu_spill_limit_denials",
+           spill + "reservations denied by a query's spill_max_bytes "
+           "budget (EXCEEDED_SPILL_LIMIT failures).",
+           SPILL_LEDGER.denials, {})
 
     from trino_tpu.exec.resource_groups import list_all_groups
     for g in list_all_groups():

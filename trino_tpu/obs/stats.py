@@ -114,6 +114,19 @@ class QueryStatsCollector:
         self.checkpoints_restored = 0
         self.checkpoint_bytes = 0
         self.preempt_latency_ms = 0.0
+        # adaptive operator strategies (exec/adaptive.py + the spill
+        # paths in exec/local_planner.py): partial-aggregation mode
+        # transitions (full -> shrunken -> bypass and back), recursive
+        # spill repartition rounds (salted re-hash of an over-budget
+        # partition), heavy-hitter keys split into dedicated bounded
+        # paths, and bounded chunked fallbacks at max recursion depth —
+        # every strategy switch is a first-class observable event
+        self.agg_mode_downgrades = 0
+        self.agg_mode_upgrades = 0
+        self.agg_recursions = 0
+        self.join_recursions = 0
+        self.heavy_key_splits = 0
+        self.spill_fallbacks = 0
 
     # ----------------------------------------------------------- spans
 
@@ -270,6 +283,12 @@ class QueryStatsCollector:
             "checkpoints_restored": self.checkpoints_restored,
             "checkpoint_bytes": self.checkpoint_bytes,
             "preempt_latency_ms": self.preempt_latency_ms,
+            "agg_mode_downgrades": self.agg_mode_downgrades,
+            "agg_mode_upgrades": self.agg_mode_upgrades,
+            "agg_recursions": self.agg_recursions,
+            "join_recursions": self.join_recursions,
+            "heavy_key_splits": self.heavy_key_splits,
+            "spill_fallbacks": self.spill_fallbacks,
         }
         if self.operators:
             snap["operators"] = self.operator_rows()
@@ -345,6 +364,15 @@ def render_analyzed_plan(plan, collector: QueryStatsCollector,
              f"{collector.plan_cache_misses} misses")
     if collector.spilled_bytes:
         text += f", spilled {_fmt_bytes(collector.spilled_bytes)}"
+    if (collector.agg_mode_downgrades or collector.agg_mode_upgrades
+            or collector.agg_recursions or collector.join_recursions
+            or collector.heavy_key_splits or collector.spill_fallbacks):
+        text += (f"\nadaptive: {collector.agg_mode_downgrades} agg "
+                 f"downgrades / {collector.agg_mode_upgrades} upgrades, "
+                 f"{collector.agg_recursions} agg + "
+                 f"{collector.join_recursions} join spill recursions, "
+                 f"{collector.heavy_key_splits} heavy-key splits, "
+                 f"{collector.spill_fallbacks} chunked fallbacks")
     return text
 
 

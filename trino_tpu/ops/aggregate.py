@@ -913,6 +913,47 @@ def _accumulate(page, aggs, resolved, step, partial_state_channels,
     return out
 
 
+def passthrough_partial(key_channels: Sequence[int],
+                        aggs: Sequence["AggSpec"]):
+    """BYPASS-mode partial aggregation ("Partial Partial Aggregates"
+    full bypass): emit ONE PARTIAL-layout state row per INPUT row — key
+    columns pass through untouched, each aggregate's state columns are
+    its per-row contributions — with no sort and no segment reduction.
+    O(n) map instead of O(n log n) sort: when observed NDV ~ rows the
+    sort collapses nothing, so the adaptive executor routes pages here
+    and lets the per-partition finalize (Step.INTERMEDIATE/FINAL over
+    spilled hash partitions) do ALL the grouping once.
+
+    Output is layout-identical to Step.PARTIAL, so pass-through pages,
+    real partial pages, and compacted intermediate pages mix freely in
+    one buffer/store."""
+    key_channels = tuple(key_channels)
+    for a in aggs:
+        if a.distinct or a.name in SINGLE_STEP_AGGREGATES:
+            # same restriction as PARTIAL: these need a whole group in
+            # one kernel call (the executor routes them elsewhere)
+            raise NotImplementedError(f"{a.name}() in bypass partial")
+    resolved = [get_aggregate(a.name,
+                              a.input_type if a.input2 is None
+                              else (a.input_type, a.input2_type))
+                for a in aggs]
+
+    def op(page: Page) -> Page:
+        live = page.row_mask()
+        out_cols: List[Column] = [page.column(ch) for ch in key_channels]
+        for spec, fn in zip(aggs, resolved):
+            states = fn.state(spec.input_type)
+            vals, mask, dictionary = _agg_inputs(page, spec, fn, live)
+            for sc in states:
+                d = dictionary if T.is_string(sc.type) else None
+                out_cols.append(Column(
+                    sc.contrib(vals, mask).astype(sc.type.dtype), None,
+                    sc.type, d))
+        return Page(tuple(out_cols), page.num_rows)
+
+    return op
+
+
 def group_max_size(key_channels: Sequence[int]):
     """Max live group size — the executor's sizing pre-pass for collect
     aggregates (one scalar fetch buys the static element capacity)."""

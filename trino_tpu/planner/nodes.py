@@ -182,6 +182,12 @@ class AggregationNode(PlanNode):
     group_by: Tuple[Symbol, ...]
     aggregations: Tuple[Tuple[Symbol, AggCall], ...]
     step: str = AggStep.SINGLE
+    # adaptive-strategy hints (optimizer.annotate_adaptive_hints): CBO
+    # estimated input rows + group NDV. The executor's AggModeController
+    # (exec/adaptive.py) picks its INITIAL partial-aggregation mode from
+    # the ratio and re-decides at runtime from the OBSERVED reduction.
+    rows_estimate: Optional[float] = None
+    ndv_estimate: Optional[float] = None
     # grouping sets support: group id symbol when multiple sets (GroupIdNode
     # is planned separately; single set here)
 
@@ -208,7 +214,8 @@ class AggregationNode(PlanNode):
 
     def with_sources(self, sources):
         return AggregationNode(sources[0], self.group_by, self.aggregations,
-                               self.step)
+                               self.step, self.rows_estimate,
+                               self.ndv_estimate)
 
 
 @_node
@@ -272,6 +279,14 @@ class JoinNode(PlanNode):
     # order) are emitted — the executor then skips the build-column gathers
     # for dropped channels, the hot cost of wide fact-to-dim joins
     output_symbols: Optional[Tuple[Symbol, ...]] = None
+    # adaptive-strategy hint (optimizer.annotate_adaptive_hints): CBO
+    # estimated build rows / build-key NDV — the average duplication of
+    # the build side. >2 pre-routes an over-threshold build to the
+    # partitioned hybrid join (exec/local_planner._run_partitioned_inner)
+    # without paying the unique-probe prep; the runtime observation
+    # (`is_unique` from prepare) still re-decides when the estimate is
+    # missing or wrong.
+    build_skew_estimate: Optional[float] = None
 
     @property
     def sources(self):
@@ -285,7 +300,8 @@ class JoinNode(PlanNode):
 
     def with_sources(self, sources):
         return JoinNode(self.kind, sources[0], sources[1], self.criteria,
-                        self.filter, self.distribution, self.output_symbols)
+                        self.filter, self.distribution, self.output_symbols,
+                        self.build_skew_estimate)
 
 
 @_node

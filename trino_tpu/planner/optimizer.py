@@ -1555,6 +1555,44 @@ def fragment_plan(root: OutputNode) -> PlanFragment:
 # pipeline (PlanOptimizers.java ordering)
 
 
+def annotate_adaptive_hints(node: PlanNode,
+                            ctx: OptimizerContext) -> PlanNode:
+    """Stamp CBO NDV/skew estimates onto aggregation and join nodes as
+    adaptive-strategy hints (exec/adaptive.py): aggregations carry
+    (input rows, group NDV) so the partial-agg mode controller starts
+    in the right lattice position; inner joins carry the build side's
+    rows/NDV duplication so an over-threshold skewed build routes to
+    the partitioned hybrid join without a wasted unique-probe prep.
+    Runs LAST in optimize() — every other rule rebuilds nodes through
+    with_sources, which preserves the fields, but the estimates
+    themselves must see the final shape."""
+    new_sources = [annotate_adaptive_hints(s, ctx) for s in node.sources]
+    if not all(a is b for a, b in zip(new_sources, node.sources)):
+        node = node.with_sources(new_sources)
+    try:
+        if isinstance(node, AggregationNode) and node.group_by and \
+                node.step in (AggStep.SINGLE, AggStep.PARTIAL):
+            rows = ctx.stats.rows(node.source)
+            groups = ctx.stats.rows(node)
+            if rows and groups:
+                node = dataclasses.replace(
+                    node, rows_estimate=float(rows),
+                    ndv_estimate=float(groups))
+        elif isinstance(node, JoinNode) and node.criteria and \
+                node.kind == JoinKind.INNER:
+            brows = ctx.stats.rows(node.right)
+            ndvs = [ctx.stats.ndv(node.right, c.right.name)
+                    for c in node.criteria]
+            known = [n for n in ndvs if n]
+            if brows and known:
+                node = dataclasses.replace(
+                    node, build_skew_estimate=(
+                        float(brows) / max(min(known), 1.0)))
+    except Exception:
+        pass    # estimates are hints: a stats failure must not fail planning
+    return node
+
+
 def optimize(root: OutputNode, metadata: Metadata, session: Session,
              distributed: bool = False) -> OutputNode:
     from trino_tpu.planner.validator import validate_plan
@@ -1583,4 +1621,4 @@ def optimize(root: OutputNode, metadata: Metadata, session: Session,
     root = validate_plan(prune_unreferenced(root))
     if distributed:
         root = add_exchanges(root, ctx)
-    return root
+    return annotate_adaptive_hints(root, ctx)
