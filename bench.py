@@ -566,6 +566,115 @@ def run_mesh(out_path=None) -> None:
             f.write(line + "\n")
 
 
+def run_lake(out_path=None) -> None:
+    """`bench.py --lake [OUT.json]`: the data-plane report. CTAS a
+    TPC-H table into a PARTITIONED lake table (round-trip verified
+    against the generator connector), then measure the scan ladder the
+    lake round exists for:
+
+      cold    first scan — file reads + host->device staging
+      warm    repeated scan — scan-cache pages (device), staging = 0
+      cached  table-cache scan — HBM-resident columns, staging = 0
+
+    plus a selective pruned scan (files_pruned/row_groups_pruned > 0
+    proving partition + zone-map skips) and the INSERT-replay
+    exactly-once counter. Always emits its final JSON line."""
+    platform = _ensure_backend()
+    payload = {"metric": "lake_data_plane", "backend": platform}
+    try:
+        import trino_tpu
+        trino_tpu.enable_persistent_cache()
+        from trino_tpu.connector.lake import lake_stats
+        from trino_tpu.exec import LocalQueryRunner
+
+        schema = os.environ.get("TRINO_TPU_LAKE_SCHEMA", "tiny")
+        runner = LocalQueryRunner.tpch(schema)
+        payload["schema"] = schema
+        payload["format"] = runner.catalogs.get(
+            "lake")._metadata.default_format
+
+        t0 = time.perf_counter()
+        runner.execute(
+            "CREATE TABLE lake.default.orders_part "
+            "WITH (partitioned_by = 'o_orderstatus', "
+            "row_group_rows = 65536) AS SELECT * FROM orders")
+        payload["ctas_wall_s"] = round(time.perf_counter() - t0, 4)
+        src_rows = runner.execute(
+            "SELECT count(*) FROM orders").only_value()
+        lake_rows = runner.execute(
+            "SELECT count(*) FROM lake.default.orders_part").only_value()
+        payload["rows"] = int(lake_rows)
+        payload["roundtrip_ok"] = bool(lake_rows == src_rows)
+
+        scan = ("SELECT o_orderstatus, count(*), sum(o_totalprice) "
+                "FROM lake.default.orders_part GROUP BY o_orderstatus")
+        runner.session.set("scan_cache_enabled", True)
+        runner.session.set("table_cache_enabled", True)
+        runner.session.set("table_cache_min_scans", 2)
+
+        def timed(tag):
+            t0 = time.perf_counter()
+            rows = runner.execute(scan).rows
+            wall = time.perf_counter() - t0
+            st = runner.last_query_stats
+            payload[f"{tag}_wall_s"] = round(wall, 4)
+            payload[f"{tag}_staging_bytes"] = int(
+                st.get("scan_staging_bytes", 0))
+            payload[f"{tag}_table_cache_hits"] = int(
+                st.get("table_cache_hits", 0))
+            payload[f"{tag}_scan_cache_hits"] = int(
+                st.get("scan_cache_hits", 0))
+            return rows
+
+        cold = timed("cold")          # connector read + staging
+        warm = timed("warm")          # scan-cache pages + promotion
+        cached = timed("cached")      # HBM-resident columns
+        payload["scan_parity_ok"] = bool(
+            sorted(map(repr, cold)) == sorted(map(repr, warm))
+            == sorted(map(repr, cached)))
+        payload["cached_zero_staging"] = \
+            payload["cached_staging_bytes"] == 0 and \
+            payload["cached_table_cache_hits"] > 0
+
+        pruned = runner.execute(
+            "SELECT count(*) FROM lake.default.orders_part "
+            "WHERE o_orderstatus = 'F' AND o_orderkey < 1000")
+        st = runner.last_query_stats
+        payload["pruned_scan_rows"] = int(pruned.only_value())
+        payload["files_pruned"] = int(st.get("files_pruned", 0))
+        payload["row_groups_pruned"] = int(st.get("row_groups_pruned", 0))
+
+        replay_before = lake_stats()["replayed_commits"]
+        runner.session.set("fault_injection_rate", 0.5)
+        runner.session.set("fault_injection_seed", 1)
+        runner.session.set("fault_injection_sites", "fragment")
+        runner.session.set("retry_policy", "QUERY")
+        runner.session.set("retry_attempts", 5)
+        runner.execute("INSERT INTO lake.default.orders_part "
+                       "SELECT * FROM orders WHERE o_orderkey < 100")
+        insert_retries = int(runner.last_query_stats.get("retries", 0))
+        runner.session.set("fault_injection_rate", 0.0)
+        extra = runner.execute("SELECT count(*) FROM orders "
+                               "WHERE o_orderkey < 100").only_value()
+        after = runner.execute(
+            "SELECT count(*) FROM lake.default.orders_part").only_value()
+        payload["insert_retries"] = insert_retries
+        payload["insert_replays"] = \
+            lake_stats()["replayed_commits"] - replay_before
+        payload["insert_exactly_once"] = bool(
+            after == src_rows + extra)
+        payload["lake_counters"] = lake_stats()
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the line must print
+        payload["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+    line = json.dumps(payload)
+    print(line, flush=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+
+
 def run_qps(out_path=None) -> None:
     """`bench.py --qps [OUT.json]`: the closed-loop serving-tier QPS
     report (trino_tpu/serve/bench_serve.py) — N clients driving prepared
@@ -981,6 +1090,8 @@ if __name__ == "__main__":
         run_rung(sys.argv[2])
     elif len(sys.argv) >= 2 and sys.argv[1] == "--mesh":
         run_mesh(sys.argv[2] if len(sys.argv) >= 3 else None)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--lake":
+        run_lake(sys.argv[2] if len(sys.argv) >= 3 else None)
     elif len(sys.argv) >= 2 and sys.argv[1] == "--qps":
         run_qps(sys.argv[2] if len(sys.argv) >= 3 else None)
     elif len(sys.argv) >= 2 and sys.argv[1] == "--preempt":

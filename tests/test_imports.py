@@ -57,3 +57,74 @@ def test_module_imports_in_isolation(module):
             if name == "trino_tpu" or name.startswith("trino_tpu."):
                 del sys.modules[name]
         sys.modules.update(saved)
+
+
+def test_imports_without_pyarrow():
+    """pyarrow is STRICTLY optional: with its import blocked (the
+    no-pyarrow machine, simulated via sys.modules = None -> ImportError
+    on import), every module — the lake connector included — still
+    imports, and the lake falls back to the .npz native format."""
+    saved = {name: mod for name, mod in sys.modules.items()
+             if name == "trino_tpu" or name.startswith("trino_tpu.")}
+    arrow_saved = {name: mod for name, mod in sys.modules.items()
+                   if name == "pyarrow" or name.startswith("pyarrow.")}
+    for name in list(saved) + list(arrow_saved):
+        del sys.modules[name]
+    sys.modules["pyarrow"] = None   # import pyarrow -> ImportError
+    try:
+        fmt = importlib.import_module("trino_tpu.connector.lake.format")
+        assert fmt.HAVE_PYARROW is False
+        assert fmt.default_format() == "npz"
+        lake = importlib.import_module("trino_tpu.connector.lake")
+        assert lake.HAVE_PYARROW is False
+        # the rest of the engine imports clean without pyarrow too
+        importlib.import_module("trino_tpu.exec.runner")
+    finally:
+        for name in list(sys.modules):
+            if name == "trino_tpu" or name.startswith("trino_tpu.") \
+                    or name == "pyarrow" or name.startswith("pyarrow."):
+                del sys.modules[name]
+        sys.modules.update(saved)
+        sys.modules.update(arrow_saved)
+
+
+def test_lake_npz_works_without_pyarrow(tmp_path):
+    """Functional fallback proof (not just import hygiene): a connector
+    forced to the npz format writes/prunes/reads with pyarrow blocked —
+    tier-1 still collects AND the lake still serves on that machine."""
+    import numpy as np
+
+    from trino_tpu import types as T
+    from trino_tpu.connector.lake import format as F
+    from trino_tpu.predicate import Domain, Range, TupleDomain
+    real = F.HAVE_PYARROW
+    try:
+        F.HAVE_PYARROW = False
+        assert F.default_format() == "npz"
+        from trino_tpu.connector import lake
+        from trino_tpu.connector.spi import (ColumnMetadata,
+                                             SchemaTableName,
+                                             TableMetadata)
+        from trino_tpu.page import Column, Page
+        conn = lake.create_connector(str(tmp_path / "lk"))
+        name = SchemaTableName("default", "t")
+        conn.metadata.create_table(TableMetadata(
+            name, (ColumnMetadata("k", T.BIGINT),)))
+        h = conn.metadata.get_table_handle(name)
+        sink = conn.page_sink(h, write_token="w1")
+        sink.append_page(Page((Column.from_numpy(
+            np.arange(10, dtype=np.int64), T.BIGINT),), 10))
+        sink.finish()
+        total = sum(int(p.num_rows) for s in
+                    conn.split_manager.get_splits(h)
+                    for p in conn.page_source.pages(
+                        s, conn.metadata.get_column_handles(h), 16))
+        assert total == 10
+        kept, pruned = lake.eligible_files(
+            conn._metadata.load_manifest(name),
+            TupleDomain.with_column_domains(
+                {"k": Domain.from_range(T.BIGINT,
+                                        Range.greater_than(50))}))
+        assert kept == [] and pruned == 1
+    finally:
+        F.HAVE_PYARROW = real

@@ -207,3 +207,35 @@ else:
             assert_same(got.rows, local.execute(sql).rows, False)
         finally:
             dist.execute("RESET SESSION mesh_execution")
+
+    def test_mesh_table_cache_zero_staging(dist):
+        """The lake-round mesh acceptance: a CTAS'd lake table's first
+        mesh scan stages from the connector (and promotes the hot set);
+        the REPEATED mesh scan serves shard slices straight from the
+        HBM-resident columns — zero host->device staging bytes — while
+        the program's exchanges stay fused."""
+        dist.execute("CREATE TABLE lake.default.mesh_hot AS "
+                     "SELECT * FROM orders")
+        dist.execute("SET SESSION table_cache_enabled = true")
+        dist.execute("SET SESSION table_cache_min_scans = 1")
+        sql = ("SELECT o_orderstatus, count(*), sum(o_totalprice) "
+               "FROM lake.default.mesh_hot GROUP BY o_orderstatus")
+        try:
+            first = dist.execute(sql)
+            st1 = dist.last_query_stats
+            assert st1["mesh_devices"] == _REQUIRED_DEVICES, st1
+            assert st1["exchanges_fused"] > 0, st1
+            assert st1["scan_staging_bytes"] > 0, st1
+            second = dist.execute(sql)
+            st2 = dist.last_query_stats
+            assert st2["table_cache_hits"] >= 1, st2
+            assert st2["scan_staging_bytes"] == 0, st2
+            assert st2["exchanges_fused"] > 0, st2
+            assert_same(second.rows, first.rows, False)
+            expect = dist.execute(
+                "SELECT o_orderstatus, count(*), sum(o_totalprice) "
+                "FROM orders GROUP BY o_orderstatus")
+            assert_same(second.rows, expect.rows, False)
+        finally:
+            dist.execute("RESET SESSION table_cache_enabled")
+            dist.execute("DROP TABLE lake.default.mesh_hot")

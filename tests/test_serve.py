@@ -445,7 +445,8 @@ def test_system_runtime_caches_table():
     r = LocalQueryRunner.tpch("tiny")
     rows = r.execute("SELECT cache, entries, hits FROM "
                      "system.runtime.caches ORDER BY cache").rows
-    assert [row[0] for row in rows] == ["jit", "plan", "result", "scan"]
+    assert [row[0] for row in rows] == ["jit", "plan", "result", "scan",
+                                        "table"]
     by_name = {row[0]: row for row in rows}
     assert by_name["jit"][1] >= 0 and by_name["plan"][2] >= 0
 

@@ -1,0 +1,221 @@
+"""Connector SPI conformance: one parametrized pass over every built-in
+connector (BaseConnectorTest's capability-matrix pattern, SURVEY §4).
+
+Each connector declares its capabilities through the SPI itself
+(writes via page_sink, idempotent_writes, zone maps); the suite asserts
+the CONTRACTS every engine path relies on — metadata resolution,
+pages() framing, the applyFilter/applyLimit negotiation shape, and the
+staged write-token sink protocol (idempotence + abort) — uniformly, so
+a new connector that passes here plugs into scans, CTAS, retry, and
+caching without engine changes.
+"""
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.connector import blackhole, memory, tpch
+from trino_tpu.connector.spi import (ColumnMetadata, SchemaTableName,
+                                     TableMetadata)
+from trino_tpu.page import Column, Page
+from trino_tpu.predicate import Domain, Range, TupleDomain
+
+CONNECTORS = ["memory", "blackhole", "tpch", "lake"]
+
+
+@pytest.fixture(params=CONNECTORS)
+def conn(request, tmp_path):
+    if request.param == "memory":
+        return memory.create_connector()
+    if request.param == "blackhole":
+        return blackhole.create_connector()
+    if request.param == "tpch":
+        return tpch.create_connector()
+    from trino_tpu.connector import lake
+    return lake.create_connector(str(tmp_path / "lake"))
+
+
+def _supports_writes(conn) -> bool:
+    try:
+        conn.metadata.create_table(TableMetadata(
+            SchemaTableName("default", "_probe"),
+            (ColumnMetadata("x", T.BIGINT),)), ignore_existing=True)
+    except NotImplementedError:
+        return False
+    h = conn.metadata.get_table_handle(
+        SchemaTableName("default", "_probe"))
+    try:
+        conn.page_sink(h)
+    except NotImplementedError:
+        conn.metadata.drop_table(h)
+        return False
+    conn.metadata.drop_table(h)
+    return True
+
+
+def _a_table(conn) -> SchemaTableName:
+    """An existing table to scan: tpch ships its schema, writable
+    connectors get one created + populated."""
+    if conn.name == "tpch":
+        return SchemaTableName("tiny", "nation")
+    name = SchemaTableName("default", "conf_t")
+    conn.metadata.create_table(TableMetadata(
+        name, (ColumnMetadata("k", T.BIGINT),
+               ColumnMetadata("s", T.VarcharType(8)))),
+        ignore_existing=True)
+    h = conn.metadata.get_table_handle(name)
+    sink = conn.page_sink(h, write_token="conf-seed")
+    sink.append_page(Page((
+        Column.from_numpy(np.arange(100, dtype=np.int64), T.BIGINT),
+        Column.from_numpy(np.asarray(
+            [f"s{i % 7}" for i in range(100)], dtype=object),
+            T.VarcharType(8)),
+    ), 100))
+    sink.finish()
+    return name
+
+
+# ------------------------------------------------------------- metadata
+
+
+def test_metadata_listing(conn):
+    schemas = conn.metadata.list_schemas()
+    assert schemas == sorted(schemas) and len(schemas) >= 1
+    name = _a_table(conn)
+    tables = conn.metadata.list_tables(name.schema)
+    assert name in tables
+    h = conn.metadata.get_table_handle(name)
+    assert h is not None and h.name == name
+    meta = conn.metadata.get_table_metadata(h)
+    assert meta.name == name and len(meta.columns) >= 1
+    handles = conn.metadata.get_column_handles(h)
+    assert [c.name for c in handles] == [c.name for c in meta.columns]
+    assert [c.ordinal for c in handles] == list(range(len(handles)))
+    missing = conn.metadata.get_table_handle(
+        SchemaTableName("default", "definitely_not_here"))
+    assert missing is None
+
+
+def test_statistics_shape(conn):
+    name = _a_table(conn)
+    h = conn.metadata.get_table_handle(name)
+    stats = conn.metadata.get_table_statistics(h)
+    if stats.row_count is not None:
+        assert stats.row_count >= 0
+
+
+# ----------------------------------------------------------------- scans
+
+
+def test_pages_framing(conn):
+    """pages() yields Pages whose live count never exceeds the asked
+    capacity, totalling the table's rows, over every split."""
+    name = _a_table(conn)
+    h = conn.metadata.get_table_handle(name)
+    cols = conn.metadata.get_column_handles(h)
+    splits = conn.split_manager.get_splits(h, target_splits=4)
+    assert len(splits) >= 1
+    assert all(s.total_parts == splits[0].total_parts for s in splits)
+    assert sorted(s.part for s in splits) == list(range(len(splits)))
+    total = 0
+    for s in splits:
+        for page in conn.page_source.pages(s, cols, 64):
+            n = int(page.num_rows)
+            assert 0 <= n <= 64
+            assert page.num_columns == len(cols)
+            total += n
+    stats = conn.metadata.get_table_statistics(h)
+    if conn.name == "blackhole":
+        assert total == 0      # blackhole swallows
+    elif stats.row_count:
+        assert total == int(stats.row_count)
+
+
+def test_apply_filter_contract(conn):
+    """applyFilter returns None or (new handle, remaining domain); a
+    constrained handle's scan stays a SUPERSET of the matching rows
+    (domains are pruning hints — the engine re-applies row-wise)."""
+    name = _a_table(conn)
+    h = conn.metadata.get_table_handle(name)
+    cols = conn.metadata.get_column_handles(h)
+    key = cols[0]
+    td = TupleDomain.with_column_domains(
+        {key.name: Domain.from_range(key.type, Range.less_equal(10))})
+    result = conn.metadata.apply_filter(h, td)
+    if result is None:
+        return      # connector opted out — engine filters row-wise
+    new_handle, _remaining = result
+    assert new_handle.name == name
+    matching = set()
+    for s in conn.split_manager.get_splits(h, target_splits=2):
+        for page in conn.page_source.pages(s, [key], 256):
+            vals = page.column(0).to_numpy(int(page.num_rows))
+            matching.update(v for v in vals
+                            if v is not None and v <= 10)
+    got = set()
+    for s in conn.split_manager.get_splits(new_handle, target_splits=2):
+        for page in conn.page_source.pages(s, [key], 256):
+            vals = page.column(0).to_numpy(int(page.num_rows))
+            got.update(v for v in vals if v is not None)
+    assert matching <= got, "pruned scan dropped matching rows"
+
+
+def test_apply_limit_contract(conn):
+    name = _a_table(conn)
+    h = conn.metadata.get_table_handle(name)
+    out = conn.metadata.apply_limit(h, 5)
+    if out is None:
+        return
+    assert out.limit == 5
+    # tightening is monotone: a larger limit on an already-tighter
+    # handle is a no-op
+    assert conn.metadata.apply_limit(out, 10) is None
+
+
+# ----------------------------------------------------------------- sinks
+
+
+def test_sink_token_idempotence_and_abort(conn):
+    """The staged write-token protocol every idempotent_writes
+    connector must honor: same token commits ONCE; abort() leaves the
+    target untouched; tokenless sinks keep legacy semantics."""
+    if not _supports_writes(conn):
+        with pytest.raises(NotImplementedError):
+            conn.page_sink(conn.metadata.get_table_handle(_a_table(conn)))
+        return
+    name = SchemaTableName("default", "conf_sink")
+    conn.metadata.create_table(TableMetadata(
+        name, (ColumnMetadata("x", T.BIGINT),)), ignore_existing=True)
+    h = conn.metadata.get_table_handle(name)
+    page = Page((Column.from_numpy(
+        np.arange(7, dtype=np.int64), T.BIGINT),), 7)
+
+    def rows_now() -> int:
+        if conn.name == "blackhole":
+            return conn._metadata.rows_written
+        total = 0
+        for s in conn.split_manager.get_splits(h, target_splits=1):
+            for p in conn.page_source.pages(
+                    s, conn.metadata.get_column_handles(h), 64):
+                total += int(p.num_rows)
+        return total
+
+    base = rows_now()
+    assert conn.idempotent_writes, \
+        "every writable built-in declares the staged-token protocol"
+    # two attempts, ONE token -> exactly one commit
+    for _ in range(2):
+        sink = conn.page_sink(h, write_token="conf-tok")
+        sink.append_page(page)
+        sink.finish()
+    assert rows_now() == base + 7
+    # abort drops the staging
+    sink = conn.page_sink(h, write_token="conf-abort")
+    sink.append_page(page)
+    sink.abort()
+    assert rows_now() == base + 7
+    # a fresh token commits again
+    sink = conn.page_sink(h, write_token="conf-tok-2")
+    sink.append_page(page)
+    sink.finish()
+    assert rows_now() == base + 14

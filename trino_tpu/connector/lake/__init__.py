@@ -1,0 +1,14 @@
+"""Lake connector package: file-based columnar tables behind the SPI.
+
+`create_connector()` builds a catalog rooted at $TRINO_TPU_LAKE_DIR (or
+a per-process temp directory); see connector.py for the manifest/commit
+model and format.py for the parquet/npz codecs (pyarrow is strictly
+optional — the .npz native format is the dependency-free fallback).
+"""
+
+from trino_tpu.connector.lake.connector import (  # noqa: F401
+    LakeConnector, LakeMetadata, LakePageSink, LakePageSource,
+    LakeSplitManager, create_connector, eligible_files, eligible_groups,
+    lake_stats, take_scan_stats)
+from trino_tpu.connector.lake.format import (  # noqa: F401
+    HAVE_PYARROW, default_format)

@@ -1,0 +1,701 @@
+"""Lake connector: directory-backed columnar tables behind the SPI.
+
+Reference parity: plugin/trino-hive / plugin/trino-iceberg collapsed to
+the single-node case — a catalog rooted at a base directory, one
+directory per table holding immutable columnar data files plus a JSON
+MANIFEST that is the single source of truth (Iceberg's metadata
+pointer). Everything transactional goes through the manifest:
+
+  - COMMIT IS AN ATOMIC MANIFEST SWAP (write tmp + os.replace): readers
+    see the old file list or the new one, never a torn state.
+  - The idempotent staged-write-token protocol (PR 8's sink contract):
+    a sink stages rows host-side, writes data files under unique names
+    at finish(), and appends them to the manifest ONLY if its token has
+    not already committed — a replayed INSERT/CTAS attempt (QUERY-level
+    retry) deletes its freshly-written orphans and no-ops, so writes
+    are exactly-once on files too. abort() deletes the attempt's files.
+  - Partitioned tables (CREATE ... WITH (partitioned_by = 'a,b')) split
+    each commit's rows by partition value into one file per partition —
+    a selective predicate then prunes whole files.
+
+Pruning: every data file carries per-row-group min/max/null-count zone
+maps in the manifest. `eligible_files` / `eligible_groups` evaluate the
+scan's TupleDomain (static pushdown AND join dynamic filters — the
+engine augments the handle's constraint at iteration time) against the
+zones; skipped files/groups count into process counters plus a
+thread-local the executor drains into the query's stats
+(`files_pruned` / `row_groups_pruned`).
+
+Split model: splits index the PRUNED file list (recomputed
+deterministically from (manifest, constraint) on both the split-manager
+and page-source sides — stateless like every other connector here);
+split p of n reads files p, p+n, p+2n, ...
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import shutil
+import threading
+import uuid
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.connector.lake import format as F
+from trino_tpu.connector.spi import (
+    ColumnHandle, ColumnMetadata, ColumnStatistics, Connector,
+    ConnectorMetadata, ConnectorPageSink, ConnectorPageSource,
+    ConnectorSplitManager, ConnectorTableHandle, SchemaTableName, Split,
+    TableMetadata, TableStatistics, WriteTokenLedger, pad_to_capacity)
+from trino_tpu.page import Column, Dictionary, Page
+from trino_tpu.predicate import TupleDomain
+
+MANIFEST = "manifest.json"
+DATA_DIR = "data"
+_MAX_MANIFEST_TOKENS = 512
+
+# process-lifetime counters (obs/metrics.py gauges sample these)
+LAKE_STATS = {
+    "files_written": 0, "files_scanned": 0, "files_pruned": 0,
+    "row_groups_scanned": 0, "row_groups_pruned": 0,
+    "manifest_commits": 0, "replayed_commits": 0, "aborted_writes": 0,
+}
+_STATS_LOCK = threading.Lock()
+
+# per-scan counters the executing query's thread accumulates across
+# get_splits + pages() and the executor drains into its collector
+# (Connector.take_scan_stats) — thread-local because concurrent queries
+# scan on their own executor threads
+_TLS = threading.local()
+
+
+def _count(name: str, n: int = 1) -> None:
+    if n:
+        with _STATS_LOCK:
+            LAKE_STATS[name] += n
+        d = getattr(_TLS, "scan", None)
+        if d is not None:
+            d[name] = d.get(name, 0) + n
+
+
+def _begin_scan_stats() -> None:
+    if getattr(_TLS, "scan", None) is None:
+        _TLS.scan = {}
+
+
+def take_scan_stats() -> Dict[str, int]:
+    """Drain this thread's accumulated scan counters (the executor calls
+    this once per finished scan and folds them into the query stats)."""
+    d = getattr(_TLS, "scan", None) or {}
+    _TLS.scan = None
+    return d
+
+
+def lake_stats() -> Dict[str, int]:
+    with _STATS_LOCK:
+        return dict(LAKE_STATS)
+
+
+# ------------------------------------------------------------ zone pruning
+
+
+def _zone_matches(domain, zone: dict) -> bool:
+    """May any row of a chunk with this zone satisfy the domain?
+    Conservative: missing zones never prune."""
+    if zone is None:
+        return True
+    lo, hi = zone.get("min"), zone.get("max")
+    if lo is None or hi is None:
+        # value-free chunk (all null): only a null-admitting domain matches
+        return bool(domain.null_allowed) or zone.get("nulls", 0) == 0
+    return domain.overlaps_range(lo, hi)
+
+
+def _chunk_matches(constraint: TupleDomain, zones: dict) -> bool:
+    if constraint.is_none():
+        return False
+    if constraint.is_all() or not zones:
+        return True
+    for col, domain in constraint.domains.items():
+        if not _zone_matches(domain, zones.get(col)):
+            return False
+    return True
+
+
+def eligible_files(manifest: dict, constraint: TupleDomain
+                   ) -> Tuple[List[dict], int]:
+    """(kept file entries, pruned count) — deterministic from the
+    manifest + constraint, shared by split manager and page source."""
+    kept, pruned = [], 0
+    for entry in manifest.get("files", ()):
+        if _chunk_matches(constraint, entry.get("file_zones") or {}):
+            kept.append(entry)
+        else:
+            pruned += 1
+    return kept, pruned
+
+
+def eligible_groups(entry: dict, constraint: TupleDomain
+                    ) -> Tuple[List[int], int]:
+    groups = entry.get("groups") or []
+    kept, pruned = [], 0
+    for g, grp in enumerate(groups):
+        if _chunk_matches(constraint, grp.get("zones") or {}):
+            kept.append(g)
+        else:
+            pruned += 1
+    return kept, pruned
+
+
+def _file_zones(groups: List[dict], names: Sequence[str]) -> dict:
+    """Fold per-group zones into one per-file zone map."""
+    out = {}
+    for name in names:
+        lo = hi = None
+        nulls = 0
+        for grp in groups:
+            z = (grp.get("zones") or {}).get(name)
+            if z is None:
+                return {}
+            nulls += int(z.get("nulls", 0))
+            if z["min"] is None:
+                continue
+            lo = z["min"] if lo is None else min(lo, z["min"])
+            hi = z["max"] if hi is None else max(hi, z["max"])
+        out[name] = {"min": lo, "max": hi, "nulls": nulls}
+    return out
+
+
+# --------------------------------------------------------------- metadata
+
+
+class LakeMetadata(ConnectorMetadata):
+    """Manifest-backed metadata. The manifest cache is keyed on the file
+    mtime+size so an external writer (another process sharing the
+    directory) is picked up without explicit invalidation."""
+
+    # the engine consults zone maps / constraint pruning for this
+    # connector (gates the dynamic-filter handle augmentation too)
+    supports_zone_maps = True
+
+    def __init__(self, base_dir: str, fmt: Optional[str] = None):
+        self.base_dir = os.path.abspath(base_dir)
+        os.makedirs(self.base_dir, exist_ok=True)
+        self.default_format = F.validate_format(fmt) if fmt \
+            else F.default_format()
+        self._lock = threading.RLock()
+        self._cache: Dict[SchemaTableName, Tuple[tuple, dict]] = {}
+        # per-(table, manifest version, column) string pools: every page
+        # of a scan encodes onto ONE sorted pool (stable codes across
+        # files — the same table-level dictionary discipline as the
+        # memory connector)
+        self._dicts: Dict[tuple, Dictionary] = {}
+
+    # ------------------------------------------------------------ layout
+
+    def table_dir(self, name: SchemaTableName) -> str:
+        return os.path.join(self.base_dir, name.schema, name.table)
+
+    def _manifest_path(self, name: SchemaTableName) -> str:
+        return os.path.join(self.table_dir(name), MANIFEST)
+
+    def load_manifest(self, name: SchemaTableName) -> Optional[dict]:
+        path = self._manifest_path(name)
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        stamp = (st.st_mtime_ns, st.st_size)
+        with self._lock:
+            hit = self._cache.get(name)
+            if hit is not None and hit[0] == stamp:
+                return hit[1]
+        with open(path) as f:
+            manifest = json.load(f)
+        with self._lock:
+            self._cache[name] = (stamp, manifest)
+        return manifest
+
+    def _require(self, name: SchemaTableName) -> dict:
+        manifest = self.load_manifest(name)
+        if manifest is None:
+            raise KeyError(f"lake table not found: {name}")
+        return manifest
+
+    def _swap_manifest(self, name: SchemaTableName, manifest: dict) -> None:
+        """COMMIT: write tmp + os.replace — the atomic rename is the
+        whole transaction (readers see old or new, never torn)."""
+        path = self._manifest_path(name)
+        tmp = f"{path}.tmp.{uuid.uuid4().hex[:8]}"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, path)
+        with self._lock:
+            self._cache.pop(name, None)
+
+    # ----------------------------------------------------------- listing
+
+    def list_schemas(self) -> List[str]:
+        out = {"default"}
+        try:
+            for entry in os.scandir(self.base_dir):
+                if entry.is_dir():
+                    out.add(entry.name)
+        except OSError:
+            pass
+        return sorted(out)
+
+    def list_tables(self, schema: Optional[str] = None
+                    ) -> List[SchemaTableName]:
+        schemas = [schema] if schema else self.list_schemas()
+        out = []
+        for s in schemas:
+            sdir = os.path.join(self.base_dir, s)
+            try:
+                entries = list(os.scandir(sdir))
+            except OSError:
+                continue
+            for entry in entries:
+                if entry.is_dir() and os.path.exists(
+                        os.path.join(entry.path, MANIFEST)):
+                    out.append(SchemaTableName(s, entry.name))
+        return sorted(out, key=lambda n: (n.schema, n.table))
+
+    def get_table_handle(self, name: SchemaTableName
+                         ) -> Optional[ConnectorTableHandle]:
+        if self.load_manifest(name) is None:
+            return None
+        return ConnectorTableHandle(name)
+
+    def get_table_metadata(self, handle: ConnectorTableHandle
+                           ) -> TableMetadata:
+        m = self._require(handle.name)
+        cols = tuple(ColumnMetadata(c["name"], T.parse_type(c["type"]))
+                     for c in m["columns"])
+        return TableMetadata(handle.name, cols)
+
+    def partition_columns(self, name: SchemaTableName) -> List[str]:
+        return list(self._require(name).get("partition_by") or [])
+
+    def get_table_statistics(self, handle: ConnectorTableHandle
+                             ) -> TableStatistics:
+        m = self.load_manifest(handle.name)
+        if m is None:
+            return TableStatistics.unknown()
+        rows = float(sum(int(e["rows"]) for e in m.get("files", ())))
+        cols: Dict[str, ColumnStatistics] = {}
+        for c in m["columns"]:
+            name = c["name"]
+            lo = hi = None
+            nulls = 0
+            known = True
+            for e in m.get("files", ()):
+                z = (e.get("file_zones") or {}).get(name)
+                if z is None:
+                    known = False
+                    break
+                nulls += int(z.get("nulls", 0))
+                if z["min"] is not None:
+                    lo = z["min"] if lo is None else min(lo, z["min"])
+                    hi = z["max"] if hi is None else max(hi, z["max"])
+            if known and rows:
+                cols[name] = ColumnStatistics(
+                    null_fraction=nulls / rows,
+                    min_value=lo, max_value=hi)
+            else:
+                cols[name] = ColumnStatistics()
+        return TableStatistics(rows, cols)
+
+    # ----------------------------------------------------------- pushdown
+
+    def apply_filter(self, handle: ConnectorTableHandle,
+                     constraint: TupleDomain):
+        # accept the domain as the file/row-group pruning hint; the
+        # engine still applies the predicate row-wise (SPI contract)
+        merged = handle.constraint.intersect(constraint)
+        return (ConnectorTableHandle(handle.name, merged, handle.limit),
+                constraint)
+
+    def apply_limit(self, handle: ConnectorTableHandle, limit: int):
+        if handle.limit is not None and handle.limit <= limit:
+            return None
+        return ConnectorTableHandle(handle.name, handle.constraint, limit)
+
+    # -------------------------------------------------------------- DDL
+
+    def create_table(self, metadata: TableMetadata,
+                     ignore_existing: bool = False):
+        props = dict(metadata.properties or ())
+        partition_by = props.pop("partitioned_by", "") or ""
+        fmt = props.pop("format", None)
+        group_rows = int(props.pop("row_group_rows",
+                                   F.DEFAULT_ROW_GROUP_ROWS))
+        if group_rows <= 0:
+            raise ValueError("row_group_rows must be positive")
+        if props:
+            raise ValueError(
+                f"unknown lake table properties: {sorted(props)} "
+                "(supported: partitioned_by, format, row_group_rows)")
+        fmt = F.validate_format(fmt) if fmt else self.default_format
+        part_cols = [c.strip() for c in str(partition_by).split(",")
+                     if c.strip()]
+        names = {c.name for c in metadata.columns}
+        for pc in part_cols:
+            if pc not in names:
+                raise ValueError(
+                    f"partitioned_by column not in table: {pc}")
+        with self._lock:
+            if self.load_manifest(metadata.name) is not None:
+                if ignore_existing:
+                    return
+                raise ValueError(
+                    f"table already exists: {metadata.name}")
+            os.makedirs(os.path.join(self.table_dir(metadata.name),
+                                     DATA_DIR), exist_ok=True)
+            self._swap_manifest(metadata.name, {
+                "version": 1,
+                "format": fmt,
+                "row_group_rows": group_rows,
+                "columns": [{"name": c.name, "type": c.type.display()}
+                            for c in metadata.columns],
+                "partition_by": part_cols,
+                "files": [],
+                "committed_tokens": [],
+            })
+
+    def drop_table(self, handle: ConnectorTableHandle):
+        with self._lock:
+            shutil.rmtree(self.table_dir(handle.name), ignore_errors=True)
+            self._cache.pop(handle.name, None)
+            sdir = os.path.join(self.base_dir, handle.name.schema)
+            try:  # prune an emptied schema dir (best effort)
+                if not os.listdir(sdir):
+                    os.rmdir(sdir)
+            except OSError:
+                pass
+
+    # ------------------------------------------------------- dictionaries
+
+    def table_dictionary(self, name: SchemaTableName, column: str,
+                         manifest: dict) -> Dictionary:
+        """One sorted string pool per (table, manifest version, column):
+        built from the union of every file's values on first use, so
+        codes are stable across files and pages (shared-dictionary
+        kernels see ONE pool per scan)."""
+        key = (name, int(manifest.get("version", 0)), column)
+        with self._lock:
+            d = self._dicts.get(key)
+        if d is not None:
+            return d
+        fmt = manifest["format"]
+        group_rows = int(manifest.get("row_group_rows",
+                                      F.DEFAULT_ROW_GROUP_ROWS))
+        all_names = [c["name"] for c in manifest["columns"]]
+        values: List[np.ndarray] = []
+        tdir = self.table_dir(name)
+        for entry in manifest.get("files", ()):
+            ngroups = len(entry.get("groups") or [])
+            if ngroups == 0:
+                continue
+            got = F.read_groups(os.path.join(tdir, entry["path"]), fmt,
+                                all_names, [column], list(range(ngroups)),
+                                group_rows=group_rows)
+            arr, valid = got[column]
+            arr = np.asarray(arr, dtype=object)
+            if valid is not None:
+                arr = arr[np.asarray(valid, dtype=bool)]
+            values.append(arr)
+        pool = np.unique(np.concatenate(values)) if values \
+            else np.empty(0, dtype=object)
+        d = Dictionary(np.asarray(pool, dtype=object))
+        with self._lock:
+            # keep only the current version's pools (old versions died
+            # with their manifest)
+            self._dicts = {k: v for k, v in self._dicts.items()
+                           if k[0] != name or k[1] == key[1]}
+            self._dicts[key] = d
+        return d
+
+
+# ------------------------------------------------------------------ splits
+
+
+class LakeSplitManager(ConnectorSplitManager):
+    def __init__(self, metadata: LakeMetadata):
+        self._metadata = metadata
+
+    def get_splits(self, handle: ConnectorTableHandle,
+                   target_splits: int = 1) -> List[Split]:
+        _begin_scan_stats()
+        manifest = self._metadata._require(handle.name)
+        kept, pruned = eligible_files(manifest, handle.constraint)
+        _count("files_pruned", pruned)
+        parts = max(1, min(max(target_splits, 1), max(len(kept), 1)))
+        # the manifest SNAPSHOT rides on every split: all splits of one
+        # query read the same committed version even if a concurrent
+        # write swaps the manifest mid-query (old-or-new, never torn)
+        return [Split(handle, p, parts, host=p, context=manifest)
+                for p in range(parts)]
+
+
+# ------------------------------------------------------------------- scan
+
+
+class LakePageSource(ConnectorPageSource):
+    def __init__(self, metadata: LakeMetadata):
+        self._metadata = metadata
+
+    def pages(self, split: Split, columns: Sequence[ColumnHandle],
+              page_capacity: int) -> Iterator[Page]:
+        _begin_scan_stats()
+        md = self._metadata
+        name = split.table.name
+        # read the split-time manifest snapshot: a commit between
+        # get_splits and pages() must not tear this query's file list
+        manifest = split.context if isinstance(split.context, dict) \
+            else md._require(name)
+        fmt = manifest["format"]
+        group_rows = int(manifest.get("row_group_rows",
+                                      F.DEFAULT_ROW_GROUP_ROWS))
+        all_names = [c["name"] for c in manifest["columns"]]
+        tdir = md.table_dir(name)
+        kept, _ = eligible_files(manifest, split.table.constraint)
+        mine = kept[split.part::split.total_parts]
+        limit = split.table.limit
+        emitted = 0
+        for entry in mine:
+            groups, pruned = eligible_groups(entry, split.table.constraint)
+            _count("row_groups_pruned", pruned)
+            if not groups:
+                continue
+            _count("files_scanned")
+            _count("row_groups_scanned", len(groups))
+            got = F.read_groups(os.path.join(tdir, entry["path"]), fmt,
+                                all_names, [c.name for c in columns],
+                                groups, group_rows=group_rows)
+            arrays = [got[c.name] for c in columns]
+            rows = len(arrays[0][0]) if arrays else 0
+            off = 0
+            while off < rows:
+                hi = min(off + page_capacity, rows)
+                n = hi - off
+                cols = []
+                for ch, (arr, valid) in zip(columns, arrays):
+                    v = None
+                    if valid is not None:
+                        v = pad_to_capacity(
+                            np.asarray(valid[off:hi], dtype=bool),
+                            page_capacity, False)
+                    if T.is_string(ch.type):
+                        d = md.table_dictionary(name, ch.name, manifest)
+                        if len(d) == 0:
+                            # every value null: the pool is empty, so
+                            # emit the reserved null/padding code -1
+                            # (decode maps it to None)
+                            codes = np.full(page_capacity, -1,
+                                            dtype=np.int32)
+                        else:
+                            raw = np.asarray(arr[off:hi], dtype=object)
+                            if v is not None:
+                                raw = np.where(
+                                    np.asarray(valid[off:hi],
+                                               dtype=bool),
+                                    raw, d.values[0])
+                            codes = pad_to_capacity(d.encode(raw),
+                                                    page_capacity, 0)
+                        cols.append(Column.from_numpy(codes, ch.type, v,
+                                                      d))
+                    else:
+                        vals = pad_to_capacity(
+                            np.asarray(arr[off:hi],
+                                       T.to_numpy_dtype(ch.type)),
+                            page_capacity, 0)
+                        cols.append(Column.from_numpy(vals, ch.type, v))
+                yield Page(tuple(cols), n)
+                emitted += n
+                if limit is not None and emitted >= limit:
+                    return
+                off = hi
+
+
+# ------------------------------------------------------------------- sink
+
+
+class LakePageSink(ConnectorPageSink):
+    """Staged, token-deduplicated file sink: appended pages decode to
+    host column chunks; finish() writes one data file per partition
+    group under unique names and commits them with ONE atomic manifest
+    swap — once per write token, so a replayed attempt deletes its
+    orphans and no-ops (exactly-once INSERT/CTAS under QUERY retry)."""
+
+    def __init__(self, metadata: LakeMetadata, name: SchemaTableName,
+                 write_token: Optional[str] = None):
+        self._metadata = metadata
+        self._name = name
+        self._token = write_token
+        manifest = metadata._require(name)
+        self._types = [T.parse_type(c["type"]) for c in manifest["columns"]]
+        self._names = [c["name"] for c in manifest["columns"]]
+        self._part_cols = [self._names.index(p)
+                           for p in manifest.get("partition_by") or []]
+        self._fmt = manifest["format"]
+        self._group_rows = int(manifest.get("row_group_rows",
+                                            F.DEFAULT_ROW_GROUP_ROWS))
+        self._staged: List[List] = [[] for _ in self._types]
+        self._written: List[str] = []
+
+    def append_page(self, page: Page):
+        n = int(page.num_rows)
+        if n == 0:
+            return
+        for i, col in enumerate(page.columns):
+            vals = col.to_numpy(n)   # decoded objects incl. None
+            typ = self._types[i]
+            nulls = np.array([v is None for v in vals], dtype=bool)
+            if T.is_string(typ):
+                filled = np.asarray(
+                    ["" if v is None else v for v in vals], dtype=object)
+            else:
+                filled = np.asarray(
+                    [0 if v is None else v for v in vals],
+                    dtype=T.to_numpy_dtype(typ))
+            self._staged[i].append((filled, nulls))
+
+    def _partition_groups(self, arrays, valids) -> List[Tuple[dict, object]]:
+        """[(partition value dict, row-index array)] — one data file per
+        distinct partition tuple; unpartitioned tables are one group."""
+        rows = len(arrays[0]) if arrays else 0
+        if not self._part_cols or rows == 0:
+            return [({}, None)]
+        keys = list(zip(*[
+            [None if (valids[c] is not None and not valids[c][r])
+             else arrays[c][r] for r in range(rows)]
+            for c in self._part_cols]))
+        by_key: Dict[tuple, list] = {}
+        for r, k in enumerate(keys):
+            by_key.setdefault(k, []).append(r)
+        out = []
+        for k in sorted(by_key, key=lambda t: tuple(
+                (v is None, v) for v in t)):
+            pv = {self._names[c]: F._json_scalar(v)
+                  for c, v in zip(self._part_cols, k)}
+            out.append((pv, np.asarray(by_key[k], dtype=np.int64)))
+        return out
+
+    def finish(self):
+        md = self._metadata
+        staged, self._staged = self._staged, [[] for _ in self._types]
+        arrays: List[np.ndarray] = []
+        valids: List[Optional[np.ndarray]] = []
+        rows = 0
+        for i, chunks in enumerate(staged):
+            if not chunks:
+                arrays.append(np.empty(0, dtype=object
+                                       if T.is_string(self._types[i])
+                                       else T.to_numpy_dtype(
+                                           self._types[i])))
+                valids.append(None)
+                continue
+            arrays.append(np.concatenate([c[0] for c in chunks]))
+            nulls = np.concatenate([c[1] for c in chunks])
+            valids.append(~nulls if nulls.any() else None)
+            rows = len(arrays[-1])
+        tdir = md.table_dir(self._name)
+        entries: List[dict] = []
+        if rows:
+            for pv, idx in self._partition_groups(arrays, valids):
+                parrs = arrays if idx is None else [a[idx] for a in arrays]
+                pvals = valids if idx is None else \
+                    [None if v is None else v[idx] for v in valids]
+                fname = (f"{DATA_DIR}/{self._token or 'w'}-"
+                         f"{uuid.uuid4().hex[:12]}"
+                         f"{F.file_extension(self._fmt)}")
+                path = os.path.join(tdir, fname)
+                nrows = F.write_file(path, self._fmt, self._names, parrs,
+                                     pvals, group_rows=self._group_rows)
+                self._written.append(path)
+                groups = F.build_zones(self._names, parrs, pvals,
+                                       group_rows=self._group_rows)
+                entries.append({
+                    "path": fname, "rows": nrows,
+                    "partition": pv,
+                    "file_zones": _file_zones(groups, self._names),
+                    "groups": groups,
+                })
+        with md._lock:
+            manifest = md._require(self._name)
+            tokens = list(manifest.get("committed_tokens") or [])
+            if self._token is not None and self._token in tokens:
+                # an earlier attempt already committed: replay no-op —
+                # this attempt's freshly-written files are orphans
+                for p in self._written:
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+                self._written = []
+                _count("replayed_commits")
+                return
+            manifest = dict(manifest)
+            manifest["files"] = list(manifest.get("files") or []) + entries
+            if self._token is not None:
+                tokens.append(self._token)
+                manifest["committed_tokens"] = \
+                    tokens[-_MAX_MANIFEST_TOKENS:]
+            manifest["version"] = int(manifest.get("version", 0)) + 1
+            md._swap_manifest(self._name, manifest)
+        self._written = []
+        _count("manifest_commits")
+        _count("files_written", len(entries))
+
+    def abort(self):
+        self._staged = [[] for _ in self._types]
+        for p in self._written:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+        if self._written:
+            _count("aborted_writes")
+        self._written = []
+
+
+# -------------------------------------------------------------- connector
+
+
+class LakeConnector(Connector):
+    # staged write-token sink + manifest-swap commit: the engine may
+    # retry writes here — chaos included — without double-write risk
+    idempotent_writes = True
+
+    def __init__(self, base_dir: str, fmt: Optional[str] = None):
+        metadata = LakeMetadata(base_dir, fmt)
+        super().__init__("lake", metadata, LakeSplitManager(metadata),
+                         LakePageSource(metadata))
+        self._metadata = metadata
+
+    def page_sink(self, handle: ConnectorTableHandle,
+                  write_token: Optional[str] = None) -> ConnectorPageSink:
+        return LakePageSink(self._metadata, handle.name, write_token)
+
+    # the executor drains per-scan prune counters through this hook
+    # (thread-local: the scan ran on the caller's thread)
+    @staticmethod
+    def take_scan_stats() -> Dict[str, int]:
+        return take_scan_stats()
+
+
+def create_connector(base_dir: Optional[str] = None,
+                     fmt: Optional[str] = None) -> LakeConnector:
+    """Lake catalog rooted at `base_dir` ($TRINO_TPU_LAKE_DIR, else a
+    fresh per-process temp directory — the dev/test default)."""
+    if base_dir is None:
+        base_dir = os.environ.get("TRINO_TPU_LAKE_DIR")
+    if base_dir is None:
+        import tempfile
+        base_dir = tempfile.mkdtemp(prefix="trino_tpu_lake_")
+    return LakeConnector(base_dir, fmt)

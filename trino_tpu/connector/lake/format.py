@@ -1,0 +1,251 @@
+"""Lake file formats: columnar data files + per-row-group zone maps.
+
+Two codecs behind one read/write interface:
+
+  parquet  pyarrow Parquet files written with a fixed row-group size, so
+           the manifest's row-group boundaries match the physical layout
+           and a pruned group is a SKIPPED READ (ParquetFile
+           .read_row_group), not a post-read slice. Primitive columns
+           without nulls come back through the dlpack/buffer protocol as
+           zero-copy numpy views where pyarrow supports it.
+  npz      pure-numpy native fallback (np.savez_compressed, no pickle:
+           strings store as fixed-width unicode arrays, nulls as bool
+           masks) so the lake connector works on a machine WITHOUT
+           pyarrow. Row groups are manifest row ranges sliced after one
+           file read — pruning still skips device staging and kernel
+           work, just not host I/O.
+
+pyarrow is a strictly optional dependency: this module imports without
+it (HAVE_PYARROW gates the parquet paths) and `default_format()` picks
+the richest codec available.
+
+Values are stored in the engine's RAW internal representation (dates as
+int32 days, decimals/timestamps as scaled int64, booleans as bool) —
+the manifest's type strings govern interpretation, so the reader never
+re-derives semantics from the file dtype.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:  # strictly optional: the lake falls back to the .npz native format
+    import pyarrow as _pa
+    import pyarrow.parquet as _pq
+    HAVE_PYARROW = True
+except Exception:  # pragma: no cover - exercised via sys.modules blocking
+    _pa = None
+    _pq = None
+    HAVE_PYARROW = False
+
+# rows per row group (and per parquet physical row group): small enough
+# that a selective predicate skips real work, large enough that group
+# bookkeeping stays negligible against scan pages
+DEFAULT_ROW_GROUP_ROWS = 1 << 16
+
+_EXT = {"parquet": ".parquet", "npz": ".npz"}
+
+
+def default_format() -> str:
+    return "parquet" if HAVE_PYARROW else "npz"
+
+
+def file_extension(fmt: str) -> str:
+    return _EXT[fmt]
+
+
+def validate_format(fmt: str) -> str:
+    fmt = str(fmt).lower()
+    if fmt not in _EXT:
+        raise ValueError(f"unknown lake format: {fmt!r} "
+                         f"(expected one of {sorted(_EXT)})")
+    if fmt == "parquet" and not HAVE_PYARROW:
+        raise ValueError("lake format 'parquet' requires pyarrow; "
+                         "install it or use format 'npz'")
+    return fmt
+
+
+def _json_scalar(v):
+    """Zone values must serialize: numpy scalars -> python."""
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, (np.bool_,)):
+        return bool(v)
+    return v
+
+
+def column_zone(arr: np.ndarray, valid: Optional[np.ndarray]) -> dict:
+    """min/max/null-count over the VALID rows of one column chunk (the
+    per-file / per-row-group zone-map entry). All-null chunks carry
+    min/max None — the pruner treats them as value-free."""
+    n = len(arr)
+    if valid is None:
+        live = arr
+        nulls = 0
+    else:
+        live = arr[np.asarray(valid, dtype=bool)]
+        nulls = int(n - len(live))
+    if len(live) == 0:
+        return {"min": None, "max": None, "nulls": nulls}
+    if arr.dtype.kind in ("U", "S", "O"):
+        lo, hi = str(min(live)), str(max(live))
+    else:
+        lo, hi = _json_scalar(live.min()), _json_scalar(live.max())
+    return {"min": lo, "max": hi, "nulls": nulls}
+
+
+def group_ranges(rows: int,
+                 group_rows: int = DEFAULT_ROW_GROUP_ROWS
+                 ) -> List[Tuple[int, int]]:
+    """Row-group [start, end) boundaries for a file of `rows` rows."""
+    if rows <= 0:
+        return []
+    n = math.ceil(rows / group_rows)
+    return [(g * group_rows, min((g + 1) * group_rows, rows))
+            for g in range(n)]
+
+
+def build_zones(names: Sequence[str], arrays: Sequence[np.ndarray],
+                valids: Sequence[Optional[np.ndarray]],
+                group_rows: int = DEFAULT_ROW_GROUP_ROWS) -> List[dict]:
+    """Per-row-group zone maps: [{"rows": r, "zones": {col: zone}}]."""
+    rows = len(arrays[0]) if arrays else 0
+    groups = []
+    for lo, hi in group_ranges(rows, group_rows):
+        zones = {}
+        for name, arr, valid in zip(names, arrays, valids):
+            zones[name] = column_zone(
+                arr[lo:hi], None if valid is None else valid[lo:hi])
+        groups.append({"rows": hi - lo, "zones": zones})
+    return groups
+
+
+# ------------------------------------------------------------------ write
+
+
+def _store_array(arr: np.ndarray) -> np.ndarray:
+    """npz-safe storage dtype: object strings -> fixed-width unicode (no
+    pickle in the native format)."""
+    if arr.dtype == object:
+        return np.asarray(["" if v is None else str(v) for v in arr],
+                          dtype=np.str_)
+    return arr
+
+
+def write_file(path: str, fmt: str, names: Sequence[str],
+               arrays: Sequence[np.ndarray],
+               valids: Sequence[Optional[np.ndarray]],
+               group_rows: int = DEFAULT_ROW_GROUP_ROWS) -> int:
+    """Write one data file; returns the row count."""
+    rows = len(arrays[0]) if arrays else 0
+    if fmt == "parquet":
+        cols = {}
+        for name, arr, valid in zip(names, arrays, valids):
+            store = _store_array(arr)
+            if valid is not None:
+                mask = ~np.asarray(valid, dtype=bool)
+                pa_arr = _pa.array(store, mask=mask)
+            else:
+                pa_arr = _pa.array(store)
+            cols[name] = pa_arr
+        table = _pa.table(cols)
+        _pq.write_table(table, path, row_group_size=group_rows)
+        return rows
+    payload = {"__rows__": np.asarray(rows, dtype=np.int64)}
+    for i, (arr, valid) in enumerate(zip(arrays, valids)):
+        payload[f"c{i}"] = _store_array(arr)
+        if valid is not None:
+            payload[f"v{i}"] = np.asarray(valid, dtype=bool)
+    np.savez_compressed(path, **payload)
+    return rows
+
+
+# ------------------------------------------------------------------- read
+
+
+def _np_view(pa_col) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """(values, valid) host arrays for one pyarrow column. Null-free
+    primitives try the zero-copy path first (dlpack / buffer protocol);
+    everything else pays the decode."""
+    col = pa_col.combine_chunks() if hasattr(pa_col, "combine_chunks") \
+        else pa_col
+    null_count = col.null_count
+    valid = None
+    if null_count:
+        valid = ~np.asarray(col.is_null())
+    if _pa.types.is_string(col.type) or _pa.types.is_large_string(col.type):
+        if null_count:
+            col = col.fill_null("")
+        return np.asarray(col.to_numpy(zero_copy_only=False),
+                          dtype=object), valid
+    if null_count:
+        col = col.fill_null(0)
+    else:
+        try:  # dlpack zero-copy where possible (primitive, no nulls)
+            return np.from_dlpack(col), valid
+        except Exception:
+            pass
+    return col.to_numpy(zero_copy_only=False), valid
+
+
+def read_groups(path: str, fmt: str, all_names: Sequence[str],
+                names: Sequence[str], group_idxs: Sequence[int],
+                group_rows: int = DEFAULT_ROW_GROUP_ROWS
+                ) -> Dict[str, Tuple[np.ndarray, Optional[np.ndarray]]]:
+    """Read the requested columns of the ELIGIBLE row groups of one data
+    file, concatenated in group order: {name: (values, valid|None)}.
+    Parquet reads only the named groups from disk; npz reads the file
+    once and slices the group ranges."""
+    if not names:
+        return {}
+    if fmt == "parquet":
+        pf = _pq.ParquetFile(path)
+        parts: Dict[str, list] = {n: [] for n in names}
+        vparts: Dict[str, list] = {n: [] for n in names}
+        any_valid = {n: False for n in names}
+        for g in group_idxs:
+            tbl = pf.read_row_group(g, columns=list(names))
+            for n in names:
+                vals, valid = _np_view(tbl.column(n))
+                parts[n].append(vals)
+                vparts[n].append(valid)
+                if valid is not None:
+                    any_valid[n] = True
+        out = {}
+        for n in names:
+            vals = np.concatenate(parts[n]) if len(parts[n]) > 1 \
+                else parts[n][0]
+            valid = None
+            if any_valid[n]:
+                valid = np.concatenate([
+                    v if v is not None else np.ones(len(a), dtype=bool)
+                    for v, a in zip(vparts[n], parts[n])])
+            out[n] = (vals, valid)
+        return out
+    with np.load(path, allow_pickle=False) as data:
+        rows = int(data["__rows__"])
+        ranges = group_ranges(rows, group_rows)
+        ordinals = {n: i for i, n in enumerate(all_names)}
+        out = {}
+        for n in names:
+            i = ordinals[n]
+            arr = data[f"c{i}"]
+            valid = data[f"v{i}"] if f"v{i}" in data.files else None
+            if len(group_idxs) == len(ranges):
+                out[n] = (arr, valid)
+                continue
+            sel = [arr[lo:hi] for g in group_idxs
+                   for lo, hi in [ranges[g]]]
+            vsel = None
+            if valid is not None:
+                vsel = np.concatenate(
+                    [valid[lo:hi] for g in group_idxs
+                     for lo, hi in [ranges[g]]]) if sel else None
+            out[n] = (np.concatenate(sel) if len(sel) != 1 else sel[0],
+                      vsel)
+        return out

@@ -42,6 +42,10 @@ class ColumnMetadata:
 class TableMetadata:
     name: SchemaTableName
     columns: Tuple[ColumnMetadata, ...]
+    # CREATE TABLE ... WITH (key = value) properties, evaluated to plain
+    # values (the ConnectorTableProperties channel: the lake connector
+    # reads partitioned_by/format here; other connectors ignore them)
+    properties: Tuple[Tuple[str, object], ...] = ()
 
     def column_index(self, name: str) -> int:
         for i, c in enumerate(self.columns):
@@ -79,12 +83,17 @@ class Split:
 
     `part`/`total_parts` index a row-range partition of the table; `host` is a
     locality hint (mesh coordinate, not hostname, in the TPU build).
+    `context` is opaque connector state captured at SPLIT time (the lake
+    pins its manifest snapshot here, so every split of one query reads
+    ONE committed version even while concurrent writes swap manifests).
     """
 
     table: ConnectorTableHandle
     part: int
     total_parts: int
     host: Optional[int] = None
+    context: Optional[object] = dataclasses.field(default=None,
+                                                  compare=False)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -127,11 +127,13 @@ def _rows_for(table: str) -> List[tuple]:
                 for g in list_all_groups()]
     if table == "caches":
         from trino_tpu.exec import jit_cache, plan_cache
+        from trino_tpu.exec.table_cache import table_cache_stats
         from trino_tpu.serve.caches import (result_cache_stats,
                                             scan_cache_stats)
         ps = plan_cache.stats()
         rs = result_cache_stats()
         ss = scan_cache_stats()
+        ts = table_cache_stats()
         js = jit_cache.stats()
         return [
             ("plan", ps["entries"], 0, ps["hits"], ps["misses"],
@@ -140,6 +142,8 @@ def _rows_for(table: str) -> List[tuple]:
              rs["evictions"], rs["invalidations"]),
             ("scan", ss["entries"], ss["bytes"], ss["hits"],
              ss["misses"], ss["evictions"], ss["invalidations"]),
+            ("table", ts["entries"], ts["bytes"], ts["hits"],
+             ts["misses"], ts["evictions"], ts["invalidations"]),
             ("jit", js["size"], 0, js["hits"], js["misses"],
              js["evictions"], 0),
         ]

@@ -89,21 +89,79 @@ class ShardExecutionPlanner(LocalExecutionPlanner):
     def _exec_TableScanNode(self, node: TableScanNode) -> PageStream:
         conn = self.metadata.connector(node.catalog)
         columns = [c for _, c in node.assignments]
+        symbols = tuple(s for s, _ in node.assignments)
+        col = self.collector
+        # device-resident table cache: this shard's row range slices out
+        # of the resident columns — a cross-device placement is a
+        # device-to-device copy, never host->device staging (the counter
+        # contract the table cache exists for). Hit/miss counts on
+        # shard 0 only, so a fragment's scan counts once per scan.
+        tcache = None if node.catalog == "system" else self.table_cache
+        if tcache is not None:
+            st = node.table.name
+            tkey = (node.catalog, st.schema, st.table)
+            names = [c.name for c in columns]
+            # ONE resolution per fragment attempt (the memo is shared by
+            # every shard executor of the attempt): a promotion or
+            # invalidation landing between shard dispatches must not mix
+            # row-range cache shards with split-based connector shards
+            # within a single scan
+            memo = self.table_cache_memo
+            memo_key = (tkey, tuple(names))
+            if memo is not None and memo_key in memo:
+                entry = memo[memo_key]
+            else:
+                entry = tcache.lookup(tkey, names, count=self.shard == 0)
+                if memo is not None:
+                    memo[memo_key] = entry
+            if entry is not None:
+                if col is not None and self.shard == 0:
+                    col.table_cache_hit()
+                from trino_tpu.exec.table_cache import build_shard_page
+                my_page = build_shard_page(entry, names, self.shard,
+                                           self.n_shards)
+
+                def gen_resident(page=my_page):
+                    if page is None:
+                        return
+                    if self.device is not None:
+                        page = jax.device_put(page, self.device)
+                    self._checkpoint()
+                    yield page
+                return PageStream(self._sliced(gen_resident()), symbols)
+            if col is not None and self.shard == 0:
+                col.table_cache_miss()
+        handle, _dyn = self._effective_handle(conn, node)
         splits = conn.split_manager.get_splits(
-            node.table, target_splits=self.n_shards)
+            handle, target_splits=self.n_shards)
         mine = [s for s in splits if s.part % self.n_shards == self.shard]
         cap = self._split_capacity(conn, node, splits)
 
         def gen():
-            for split in mine:
-                self._fault_site("scan", f"{node.table} part {split.part}")
-                for page in conn.page_source.pages(split, columns, cap):
-                    self._checkpoint()
-                    if self.device is not None:
-                        page = jax.device_put(page, self.device)
-                    yield page
-        return PageStream(self._sliced(gen()),
-                          tuple(s for s, _ in node.assignments))
+            from trino_tpu.exec.memory import page_bytes
+            try:
+                for split in mine:
+                    self._fault_site("scan",
+                                     f"{node.table} part {split.part}")
+                    for page in conn.page_source.pages(split, columns,
+                                                       cap):
+                        self._checkpoint()
+                        if col is not None:
+                            col.add_scan_staging(page_bytes(page))
+                        if self.device is not None:
+                            page = jax.device_put(page, self.device)
+                        yield page
+            finally:
+                # shard executors dispatch sequentially on one thread;
+                # every shard's get_splits sees the same pruning, so
+                # fold shard 0's counters and drop the duplicates
+                if self.shard == 0:
+                    self._drain_scan_stats(conn)
+                else:
+                    take = getattr(conn, "take_scan_stats", None)
+                    if take is not None:
+                        take()
+        return PageStream(self._sliced(gen()), symbols)
 
     def _split_capacity(self, conn, node: TableScanNode, splits) -> int:
         cap = split_scan_capacity(self.session, conn, node, splits)
@@ -241,6 +299,8 @@ class DistributedQueryRunner(LocalQueryRunner):
         runner.catalogs.register("tpcds", tpcds.create_connector())
         runner.catalogs.register("memory", memory.create_connector())
         runner.catalogs.register("blackhole", blackhole.create_connector())
+        from trino_tpu.connector import lake
+        runner.catalogs.register("lake", lake.create_connector())
         runner.catalogs.register("system", system.create_connector())
         return runner
 
@@ -282,6 +342,9 @@ class DistributedQueryRunner(LocalQueryRunner):
         executor.exec_params = self._exec_params
         executor.slices = self._slices
         executor.adaptive = getattr(self, "_adaptive", None)
+        executor.table_cache = self._active_table_cache()
+        executor.table_cache_min_scans = int(
+            self.session.get("table_cache_min_scans"))
         if self._memory is not None:
             executor.memory = self._memory   # query-level shared ledger
         root_stream = executor.execute(frag.root)
@@ -423,6 +486,12 @@ class DistributedQueryRunner(LocalQueryRunner):
         def scope_of(shard: int) -> str:
             return f"fragment-{frag.fragment_id}/shard-{shard}"
 
+        # one table-cache resolution per (table, columns) for the WHOLE
+        # attempt: shard executors share this memo so a concurrent
+        # promotion/invalidation can never split one scan across the
+        # cache and connector data planes
+        tcache_memo: Dict[tuple, object] = {}
+
         # dispatch every non-checkpointed shard's pipeline before the
         # batched result sync. Leaf pages are device_put onto mesh device
         # `shard`, so each task's kernels queue on ITS device's stream:
@@ -444,6 +513,10 @@ class DistributedQueryRunner(LocalQueryRunner):
             executor.exec_params = self._exec_params
             executor.slices = self._slices
             executor.adaptive = getattr(self, "_adaptive", None)
+            executor.table_cache = self._active_table_cache()
+            executor.table_cache_min_scans = int(
+                self.session.get("table_cache_min_scans"))
+            executor.table_cache_memo = tcache_memo
             if self._memory is not None:
                 executor.memory = self._memory  # shards share the ledger
             ck = store.load(scope_of(shard)) if store is not None else None

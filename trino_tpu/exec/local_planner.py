@@ -215,6 +215,25 @@ class LocalExecutionPlanner:
         # heavy keys the failed attempt observed. None = per-execution
         # throwaway state (direct executor use).
         self.adaptive = None
+        # device-resident table cache (exec/table_cache.TableCache),
+        # installed by the owning runner when table_cache_enabled: hot
+        # columns promoted into HBM across queries serve scans with ZERO
+        # host->device staging (scan_staging_bytes stays 0 on a hit)
+        self.table_cache = None
+        # scans promote after this many observed scans of the same
+        # (table, columns) working set (session table_cache_min_scans)
+        self.table_cache_min_scans = 2
+        # per-fragment-attempt memo of resolved table-cache entries
+        # (exec/distributed.py shares one dict across a fragment's
+        # shard executors, so every shard of one scan sees the SAME
+        # hit-or-miss decision); None = resolve per scan (local path)
+        self.table_cache_memo: Optional[Dict] = None
+        # join dynamic filters routed into connector pruning: scan node
+        # id -> TupleDomain registered by the consuming join AFTER its
+        # build side collected; the scan's lazy generator intersects it
+        # into the split/file/row-group pruning constraint at iteration
+        # time (build-before-probe ordering makes that window real)
+        self._dyn_domains: Dict[int, object] = {}
 
     def _checkpoint(self) -> None:
         """Cooperative cancellation/deadline point (page-batch boundary);
@@ -344,44 +363,215 @@ class LocalExecutionPlanner:
         columns = [c for _, c in node.assignments]
         cap = self._scan_capacity(conn, node)
         symbols = tuple(s for s, _ in node.assignments)
+        system = node.catalog == "system"
+        st = node.table.name
+        tkey = (node.catalog, st.schema, st.table)
+        col = self.collector
+        # device-resident table cache FIRST: full columns already in HBM
+        # serve any column subset at any capacity with zero host->device
+        # staging (scan_staging_bytes stays 0 — the counter proof)
+        tcache = None if system else self.table_cache
+        col_names = [c.name for c in columns]
+        # generation snapshot BEFORE any scanning: a promotion built
+        # from pre-INSERT pages must not land after the invalidation
+        tgen = None if tcache is None else tcache.generation()
+        if tcache is not None:
+            entry = tcache.lookup(tkey, col_names)
+            if entry is not None:
+                if col is not None:
+                    col.table_cache_hit()
+                from trino_tpu.exec.table_cache import build_pages
+                resident = build_pages(entry, col_names, cap)
+
+                def gen_resident(pages=resident):
+                    for page in pages:
+                        self._checkpoint()
+                        yield page
+                return PageStream(self._sliced(gen_resident()), symbols)
+            if col is not None:
+                col.table_cache_miss()
         cache = self.scan_cache
         key = None
-        if cache is not None and node.catalog != "system":
+        if cache is not None and not system:
             # system.runtime tables materialize live engine state at
-            # scan time — caching them would freeze it
-            st = node.table.name
-            key = ((node.catalog, st.schema, st.table),
-                   tuple((c.name, c.ordinal) for c in columns), cap)
+            # scan time — caching them would freeze it. The key carries
+            # the handle's pushed-down constraint and limit: a pruning
+            # connector's page set is a function of both, so a LIMIT- or
+            # domain-truncated scan must never serve a full one.
+            key = (tkey, tuple((c.name, c.ordinal) for c in columns),
+                   cap, node.table.constraint.freeze(), node.table.limit)
             staged = cache.get(key)
             if staged is not None:
-                if self.collector is not None:
-                    self.collector.scan_cache_hit()
+                if col is not None:
+                    col.scan_cache_hit()
+                # staged pages are already on device: a hot working set
+                # promotes into the table cache from HERE (device
+                # concats, no host re-read)
+                self._maybe_promote(tcache, tkey, node, staged, tgen)
 
                 def gen_hit(pages=staged):
                     for page in pages:
                         self._checkpoint()
                         yield page
                 return PageStream(self._sliced(gen_hit()), symbols)
-            if self.collector is not None:
-                self.collector.scan_cache_miss()
+            if col is not None:
+                col.scan_cache_miss()
         gen_seen = None if key is None else cache.generation()
-        splits = conn.split_manager.get_splits(node.table, target_splits=1)
 
         def gen():
-            staging = [] if key is not None else None
-            for split in splits:
-                self._fault_site("scan", str(node.table))
-                for page in conn.page_source.pages(split, columns, cap):
-                    self._checkpoint()
-                    if staging is not None:
-                        staging.append(page)
-                    yield page
-            if staging is not None:
+            from trino_tpu.exec.memory import page_bytes
+            # dynamic filters (registered by a consuming join after its
+            # build collected — strictly before this generator is
+            # pulled) intersect into the pruning constraint so the
+            # connector can skip whole files/row groups, not just rows
+            handle, dyn_applied = self._effective_handle(conn, node)
+            splits = conn.split_manager.get_splits(handle, target_splits=1)
+            # promotion decision up front: a FULL page set (no limit, no
+            # effective pruning) of a hot-enough working set stages for
+            # the device table cache even when the scan cache is off
+            promote = False
+            if tcache is not None and not dyn_applied \
+                    and node.table.limit is None \
+                    and (not getattr(conn.metadata, "supports_zone_maps",
+                                     False)
+                         or handle.constraint.is_all()):
+                count = tcache.note_scan(tkey, col_names)
+                promote = count >= max(
+                    int(self.table_cache_min_scans), 1) \
+                    and tcache.should_promote(tkey, col_names)
+            staging = [] if (key is not None and not dyn_applied) \
+                or promote else None
+            try:
+                for split in splits:
+                    self._fault_site("scan", str(node.table))
+                    for page in conn.page_source.pages(split, columns,
+                                                       cap):
+                        self._checkpoint()
+                        if col is not None:
+                            col.add_scan_staging(page_bytes(page))
+                        if staging is not None:
+                            staging.append(page)
+                        yield page
+            finally:
+                self._drain_scan_stats(conn)
+            if staging is not None and key is not None and not dyn_applied:
                 # gen_seen guards the race with a concurrent INSERT: a
                 # scan that started pre-change must not publish post-
-                # invalidation (same discipline as PlanCache.put)
+                # invalidation (same discipline as PlanCache.put). A
+                # dynamically-pruned page set is keyed on the STATIC
+                # constraint, so it must not publish at all.
                 cache.put(key, staging, gen=gen_seen)
+            if promote and staging:
+                counts = [int(c) for c in jax.device_get(
+                    [p.num_rows for p in staging])]
+                tcache.promote_from_pages(
+                    tkey, [(c.name, c) for _, c in node.assignments],
+                    staging, counts, device=self.mem_device,
+                    collector=col, gen=tgen)
         return PageStream(self._sliced(gen()), symbols)
+
+    def _effective_handle(self, conn, node: TableScanNode):
+        """(handle for split pruning, dynamic-filter-applied flag): the
+        static pushed-down constraint, intersected with any registered
+        join dynamic filter, or cleared entirely when the session pins
+        zone-map pruning off (lake_zone_maps_enabled = false)."""
+        import dataclasses as _dc
+
+        from trino_tpu.predicate import TupleDomain
+        handle = node.table
+        prunes = getattr(conn.metadata, "supports_zone_maps", False)
+        if prunes and not bool(
+                self.session.get("lake_zone_maps_enabled")):
+            return (_dc.replace(handle, constraint=TupleDomain.all()),
+                    False)
+        dyn = self._dyn_domains.get(id(node))
+        if dyn is None or not prunes:
+            return handle, False
+        return (_dc.replace(handle,
+                            constraint=handle.constraint.intersect(dyn)),
+                True)
+
+    def _drain_scan_stats(self, conn) -> None:
+        """Fold the connector's per-scan prune counters (thread-local —
+        the scan ran on this thread) into the query stats."""
+        take = getattr(conn, "take_scan_stats", None)
+        if take is None:
+            return
+        d = take() or {}
+        if self.collector is not None and d:
+            self.collector.add_pruned(d.get("files_pruned", 0),
+                                      d.get("row_groups_pruned", 0))
+
+    def _maybe_promote(self, tcache, tkey, node: TableScanNode,
+                       pages, gen=None) -> None:
+        """Promote a hot (table, columns) working set into the device
+        table cache from its already-staged pages. Only FULL page sets
+        are admissible: a handle with a pushed-down constraint or limit
+        on a pruning connector may cover a subset of the table."""
+        if tcache is None or not pages:
+            return
+        if node.table.limit is not None:
+            return
+        if getattr(self.metadata.connector(node.catalog).metadata,
+                   "supports_zone_maps", False) \
+                and not node.table.constraint.is_all():
+            return
+        names = [c.name for _, c in node.assignments]
+        if tcache.note_scan(tkey, names) < max(
+                int(self.table_cache_min_scans), 1):
+            return
+        if not tcache.should_promote(tkey, names):
+            return
+        counts = [int(c) for c in jax.device_get(
+            [p.num_rows for p in pages])]
+        tcache.promote_from_pages(
+            tkey, [(c.name, c) for _, c in node.assignments], pages,
+            counts, device=self.mem_device, collector=self.collector,
+            gen=gen)
+
+    def register_dynamic_domain(self, scan_node, column: str, typ,
+                                lo, hi) -> None:
+        """A consuming join publishes its collected build-side key range
+        as a TupleDomain for `scan_node` — the scan's generator (not yet
+        pulled: build-before-probe) folds it into file/row-group
+        pruning. Values are raw internal representation, matching the
+        zone maps."""
+        from trino_tpu.predicate import Domain, Range, TupleDomain
+        dom = TupleDomain.with_column_domains(
+            {column: Domain.from_range(typ, Range.between(lo, hi))})
+        prev = self._dyn_domains.get(id(scan_node))
+        self._dyn_domains[id(scan_node)] = \
+            dom if prev is None else prev.intersect(dom)
+        from trino_tpu.obs.stats import maybe_span
+        with maybe_span(self.collector, "dynamic-filter-pushdown",
+                        kind="scan", column=column, low=str(lo),
+                        high=str(hi)):
+            pass
+
+    def _dyn_scan_target(self, subtree, symbol_name: str):
+        """The TableScanNode under `subtree` whose output directly
+        carries `symbol_name`, reached only through row-restricting
+        nodes (filter/project/join/semijoin — pruning its rows by a key
+        bound the join will enforce anyway cannot change results; a
+        window/limit/topn in between could, so the walk stops there),
+        on a connector that prunes by zone maps. None when absent."""
+        from trino_tpu.planner.nodes import (FilterNode, JoinNode,
+                                             ProjectNode, SemiJoinNode)
+        stack = [subtree]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, TableScanNode):
+                for s, ch in n.assignments:
+                    if s.name == symbol_name:
+                        conn = self.metadata.connector(n.catalog)
+                        if getattr(conn.metadata, "supports_zone_maps",
+                                   False):
+                            return n, ch.name, ch.type
+                continue
+            if isinstance(n, (FilterNode, ProjectNode, JoinNode,
+                              SemiJoinNode)):
+                stack.extend(n.sources)
+        return None
 
     def _scan_capacity(self, conn, node: TableScanNode) -> int:
         """Size scan pages to the table: one big page per split keeps the
@@ -1380,6 +1570,21 @@ class LocalExecutionPlanner:
                         ("dfrange", probe_keys[0]),
                         lambda: range_prefilter(probe_keys[0]))
                     prefilter = (pf_op, bounds_op(bp))
+                    # the same build-side range, pushed into connector
+                    # FILE/ROW-GROUP pruning when the probe key maps
+                    # straight to a zone-mapped scan column (the lake's
+                    # dynamic-filter pushdown) — the scan's generator
+                    # has not been pulled yet (build-before-probe), so
+                    # the domain lands before splits are chosen
+                    target = self._dyn_scan_target(
+                        node.left,
+                        probe_stream.symbols[probe_keys[0]].name)
+                    if target is not None:
+                        scan_node, col_name, col_type = target
+                        lo_h, hi_h = jax.device_get(prefilter[1])
+                        self.register_dynamic_domain(
+                            scan_node, col_name, col_type,
+                            lo_h.item(), hi_h.item())
                 coalesced = self._coalesce_stream(aligned,
                                                   prefilter=prefilter)
                 if join_kind == JoinType.INNER and max_run <= 1:

@@ -142,6 +142,14 @@ class NodeMemoryPool:
         # its budget. Advisory after attempt rollbacks (like by_tag).
         self.device_reserved: Dict[int, int] = {}
         self.device_peak: Dict[int, int] = {}
+        # HBM pinned by cross-query caches (the device-resident table
+        # cache, exec/table_cache.py): tracked SEPARATELY from query
+        # reservations — cache residency outlives queries, so it must
+        # not trip the per-query leak detector — but counted against
+        # the pool limit at admission time, so a cache can never pin
+        # HBM a live query's reservation was promised
+        self.cache_reserved = 0
+        self.device_cache_reserved: Dict[int, int] = {}
         self._contexts: Dict[str, "QueryMemoryContext"] = {}
 
     # ------------------------------------------------------- configuration
@@ -255,6 +263,43 @@ class NodeMemoryPool:
             if device is not None:
                 self.device_reserved[device] = max(
                     0, self.device_reserved.get(device, 0) - nbytes)
+            self._cond.notify_all()
+
+    # ----------------------------------------------- cache residency
+
+    def reserve_cache(self, nbytes: int,
+                      device: Optional[int] = None) -> bool:
+        """Admit `nbytes` of cross-query cache residency (the HBM table
+        cache) against the pool budget. Never kills and never blocks —
+        a cache that cannot fit simply isn't admitted (returns False);
+        live queries always win the HBM."""
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            return True
+        with self._cond:
+            if self.limit is not None:
+                if self.enforce_per_device and device is not None:
+                    current = (self.device_reserved.get(device, 0)
+                               + self.device_cache_reserved.get(device, 0))
+                else:
+                    current = self.reserved + self.cache_reserved
+                if current + nbytes > self.limit:
+                    return False
+            self.cache_reserved += nbytes
+            key = device if device is not None else 0
+            self.device_cache_reserved[key] = \
+                self.device_cache_reserved.get(key, 0) + nbytes
+            return True
+
+    def free_cache(self, nbytes: int, device: Optional[int] = None) -> None:
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            return
+        with self._cond:
+            self.cache_reserved = max(0, self.cache_reserved - nbytes)
+            key = device if device is not None else 0
+            self.device_cache_reserved[key] = max(
+                0, self.device_cache_reserved.get(key, 0) - nbytes)
             self._cond.notify_all()
 
     def reset_context(self, ctx: "QueryMemoryContext") -> None:

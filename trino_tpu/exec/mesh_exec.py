@@ -590,36 +590,100 @@ def _align_key_dictionaries(probe: Page, build: Page, probe_keys,
 def _stage_scan(runner, node: TableScanNode) -> Tuple[List[Page], int]:
     """Read one leaf scan as n per-shard pages (split round-robin, the
     SourcePartitionedScheduler assignment), each merged to one page; the
-    caller normalizes + stacks them into a workers-sharded global Page."""
-    from trino_tpu.exec.distributed import split_scan_capacity
+    caller normalizes + stacks them into a workers-sharded global Page.
+
+    Device-resident table cache: when the scan's columns are already
+    promoted into HBM, the per-shard pages are ROW-RANGE SLICES of the
+    resident arrays — the shard placement that follows is a device-to-
+    device move, so a warm repeated mesh scan stages ZERO host->device
+    bytes (scan_staging_bytes, the mesh-side counter proof). A cold
+    mesh scan both stages from the connector (counted) and, once the
+    working set is hot enough, promotes from its own normalized pages."""
+    import dataclasses as _dc
+
+    from trino_tpu.exec.distributed import (_empty_like, _normalize_pages,
+                                            split_scan_capacity)
+    from trino_tpu.exec.memory import page_bytes
+    from trino_tpu.predicate import TupleDomain
     conn = runner.metadata.connector(node.catalog)
     columns = [c for _, c in node.assignments]
+    names = [c.name for c in columns]
     n = runner.mesh.n
-    splits = conn.split_manager.get_splits(node.table, target_splits=n)
+    col = runner._collector
+    st = node.table.name
+    tkey = (node.catalog, st.schema, st.table)
+    tcache = None if node.catalog == "system" \
+        else runner._active_table_cache()
+    tgen = None if tcache is None else tcache.generation()
+    if tcache is not None:
+        entry = tcache.lookup(tkey, names)
+        if entry is not None:
+            if col is not None:
+                col.table_cache_hit()
+            from trino_tpu.exec.table_cache import build_shard_pages
+            per_shard = build_shard_pages(entry, names, n)
+            ref = next((p for p in per_shard if p is not None), None)
+            if ref is None:
+                raise MeshUnsupported(f"empty table {node.table}")
+            per_shard = [_empty_like(ref) if p is None else p
+                         for p in per_shard]
+            return _normalize_pages(per_shard), ref.capacity
+        if col is not None:
+            col.table_cache_miss()
+    handle = node.table
+    prunes = getattr(conn.metadata, "supports_zone_maps", False)
+    if prunes and not bool(
+            runner.session.get("lake_zone_maps_enabled")):
+        handle = _dc.replace(handle, constraint=TupleDomain.all())
+    splits = conn.split_manager.get_splits(handle, target_splits=n)
     cap = split_scan_capacity(runner.session, conn, node, splits)
     per_shard: List[Optional[Page]] = []
-    for shard in range(n):
-        mine = [s for s in splits if s.part % n == shard]
-        pages: List[Page] = []
-        for split in mine:
-            for page in conn.page_source.pages(split, columns, cap):
-                pages.append(page)
-        if not pages:
-            per_shard.append(None)
-        elif len(pages) == 1:
-            per_shard.append(pages[0])
-        else:
-            from trino_tpu.page import device_concat
-            key = ("mesh-sconcat", tuple(p.capacity for p in pages),
-                   pages[0].num_columns)
-            op = cached_kernel(key, lambda: lambda *ps: device_concat(ps))
-            per_shard.append(op(*pages))
+    try:
+        for shard in range(n):
+            mine = [s for s in splits if s.part % n == shard]
+            pages: List[Page] = []
+            for split in mine:
+                for page in conn.page_source.pages(split, columns, cap):
+                    if col is not None:
+                        col.add_scan_staging(page_bytes(page))
+                    pages.append(page)
+            if not pages:
+                per_shard.append(None)
+            elif len(pages) == 1:
+                per_shard.append(pages[0])
+            else:
+                from trino_tpu.page import device_concat
+                key = ("mesh-sconcat", tuple(p.capacity for p in pages),
+                       pages[0].num_columns)
+                op = cached_kernel(key,
+                                   lambda: lambda *ps: device_concat(ps))
+                per_shard.append(op(*pages))
+    finally:
+        take = getattr(conn, "take_scan_stats", None)
+        if take is not None:
+            d = take() or {}
+            if col is not None and d:
+                col.add_pruned(d.get("files_pruned", 0),
+                               d.get("row_groups_pruned", 0))
     ref = next((p for p in per_shard if p is not None), None)
     if ref is None:
         raise MeshUnsupported(f"empty table {node.table}")
-    from trino_tpu.exec.distributed import _empty_like, _normalize_pages
     per_shard = [_empty_like(ref) if p is None else p for p in per_shard]
-    return _normalize_pages(per_shard), cap
+    normalized = _normalize_pages(per_shard)
+    if tcache is not None and node.table.limit is None \
+            and (not prunes or handle.constraint.is_all()):
+        # hot-set promotion from the just-normalized device pages
+        # (shared dictionaries by construction) — the NEXT mesh scan of
+        # this table stages zero host bytes
+        if tcache.note_scan(tkey, names) >= max(1, int(
+                runner.session.get("table_cache_min_scans"))) \
+                and tcache.should_promote(tkey, names):
+            counts = [int(c) for c in jax.device_get(
+                [p.num_rows for p in normalized])]
+            tcache.promote_from_pages(
+                tkey, list(zip(names, columns)), normalized, counts,
+                collector=col, gen=tgen)
+    return normalized, cap
 
 
 def run_co_scheduled(runner, frag: PlanFragment,

@@ -108,6 +108,14 @@ class LocalQueryRunner:
         self._scan_cache = ScanCache()
         self._plan_cache.add_invalidation_hook(self._result_cache.invalidate)
         self._plan_cache.add_invalidation_hook(self._scan_cache.invalidate)
+        # device-resident hot-table cache (exec/table_cache.py): columns
+        # promoted into HBM across queries, serving both the local
+        # dispatch loop and mesh shard_map staging. Registered on the
+        # SAME invalidation fan-out, so one DDL/INSERT call drops plans,
+        # results, scan pages, AND resident device columns together.
+        from trino_tpu.exec.table_cache import TableCache
+        self._table_cache = TableCache()
+        self._plan_cache.add_invalidation_hook(self._invalidate_table_cache)
         # streaming result sink for the CURRENT query (serve/streaming
         # ResultStream, installed per execute() by the server): pages
         # leave through the ring as they are produced; None = buffered
@@ -202,6 +210,8 @@ class LocalQueryRunner:
         runner.catalogs.register("tpcds", tpcds.create_connector())
         runner.catalogs.register("memory", memory.create_connector())
         runner.catalogs.register("blackhole", blackhole.create_connector())
+        from trino_tpu.connector import lake
+        runner.catalogs.register("lake", lake.create_connector())
         from trino_tpu.connector import system
         runner.catalogs.register("system", system.create_connector())
         return runner
@@ -764,6 +774,34 @@ class LocalQueryRunner:
             self.session.param_types = None
         return self._result_cache.get(key, count_miss=False)
 
+    def _active_table_cache(self):
+        """The shared device table cache when the session enables it and
+        no chaos is armed (injected scan faults must fire, and a cached
+        column must not dodge them). The OWNING runner applies its
+        session's sizing; clones' header overrides never resize the
+        shared tier."""
+        if not bool(self.session.get("table_cache_enabled")) \
+                or self._faults is not None:
+            return None
+        if self._owns_plan_cache:
+            self._table_cache.configure(
+                int(self.session.get("table_cache_max_bytes")),
+                int(self.session.get("table_cache_min_scans")))
+        return self._table_cache
+
+    def _invalidate_table_cache(self, table) -> None:
+        """PlanCache invalidation hook: drop resident device columns of
+        the changed table (the fourth leg of the one-call fan-out:
+        plans, results, scan pages, device columns)."""
+        dropped = self._table_cache.invalidate(table)
+        col = self._collector
+        if dropped and col is not None:
+            from trino_tpu.obs.stats import maybe_span
+            with maybe_span(col, "table-cache-invalidate",
+                            kind="table-cache", table=str(table),
+                            entries=dropped):
+                pass
+
     def _session_property_changed(self, name: str) -> None:
         """SET/RESET SESSION side effects: resizing the plan-cache LRU
         applies immediately on the OWNING runner (a hit-only steady-state
@@ -920,6 +958,9 @@ class LocalQueryRunner:
             # chaos runs bypass the scan cache: the `scan` fault site
             # must fire, and injected scan failures must not poison it
             executor.scan_cache = self._scan_cache
+        executor.table_cache = self._active_table_cache()
+        executor.table_cache_min_scans = int(
+            self.session.get("table_cache_min_scans"))
         if self._memory is not None:
             executor.memory = self._memory   # query-level shared ledger
         stream = executor.execute(plan)
@@ -984,13 +1025,22 @@ class LocalQueryRunner:
     def _resolve(self, name: t.QualifiedName):
         return self.metadata.resolve_table_name(name.parts, self.session)
 
+    @staticmethod
+    def _table_properties(stmt) -> Tuple[Tuple[str, Any], ...]:
+        """CREATE TABLE ... WITH (key = literal) -> evaluated pairs the
+        connector reads off TableMetadata.properties (the lake's
+        partitioned_by/format channel; other connectors ignore them)."""
+        return tuple((k, _literal_value(v))
+                     for k, v in getattr(stmt, "properties", ()) or ())
+
     def _create_table(self, stmt: t.CreateTable) -> MaterializedResult:
         qname = self._resolve(stmt.name)
         conn = self.catalogs.get(qname.catalog)
         cols = tuple(ColumnMetadata(c.name.value, T.parse_type(c.type))
                      for c in stmt.elements)
         conn.metadata.create_table(
-            TableMetadata(qname.schema_table, cols), stmt.not_exists)
+            TableMetadata(qname.schema_table, cols,
+                          self._table_properties(stmt)), stmt.not_exists)
         self._invalidate_plans(qname)
         return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
 
@@ -1009,7 +1059,8 @@ class LocalQueryRunner:
         table_key = (qname.catalog, qname.schema, qname.table)
         replay = table_key in self._created_tables
         conn.metadata.create_table(
-            TableMetadata(qname.schema_table, cols),
+            TableMetadata(qname.schema_table, cols,
+                          self._table_properties(stmt)),
             stmt.not_exists or replay)
         self._created_tables.add(table_key)
         self._invalidate_plans(qname)
@@ -1116,6 +1167,9 @@ class LocalQueryRunner:
         executor.slices = self._slices
         executor.write_token = self._write_token
         executor.adaptive = self._adaptive
+        executor.table_cache = self._active_table_cache()
+        executor.table_cache_min_scans = int(
+            self.session.get("table_cache_min_scans"))
         if self._memory is not None:
             executor.memory = self._memory
         t0 = time.perf_counter()

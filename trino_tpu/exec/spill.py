@@ -555,10 +555,15 @@ def split_partition(store: HostPartitionStore, p: int,
         piece_hashes = partition_key_hashes(store, p, key_idxs)
     for piece, h in zip(store.pieces[p], piece_hashes):
         mask = np.isin(h, heavy)
-        if mask.any():
-            heavy_pieces.append(
-                [(v[mask], None if m is None else m[mask])
-                 for v, m in piece])
+        if not mask.any():
+            # no heavy rows here: keep the piece BY REFERENCE — a
+            # fancy-indexed all-True copy would double host RAM traffic
+            # on exactly the memory-pressure path this split relieves
+            rest_pieces.append(piece)
+            continue
+        heavy_pieces.append(
+            [(v[mask], None if m is None else m[mask])
+             for v, m in piece])
         if not mask.all():
             keep = ~mask
             rest_pieces.append(

@@ -141,6 +141,32 @@ SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     # invalidated per table like the result cache. Off by default
     # (direct runners); TrinoServer turns it on.
     "scan_cache_enabled": False,
+    # device-resident hot-table cache (exec/table_cache.py): columns of
+    # frequently-scanned tables promote into HBM and stay resident
+    # ACROSS queries — a warm repeated scan (local dispatch loop or
+    # mesh shard_map staging alike) does zero host->device transfers
+    # (proven per query by the scan_staging_bytes counter). Admission
+    # is scan-frequency x size under table_cache_max_bytes, residency
+    # is accounted against the per-chip node pool, and invalidation
+    # rides the PlanCache hook fan-out (one INSERT/DDL drops plans,
+    # results, scan pages, and device columns). Off by default on
+    # direct runners; TrinoServer turns it on. The warmup manifest's
+    # `tables:` entries preload into this tier at server start.
+    "table_cache_enabled": False,
+    # byte budget for resident columns; the lowest-frequency entry
+    # evicts first when a promotion would overflow it
+    "table_cache_max_bytes": 1 << 30,
+    # scans of one (table, columns) working set before promotion —
+    # 1 promotes on the first scan (bench/warmup style), higher values
+    # keep one-shot scans from churning HBM
+    "table_cache_min_scans": 2,
+    # lake connector pruning (connector/lake/): evaluate partition
+    # values + per-file/per-row-group min/max zone maps against the
+    # scan's TupleDomain (static pushdown AND join dynamic filters) and
+    # skip non-overlapping files/row groups entirely — counted per
+    # query as files_pruned / row_groups_pruned. Set false to force
+    # full-table reads (debugging / pruning-correctness comparisons).
+    "lake_zone_maps_enabled": True,
     # observability (obs/stats.py): per-operator stats collection for
     # EVERY query on the session (EXPLAIN ANALYZE forces it regardless).
     # Off by default: instrumenting node boundaries splits fused kernel

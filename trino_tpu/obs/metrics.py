@@ -467,6 +467,65 @@ def _engine_gauges():
            "Scan cache misses (scans staged from the connector) since "
            "process start.", ss["misses"], {})
 
+    from trino_tpu.exec.table_cache import (device_residency,
+                                            table_cache_stats)
+    ts = table_cache_stats()
+    tc = "Device-resident hot-table cache: "
+    yield ("trino_tpu_table_cache_entries",
+           tc + "promoted (table, columns) working sets resident.",
+           ts["entries"], {})
+    yield ("trino_tpu_table_cache_bytes",
+           tc + "HBM pinned by resident columns.", ts["bytes"], {})
+    yield ("trino_tpu_table_cache_hits",
+           tc + "scans served entirely from HBM (zero host->device "
+           "staging).", ts["hits"], {})
+    yield ("trino_tpu_table_cache_misses",
+           tc + "scans that staged from the connector.",
+           ts["misses"], {})
+    yield ("trino_tpu_table_cache_evictions",
+           tc + "entries evicted under the byte budget.",
+           ts["evictions"], {})
+    yield ("trino_tpu_table_cache_promotions",
+           tc + "working sets promoted since process start.",
+           ts["promotions"], {})
+    yield ("trino_tpu_table_cache_invalidations",
+           tc + "entries dropped by DDL/INSERT invalidation.",
+           ts["invalidations"], {})
+    for dev, nbytes in sorted(device_residency().items(),
+                              key=lambda kv: -1 if kv[0] is None
+                              else kv[0]):
+        # None = promoted outside a pinned shard (the default device);
+        # a distinct label value so it can never collide with a real
+        # device-0 series in the exposition
+        yield ("trino_tpu_table_cache_device_bytes",
+               tc + "resident bytes attributed per mesh device.",
+               nbytes, {"device": "default" if dev is None else dev})
+
+    try:
+        from trino_tpu.connector.lake import lake_stats
+        ls = lake_stats()
+        lk = "Lake connector: "
+        yield ("trino_tpu_lake_files_written",
+               lk + "data files committed since process start.",
+               ls["files_written"], {})
+        yield ("trino_tpu_lake_files_scanned",
+               lk + "data files read by scans.", ls["files_scanned"], {})
+        yield ("trino_tpu_lake_files_pruned",
+               lk + "data files skipped by partition/zone-map pruning "
+               "against the scan TupleDomain.", ls["files_pruned"], {})
+        yield ("trino_tpu_lake_row_groups_pruned",
+               lk + "row groups skipped by zone-map pruning.",
+               ls["row_groups_pruned"], {})
+        yield ("trino_tpu_lake_manifest_commits",
+               lk + "atomic manifest swaps committed.",
+               ls["manifest_commits"], {})
+        yield ("trino_tpu_lake_replayed_commits",
+               lk + "write-token replays detected (retried INSERT/CTAS "
+               "attempts that no-op'd — the exactly-once proof).",
+               ls["replayed_commits"], {})
+    except Exception:   # lake import must never fail the scrape
+        pass
+
     from trino_tpu.exec.sliced.checkpoint import checkpoint_stats
     cs = checkpoint_stats()
     yield ("trino_tpu_checkpoints_saved",

@@ -83,6 +83,20 @@ class QueryStatsCollector:
         self.result_cache_misses = 0
         self.scan_cache_hits = 0
         self.scan_cache_misses = 0
+        # device-resident table cache (exec/table_cache.py): a hit
+        # served a scan entirely from HBM-resident columns; and the
+        # data-plane proof for it — scan_staging_bytes counts every
+        # host->device byte table scans staged this query (0 on a warm
+        # cached scan, the `exchanges_fused`-style counter contract)
+        self.table_cache_hits = 0
+        self.table_cache_misses = 0
+        self.scan_staging_bytes = 0
+        # lake connector pruning (connector/lake/): whole data files
+        # and row groups skipped via partition values + min/max zone
+        # maps evaluated against the scan's TupleDomain (static
+        # pushdown and join dynamic filters alike)
+        self.files_pruned = 0
+        self.row_groups_pruned = 0
         # streaming delivery (trino_tpu/serve/streaming.py): chunks that
         # left through the result ring buffer. Output rows/bytes are
         # counted ONCE at the producer regardless of whether the result
@@ -207,6 +221,21 @@ class QueryStatsCollector:
     def scan_cache_miss(self) -> None:
         self.scan_cache_misses += 1
 
+    def table_cache_hit(self) -> None:
+        self.table_cache_hits += 1
+
+    def table_cache_miss(self) -> None:
+        self.table_cache_misses += 1
+
+    def add_scan_staging(self, nbytes: int) -> None:
+        """Host->device bytes staged by table scans (connector pages);
+        cached scans add nothing — the zero-transfer proof."""
+        self.scan_staging_bytes += int(nbytes)
+
+    def add_pruned(self, files: int = 0, row_groups: int = 0) -> None:
+        self.files_pruned += int(files)
+        self.row_groups_pruned += int(row_groups)
+
     def add_streamed(self, chunks: int, rows: int) -> None:
         self.streamed_chunks += int(chunks)
         self.streamed_rows += int(rows)
@@ -269,6 +298,11 @@ class QueryStatsCollector:
             "result_cache_misses": self.result_cache_misses,
             "scan_cache_hits": self.scan_cache_hits,
             "scan_cache_misses": self.scan_cache_misses,
+            "table_cache_hits": self.table_cache_hits,
+            "table_cache_misses": self.table_cache_misses,
+            "scan_staging_bytes": self.scan_staging_bytes,
+            "files_pruned": self.files_pruned,
+            "row_groups_pruned": self.row_groups_pruned,
             "streamed_chunks": self.streamed_chunks,
             "streamed_rows": self.streamed_rows,
             "retries": self.retries,

@@ -927,11 +927,26 @@ class PushPredicateIntoTableScan(Rule):
         return FilterNode(new_scan, node.predicate)
 
 
+def _unwrap_literal(e: RowExpression) -> RowExpression:
+    """See through value-preserving integer-widening casts so
+    `bigint_col < 100` (planned as lt(col, cast(100))) still yields a
+    pushable domain. Only integer->integer casts unwrap: a decimal/date
+    cast changes the RAW representation the zone maps compare against."""
+    from trino_tpu import types as _T
+    if (isinstance(e, Call) and e.name == "cast" and len(e.args) == 1
+            and isinstance(e.args[0], Literal)
+            and isinstance(e.type, (_T.BigintType, _T.IntegerType))
+            and isinstance(e.args[0].type,
+                           (_T.BigintType, _T.IntegerType))):
+        return Literal(e.args[0].value, e.type)
+    return e
+
+
 def _extract_domain(p: RowExpression, sym_to_col
                     ) -> Optional[Tuple[str, Domain]]:
     if not (isinstance(p, Call) and len(p.args) == 2):
         return None
-    a, b = p.args
+    a, b = (_unwrap_literal(x) for x in p.args)
     if isinstance(a, SymbolRef) and isinstance(b, Literal) and \
             b.value is not None and a.name in sym_to_col:
         col, val, op = sym_to_col[a.name].name, b.value, p.name

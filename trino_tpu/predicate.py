@@ -240,3 +240,13 @@ class TupleDomain:
             return self
         return TupleDomain.with_column_domains(
             {fn(k): v for k, v in self.domains.items()})
+
+    def freeze(self) -> Hashable:
+        """Hashable canonical form (TupleDomain holds a dict, so the
+        dataclass itself cannot key a cache): sorted (column, Domain)
+        pairs, or the NONE sentinel. Two equal domains freeze equal —
+        the scan-cache key contract (a pruning connector's page set is a
+        function of the effective constraint)."""
+        if self.domains is None:
+            return ("<none>",)
+        return tuple(sorted(self.domains.items(), key=lambda kv: str(kv[0])))

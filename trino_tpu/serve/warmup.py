@@ -27,6 +27,22 @@ Manifest format (JSON; a bare list of statement specs also loads):
 `using` is present) EXECUTE name USING <using>. Plain `sql` executes
 directly. A failing statement is recorded in the report and does NOT
 abort the server start — a partially warm server beats no server.
+
+The manifest also learns `tables:` entries — DATA warmup, not just
+plans: each named table's columns are read through its connector ONCE
+at start() and promoted straight into the device table cache
+(exec/table_cache.py), so the FIRST real scan is an HBM hit with zero
+host->device staging:
+
+    {"tables": [
+      {"table": "lake.default.orders_part"},
+      {"table": "tpch.tiny.nation", "columns": ["n_nationkey",
+                                                "n_name"]}
+     ],
+     "statements": [...]}
+
+`table` is catalog.schema.table (or schema.table / table, resolved
+against the runner's session); `columns` defaults to every column.
 """
 
 from __future__ import annotations
@@ -45,6 +61,8 @@ def load_manifest(source: Union[str, dict, list]) -> List[Dict[str, Any]]:
         statements = source
     elif isinstance(source, dict):
         statements = source.get("statements")
+        if statements is None and "tables" in source:
+            statements = []     # a data-only manifest is legitimate
         if statements is None:
             raise ValueError(
                 "warmup manifest needs a top-level 'statements' list "
@@ -69,13 +87,99 @@ def load_manifest(source: Union[str, dict, list]) -> List[Dict[str, Any]]:
     return out
 
 
+def load_tables(source: Union[str, dict, list]) -> List[Dict[str, Any]]:
+    """The manifest's `tables:` preload specs (empty for bare lists)."""
+    if isinstance(source, str):
+        with open(source) as f:
+            source = json.load(f)
+    if not isinstance(source, dict):
+        return []
+    tables = source.get("tables") or []
+    out = []
+    for i, spec in enumerate(tables):
+        if not isinstance(spec, dict) or "table" not in spec:
+            raise ValueError(
+                f"warmup table #{i} needs an object with 'table' "
+                f"(got {spec!r})")
+        unknown = sorted(set(spec) - {"table", "columns"})
+        if unknown:
+            raise ValueError(f"warmup table #{i}: unknown keys {unknown}")
+        out.append(spec)
+    return out
+
+
+def preload_table(runner, table: str,
+                  columns: Optional[List[str]] = None) -> Dict[str, Any]:
+    """Read one table through its connector and promote the columns
+    into the runner's device table cache — the first real scan is then
+    an HBM hit with zero host->device staging."""
+    import jax
+
+    if not bool(runner.session.get("table_cache_enabled")):
+        # promoting into a tier no query will ever consult would pin
+        # HBM (pool cache reservation) for nothing
+        raise ValueError(
+            "table_cache_enabled is false on this server — `tables:` "
+            "warmup entries need the device table cache on")
+    qname = runner.metadata.resolve_table_name(
+        tuple(table.split(".")), runner.session)
+    conn = runner.catalogs.get(qname.catalog)
+    handle = conn.metadata.get_table_handle(qname.schema_table)
+    if handle is None:
+        raise ValueError(f"table not found: {table}")
+    all_handles = conn.metadata.get_column_handles(handle)
+    if columns:
+        by_name = {c.name: c for c in all_handles}
+        missing = [c for c in columns if c not in by_name]
+        if missing:
+            raise ValueError(f"{table}: unknown columns {missing}")
+        handles = [by_name[c] for c in columns]
+    else:
+        handles = list(all_handles)
+    stats = conn.metadata.get_table_statistics(handle)
+    rows = int(stats.row_count or 0)
+    cap = 1 << 16
+    while cap < rows and cap < (1 << 22):
+        cap *= 2
+    cache = runner._table_cache
+    gen = cache.generation()    # before reading: the promotion guard
+    pages = []
+    for split in conn.split_manager.get_splits(handle, target_splits=1):
+        pages.extend(conn.page_source.pages(split, handles, cap))
+    take = getattr(conn, "take_scan_stats", None)
+    if take is not None:
+        take()      # drop the preload's thread-local scan counters
+    counts = [int(c) for c in jax.device_get(
+        [p.num_rows for p in pages])] if pages else []
+    tkey = (qname.catalog, qname.schema, qname.table)
+    cache.configure(int(runner.session.get("table_cache_max_bytes")),
+                    int(runner.session.get("table_cache_min_scans")))
+    cache.note_scan(tkey, [c.name for c in handles])
+    resident = cache.promote_from_pages(
+        tkey, [(c.name, c) for c in handles], pages, counts, gen=gen)
+    return {"table": str(qname), "columns": len(handles),
+            "rows": int(sum(counts)), "resident": bool(resident)}
+
+
 def apply_warmup(runner, source: Union[str, dict, list]
                  ) -> List[Dict[str, Any]]:
     """Run the manifest against `runner` (the server's BASE runner, so
     PREPAREd names land in the shared map every request can EXECUTE).
-    Returns the per-statement report: what warmed, what it cost, what
-    the first real request will now skip."""
+    Preloads `tables:` into the device table cache first (data warmup),
+    then PREPAREs/executes the statements (plan + kernel warmup).
+    Returns the per-entry report: what warmed, what it cost, what the
+    first real request will now skip."""
     report: List[Dict[str, Any]] = []
+    for spec in load_tables(source):
+        entry: Dict[str, Any] = {"table": spec["table"]}
+        t0 = time.perf_counter()
+        try:
+            entry.update(preload_table(runner, spec["table"],
+                                       spec.get("columns")))
+            entry["wall_s"] = round(time.perf_counter() - t0, 4)
+        except Exception as e:  # noqa: BLE001 — warm what we can
+            entry["error"] = f"{type(e).__name__}: {str(e)[:160]}"
+        report.append(entry)
     for spec in load_manifest(source):
         name = spec.get("name")
         label = name or spec["sql"][:60]

@@ -173,6 +173,7 @@ class TrinoServer:
                  streaming: bool = True,
                  result_cache: bool = True,
                  scan_cache: bool = True,
+                 table_cache: bool = True,
                  stream_ring_chunks: int = 16,
                  stream_stall_timeout_s: float = 300.0,
                  warmup_manifest=None,
@@ -191,6 +192,11 @@ class TrinoServer:
             runner.session.set("result_cache_enabled", True)
         if scan_cache:
             runner.session.set("scan_cache_enabled", True)
+        if table_cache:
+            # the device-resident hot-table tier (exec/table_cache.py):
+            # server sessions promote hot columns into HBM across
+            # queries; warmup `tables:` entries preload them at start()
+            runner.session.set("table_cache_enabled", True)
         # warmup manifest (serve/warmup.py): held here, applied in
         # start() BEFORE the executors spin up so the first real request
         # finds a warm plan cache and warm kernels
