@@ -1,0 +1,270 @@
+"""Query-history tier (obs/history.py) + listener-error accounting.
+
+The ring retains terminal queries past the live tracker's pruning bound:
+bounded FIFO retention, failed/canceled queries kept with the full error
+taxonomy, `system.runtime.completed_queries` on the wire, per-group
+latency histograms in the Prometheus scrape, and the listener bus
+logging broken plugins once while counting every failure.
+"""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from trino_tpu.exec import LocalQueryRunner
+from trino_tpu.obs.history import (HISTORY, CompletedQuery, QueryHistory,
+                                   record_from_info)
+from trino_tpu.obs.listeners import (EventListener, register_listener,
+                                     unregister_listener)
+
+# value: any Go-parseable float — negative-exponent scientific notation
+# (5.1e-05) is legal exposition (a 51us histogram sum renders that way)
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+    r"(-?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?|\+Inf|NaN)$")
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner.tpch("tiny")
+
+
+def _entry(i: int, state: str = "FINISHED") -> CompletedQuery:
+    return CompletedQuery(query_id=f"hq_{i}", state=state, user="t",
+                          query=f"SELECT {i}", ended_at=float(i))
+
+
+# ------------------------------------------------------------- ring unit
+
+
+def test_ring_bounded_retention_and_eviction_order():
+    ring = QueryHistory(max_entries=3)
+    for i in range(5):
+        ring.record(_entry(i))
+    ids = [c.query_id for c in ring.list()]
+    # FIFO by completion order: oldest evicted first, newest retained
+    assert ids == ["hq_2", "hq_3", "hq_4"]
+    assert ring.stats() == {"entries": 3, "max_entries": 3,
+                            "recorded": 5, "evicted": 2}
+    assert ring.get("hq_0") is None and ring.get("hq_4") is not None
+
+
+def test_ring_resize_keeps_newest():
+    ring = QueryHistory(max_entries=8)
+    for i in range(6):
+        ring.record(_entry(i))
+    ring.resize(2)
+    assert [c.query_id for c in ring.list()] == ["hq_4", "hq_5"]
+    ring.resize(4)     # growth keeps what survived
+    ring.record(_entry(9))
+    assert [c.query_id for c in ring.list()] == ["hq_4", "hq_5", "hq_9"]
+
+
+# --------------------------------------------------------- bus feeding
+
+
+def test_completed_query_recorded_with_time_split(runner):
+    sql = "SELECT count(*) AS hist_probe FROM nation"
+    runner.execute(sql)
+    entry = next(c for c in reversed(HISTORY.list()) if c.query == sql)
+    assert entry.state == "FINISHED" and entry.rows == 1
+    assert entry.stats is not None
+    assert "device_time_ms" in entry.stats
+    assert entry.compile_time_ms >= 0.0
+    assert entry.trace is not None     # span dump retained for /trace
+
+
+def test_failed_query_retained_with_error_taxonomy(runner):
+    """Failed queries keep the full taxonomy: name, family, and the
+    retryable bit resolved from the process error-code registry."""
+    runner.session.set("retry_policy", "NONE")
+    runner.session.set("fault_injection_rate", 1.0)
+    runner.session.set("fault_injection_sites", "fragment")
+    sql = "SELECT sum(s_acctbal) AS hist_fail_probe FROM supplier"
+    try:
+        with pytest.raises(Exception):
+            runner.execute(sql)
+    finally:
+        for prop in ("retry_policy", "fault_injection_rate",
+                     "fault_injection_sites"):
+            runner.session.properties.pop(prop, None)
+    entry = next(c for c in reversed(HISTORY.list()) if c.query == sql)
+    assert entry.state == "FAILED"
+    assert entry.error and entry.error_name
+    assert entry.error_type in ("USER_ERROR", "INTERNAL_ERROR",
+                                "INSUFFICIENT_RESOURCES", "EXTERNAL")
+    assert entry.retryable is True     # injected faults classify retryable
+    assert entry.faults_injected >= 1
+
+
+def test_canceled_query_retained():
+    from trino_tpu.exec.query_tracker import TRACKER
+    info = TRACKER.begin("SELECT 'hist-cancel'", user="t")
+    TRACKER.running(info)
+    TRACKER.cancel(info)
+    entry = next(c for c in reversed(HISTORY.list())
+                 if c.query_id == info.query_id)
+    assert entry.state == "CANCELED"
+    assert entry.error_name == "USER_CANCELED"
+    assert entry.error_type == "USER_ERROR" and entry.retryable is False
+
+
+def test_history_outlives_tracker_pruning():
+    """The acceptance clause: a just-finished query's stats stay
+    queryable AFTER the tracker entry is pruned (tiny tracker here; the
+    ring is fed from the listener bus, not from tracker retention)."""
+    from trino_tpu.exec.query_tracker import QueryTracker
+    tracker = QueryTracker(keep=1)
+    infos = []
+    for i in range(3):
+        info = tracker.begin(f"SELECT 'prune_{i}'", user="t")
+        tracker.running(info)
+        tracker.finish(info, rows=1)
+        infos.append(info)
+    live_ids = {q.query_id for q in tracker.list()}
+    assert infos[0].query_id not in live_ids      # pruned from the tracker
+    recorded = {c.query_id for c in HISTORY.list()}
+    assert all(i.query_id in recorded for i in infos)   # all in history
+
+
+def test_record_from_info_roundtrip(runner):
+    from trino_tpu.exec.query_tracker import TRACKER
+    sql = "SELECT count(*) AS hist_rt_probe FROM region"
+    runner.execute(sql)
+    info = next(q for q in TRACKER.list() if q.query == sql)
+    rec = record_from_info(info)
+    assert rec.query_id == info.query_id and rec.state == "FINISHED"
+    assert rec.cpu_time_ms == info.cpu_time_ms
+
+
+# ---------------------------------------------------------- SQL + wire
+
+
+def test_completed_queries_table(runner):
+    sql = "SELECT count(*) AS hist_table_probe FROM orders"
+    runner.execute(sql)
+    rows = runner.execute(
+        "SELECT query_id, state, rows, device_time_ms, compile_time_ms, "
+        "error_name, ended_at_ms FROM system.runtime.completed_queries "
+        f"WHERE query = '{sql}'").rows
+    assert rows, "completed query missing from history table"
+    qid, state, nrows, dev_ms, comp_ms, err, ended = rows[-1]
+    assert state == "FINISHED" and nrows == 1 and err is None
+    assert dev_ms >= 0.0 and comp_ms >= 0.0 and ended > 0
+
+
+def test_completed_queries_and_query_api_over_http(runner):
+    from trino_tpu.server import TrinoServer
+    srv = TrinoServer(LocalQueryRunner.tpch("tiny"),
+                      history_max_entries=64).start()
+    try:
+        def post(sql):
+            req = urllib.request.Request(
+                f"{srv.base_uri}/v1/statement", data=sql.encode(),
+                method="POST")
+            req.add_header("X-Trino-User", "t")
+            with urllib.request.urlopen(req) as resp:
+                payload = json.loads(resp.read())
+            rows = list(payload.get("data") or [])
+            while "nextUri" in payload:
+                with urllib.request.urlopen(payload["nextUri"]) as resp:
+                    payload = json.loads(resp.read())
+                rows.extend(payload.get("data") or [])
+            return payload["id"], rows
+
+        probe = "SELECT count(*) AS http_hist_probe FROM nation"
+        qid, _ = post(probe)
+        # the finished query is visible through completed_queries ON THE
+        # WIRE (second statement scans the history ring)
+        _, rows = post("SELECT query_id, state FROM "
+                       "system.runtime.completed_queries "
+                       f"WHERE query_id = '{qid}'")
+        assert rows == [[qid, "FINISHED"]], rows
+        # GET /v1/query/{id}: live tracker first
+        with urllib.request.urlopen(
+                f"{srv.base_uri}/v1/query/{qid}") as resp:
+            info = json.loads(resp.read())
+        assert info["state"] == "FINISHED" and info["rows"] == 1
+        assert "compile_time_ms" in info["stats"]
+        # GET /v1/query/{id}/trace: Chrome-trace JSON on demand
+        with urllib.request.urlopen(
+                f"{srv.base_uri}/v1/query/{qid}/trace") as resp:
+            trace = json.loads(resp.read())
+        assert any(e.get("ph") == "X" for e in trace["traceEvents"])
+        # history fallback: an id only the ring knows still resolves
+        HISTORY.record(CompletedQuery(
+            query_id="hist_only_qid", state="FINISHED", user="t",
+            query="SELECT 1", ended_at=1.0,
+            trace={"name": "q", "kind": "query", "start_ms": 0.0,
+                   "wall_ms": 1.0}))
+        with urllib.request.urlopen(
+                f"{srv.base_uri}/v1/query/hist_only_qid") as resp:
+            info = json.loads(resp.read())
+        assert info["source"] == "history"
+        with urllib.request.urlopen(
+                f"{srv.base_uri}/v1/query/hist_only_qid/trace") as resp:
+            trace = json.loads(resp.read())
+        assert trace["traceEvents"], trace
+        # unknown id: 404
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"{srv.base_uri}/v1/query/does_not_exist")
+        assert exc.value.code == 404
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------------- histograms
+
+
+def test_group_wall_histogram_in_scrape(runner):
+    from trino_tpu.obs.metrics import REGISTRY
+    runner.session.set("resource_group", "hist.slo")
+    try:
+        runner.execute("SELECT count(*) FROM part")
+    finally:
+        runner.session.properties.pop("resource_group", None)
+    text = REGISTRY.render()
+    assert re.search(r'trino_tpu_group_wall_seconds_bucket\{[^}]*'
+                     r'group="hist\.slo"[^}]*outcome="FINISHED"',
+                     text), text
+    # well-formed exposition: every non-comment line parses, and the
+    # labeled histogram fabricates no unlabeled phantom series
+    for line in text.strip().splitlines():
+        if not line.startswith("#"):
+            assert _PROM_LINE.match(line), line
+    assert not re.search(r"^trino_tpu_group_wall_seconds_bucket\{le=",
+                         text, re.MULTILINE), \
+        "phantom unlabeled group series"
+    # sum/count series accompany the buckets (histogram contract)
+    assert "trino_tpu_group_wall_seconds_sum" in text
+    assert "trino_tpu_group_wall_seconds_count" in text
+
+
+# ------------------------------------------------------ listener errors
+
+
+def test_listener_errors_counted_and_logged_once(runner, caplog):
+    from trino_tpu.obs.metrics import LISTENER_ERRORS_TOTAL
+
+    class HistBrokenListener(EventListener):
+        def query_completed(self, event):
+            raise RuntimeError("plugin bug")
+
+    def count():
+        return sum(v for _, labels, v in LISTENER_ERRORS_TOTAL.samples()
+                   if ("listener", "HistBrokenListener") in labels)
+
+    broken = register_listener(HistBrokenListener())
+    try:
+        with caplog.at_level("ERROR", logger="trino_tpu.obs"):
+            assert runner.execute("SELECT 1").rows == [(1,)]
+            assert runner.execute("SELECT 2").rows == [(2,)]
+    finally:
+        unregister_listener(broken)
+    assert count() >= 2, "every failure counts"
+    logged = [r for r in caplog.records
+              if "HistBrokenListener" in r.getMessage()]
+    assert len(logged) == 1, "broken plugin logs once, not per query"

@@ -239,3 +239,36 @@ else:
         finally:
             dist.execute("RESET SESSION table_cache_enabled")
             dist.execute("DROP TABLE lake.default.mesh_hot")
+
+    def test_mesh_operator_stats_parity(dist, oracle):
+        """Round-13 acceptance: collect_operator_stats no longer forces
+        mesh programs off the fused data plane. The instrumented q1 run
+        keeps exchanges_staged == 0 with the SAME fused-exchange count
+        as the plain run, stays oracle-correct, and emits program-level
+        operator rows with cost-apportioned device walls for the
+        co-scheduled child fragments."""
+        engine_sql, oracle_sql, ordered = QUERIES["q1"]
+        dist.execute(engine_sql)
+        plain = dict(dist.last_query_stats)
+        assert plain["exchanges_fused"] > 0, plain
+        dist.execute("SET SESSION collect_operator_stats = true")
+        try:
+            got = dist.execute(engine_sql)
+            st = dict(dist.last_query_stats)
+        finally:
+            dist.execute("RESET SESSION collect_operator_stats")
+        # the data plane did not change: still fused, nothing staged
+        assert st["exchanges_staged"] == 0, st
+        assert st["exchanges_fused"] == plain["exchanges_fused"], \
+            (plain["exchanges_fused"], st["exchanges_fused"])
+        assert st["mesh_devices"] == _REQUIRED_DEVICES, st
+        # program-level stats rows present: the mesh child fragment's
+        # nodes (scan/partial agg) report cost-apportioned device walls
+        ops = st.get("operators", [])
+        assert ops, st
+        names = {o["name"] for o in ops}
+        assert "TableScanNode" in names, names
+        assert st["device_time_ms"] > 0, st
+        assert any(o["device_ms"] > 0 for o in ops), ops
+        expected = oracle.execute(oracle_sql or engine_sql).fetchall()
+        assert_same(got.rows, expected, ordered)

@@ -311,8 +311,11 @@ def test_broken_listener_does_not_fail_queries(runner):
 
 # ---------------------------------------------------------------- metrics
 
+# value: any Go-parseable float — negative-exponent scientific notation
+# (5.1e-05) is legal exposition (a 51us histogram sum renders that way)
 _PROM_LINE = re.compile(
-    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? (-?[0-9.eE+]+|\+Inf|NaN)$")
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+    r"(-?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?|\+Inf|NaN)$")
 
 
 def test_metrics_registry_renders_prometheus_text(runner):

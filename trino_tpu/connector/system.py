@@ -32,7 +32,22 @@ TABLES: Dict[str, tuple] = {
         ("resource_group", T.VarcharType()),
         ("pool_reserved_bytes", T.BIGINT), ("pool_peak_bytes", T.BIGINT),
         ("memory_kills", T.BIGINT), ("leaked_bytes", T.BIGINT),
-        ("spilled_bytes", T.BIGINT)),
+        ("spilled_bytes", T.BIGINT),
+        ("device_time_ms", T.DOUBLE), ("compile_time_ms", T.DOUBLE)),
+    # the query-history ring (obs/history.py): terminal queries retained
+    # past the live tracker's pruning bound, with the device/compile/host
+    # time split and the full error taxonomy — the post-incident table
+    "completed_queries": (
+        ("query_id", T.VarcharType()), ("state", T.VarcharType()),
+        ("user", T.VarcharType()), ("query", T.VarcharType()),
+        ("rows", T.BIGINT), ("bytes", T.BIGINT),
+        ("wall_ms", T.BIGINT), ("cpu_time_ms", T.BIGINT),
+        ("device_time_ms", T.DOUBLE), ("compile_time_ms", T.DOUBLE),
+        ("error", T.VarcharType()), ("error_name", T.VarcharType()),
+        ("error_type", T.VarcharType()), ("retryable", T.BOOLEAN),
+        ("retries", T.BIGINT), ("faults_injected", T.BIGINT),
+        ("resource_group", T.VarcharType()),
+        ("peak_memory_bytes", T.BIGINT), ("ended_at_ms", T.BIGINT)),
     "tasks": (
         ("query_id", T.VarcharType()), ("task_id", T.VarcharType()),
         ("state", T.VarcharType()), ("rows", T.BIGINT),
@@ -85,8 +100,20 @@ def _rows_for(table: str) -> List[tuple]:
                  max(q.memory_kills,
                      q.mem.kills if q.mem is not None else 0),
                  q.leaked_bytes,
-                 (q.stats or {}).get("spilled_bytes", 0))
+                 (q.stats or {}).get("spilled_bytes", 0),
+                 float((q.stats or {}).get("device_time_ms", 0) or 0),
+                 float((q.stats or {}).get("compile_time_ms", 0) or 0))
                 for q in TRACKER.list()]
+    if table == "completed_queries":
+        from trino_tpu.obs.history import HISTORY
+        return [(c.query_id, c.state, c.user, c.query, c.rows,
+                 c.output_bytes, c.wall_ms, c.cpu_time_ms,
+                 c.device_time_ms, c.compile_time_ms, c.error,
+                 c.error_name, c.error_type,
+                 bool(c.retryable) if c.retryable is not None else None,
+                 c.retries, c.faults_injected, c.resource_group,
+                 c.peak_memory_bytes, int(c.ended_at * 1000))
+                for c in HISTORY.list()]
     if table == "tasks":
         # single-controller engine: one task per query (the mesh's shards
         # are lanes inside one program, not separately tracked tasks)

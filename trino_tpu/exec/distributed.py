@@ -219,7 +219,8 @@ class ShardExecutionPlanner(LocalExecutionPlanner):
         partial_op = compose_chain(
             src.pending, ("agg-partial", key_channels, specs),
             lambda: hash_aggregate(list(key_channels), list(specs),
-                                   Step.PARTIAL))
+                                   Step.PARTIAL),
+            tail_slot=self._slot(node))
 
         def gen():
             for page in src.pages:
@@ -422,15 +423,17 @@ class DistributedQueryRunner(LocalQueryRunner):
                         ) -> Optional[List[Optional[Page]]]:
         """Co-scheduled mesh execution of one child fragment chain, or
         None to use the dispatch-loop fallback. Disabled under fault
-        injection (chaos must see per-shard sites) and operator-level
-        stats (node-boundary instrumentation needs the Python loop)."""
+        injection (chaos must see per-shard sites). Operator-level stats
+        runs STAY on the mesh (round 13): the program emits
+        program-level operator rows with cost-apportioned device walls
+        (mesh_exec._record_program_stats) instead of falling back to the
+        per-shard dispatch loop — turning stats on no longer changes the
+        data plane (exchanges stay fused)."""
         if not bool(self.session.get("mesh_execution")):
             return None
         if self.mesh.n < 2:
             return None
         if self._faults is not None:
-            return None
-        if self._collector is not None and self._collector.operator_level:
             return None
         from trino_tpu.exec import mesh_exec
         try:

@@ -20,6 +20,18 @@ separate resident kernel after). Counted separately so bench/metrics can
 see the parameterized workload; note it counts value CHANGES against the
 last call, not distinct literal sets, so alternating parameters re-count.
 
+Compile-vs-execute accounting (round 13): `profiled_kernel` dispatches the
+chain/program hot paths through per-input-signature AOT executables
+(`fn.lower(*args).compile()`) managed HERE instead of inside jax.jit's
+opaque dispatch cache. That makes every XLA compile an explicit, timed
+event: the wall, the HLO instruction count, and the cost-model
+flops/bytes record against the process counters AND the calling query's
+collector (thread-local observer), so `compile_time_ms` in query stats is
+measured, not inferred from cold-vs-warm deltas. A signature mismatch at
+call time (defensive — shardings or weak types drifting) falls back to
+the plain jitted callable rather than failing the query, counted as
+`aot_fallbacks`.
+
 Interaction with the on-disk persistent XLA cache
 (trino_tpu.enable_persistent_cache / TRINO_TPU_COMPILATION_CACHE_DIR): this
 LRU caches *loaded executables + traces in-process*; the persistent cache
@@ -33,12 +45,14 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
 from typing import Any, Callable, Dict, Hashable, Optional, Tuple
 
 import jax
 import numpy as np
 
-# key -> [jitted kernel, last-seen flattened param signature or None]
+# key -> [jitted kernel, last-seen flattened param signature or None,
+#         {input signature -> AOT compiled executable} (profiled path)]
 _CACHE: "collections.OrderedDict[Hashable, list]" = \
     collections.OrderedDict()
 # concurrent queries (the server's executor pool) share this cache; the
@@ -53,19 +67,30 @@ _LOCK = threading.RLock()   # reentrant: a build() may consult the cache
 _MAX_KERNELS = 512
 
 # process-lifetime hit/miss/param-hit/eviction counters (exported by
-# obs/metrics.py), plus a per-thread observer slot: the runner installs its
-# query's QueryStatsCollector for the duration of execute(), so
-# hits/misses attribute to the query whose executor thread triggered them
-# (server concurrency runs each query on its own thread)
-_STATS = {"hits": 0, "misses": 0, "param_hits": 0, "evictions": 0}
+# obs/metrics.py) plus compile accounting: XLA compiles performed through
+# the profiled path, their summed wall, summed HLO instruction counts,
+# and cost-model flops/bytes — the process-level compile ledger behind
+# every query's compile_time_ms. Plus a per-thread observer slot: the
+# runner installs its query's QueryStatsCollector for the duration of
+# execute(), so hits/misses/compiles attribute to the query whose
+# executor thread triggered them.
+_STATS = {"hits": 0, "misses": 0, "param_hits": 0, "evictions": 0,
+          "compiles": 0, "compile_s": 0.0, "hlo_ops": 0,
+          "aot_fallbacks": 0}
 _TLS = threading.local()
 
 
 def set_observer(observer) -> None:
     """Install/clear (None) this thread's per-query jit observer — an
     object with jit_hit(key)/jit_miss(key) and optionally
-    jit_param_hit(key)."""
+    jit_param_hit(key) / add_compile(wall_s, hlo_ops, flops, nbytes)."""
     _TLS.observer = observer
+
+
+def get_observer():
+    """This thread's per-query observer (the executing query's
+    QueryStatsCollector), or None outside runner.execute()."""
+    return getattr(_TLS, "observer", None)
 
 
 def _param_signature(params) -> Tuple:
@@ -88,6 +113,39 @@ def _param_signature(params) -> Tuple:
     return tuple(out)
 
 
+def _lookup(key: Hashable, build: Callable[[], Callable],
+            params: Optional[Any]) -> list:
+    """Shared LRU lookup: returns the entry list, counting hit/miss and
+    param-hit exactly as before, and notifying the thread observer."""
+    sig = None if params is None else _param_signature(params)
+    param_hit = False
+    with _LOCK:
+        entry = _CACHE.get(key)
+        if entry is None:
+            fn = jax.jit(build())
+            while len(_CACHE) >= _MAX_KERNELS:
+                _CACHE.popitem(last=False)
+                _STATS["evictions"] += 1
+            entry = _CACHE[key] = [fn, sig, {}]
+            _STATS["misses"] += 1
+            miss = True
+        else:
+            _CACHE.move_to_end(key)
+            _STATS["hits"] += 1
+            miss = False
+            if sig is not None:
+                param_hit = entry[1] is not None and entry[1] != sig
+                entry[1] = sig
+                if param_hit:
+                    _STATS["param_hits"] += 1
+    observer = get_observer()
+    if observer is not None:
+        (observer.jit_miss if miss else observer.jit_hit)(key)
+        if param_hit and hasattr(observer, "jit_param_hit"):
+            observer.jit_param_hit(key)
+    return entry
+
+
 def cached_kernel(key: Hashable, build: Callable[[], Callable],
                   params: Optional[Any] = None) -> Callable:
     """Return the jitted kernel for `key`, building+jitting it on first use.
@@ -98,34 +156,90 @@ def cached_kernel(key: Hashable, build: Callable[[], Callable],
     to the kernel — used ONLY for hit attribution (param-hit vs plain hit),
     never for keying: the whole point is that the key excludes it.
     """
-    sig = None if params is None else _param_signature(params)
-    param_hit = False
+    return _lookup(key, build, params)[0]
+
+
+def _aot_compile(key: Hashable, fn, args: tuple, arg_sig, aot: dict):
+    """Lower + compile one executable for this input signature, timed:
+    the explicit XLA-compile event behind compile_time_ms. Records the
+    wall, the HLO instruction count, and the cost-model flops/bytes on
+    the process ledger and the calling query's collector. Concurrent
+    losers of the publish race discard their duplicate and record
+    NOTHING — the ledger counts real resident executables, not wasted
+    work (full in-flight dedup would need a per-signature latch; the
+    duplicated compile is rare and harmless, the double-count would
+    not be)."""
+    from trino_tpu.obs import profiler
+    t0 = time.perf_counter()
+    lowered = fn.lower(*args)
+    compiled = lowered.compile()
+    wall = time.perf_counter() - t0
+    ops = profiler.hlo_op_count(lowered)
+    cost = profiler.cost_dict(lowered)
     with _LOCK:
-        entry = _CACHE.get(key)
-        if entry is None:
-            fn = jax.jit(build())
-            while len(_CACHE) >= _MAX_KERNELS:
-                _CACHE.popitem(last=False)
-                _STATS["evictions"] += 1
-            _CACHE[key] = [fn, sig]
-            _STATS["misses"] += 1
-            miss = True
-        else:
-            _CACHE.move_to_end(key)
-            fn = entry[0]
-            _STATS["hits"] += 1
-            miss = False
-            if sig is not None:
-                param_hit = entry[1] is not None and entry[1] != sig
-                entry[1] = sig
-                if param_hit:
-                    _STATS["param_hits"] += 1
-    observer = getattr(_TLS, "observer", None)
-    if observer is not None:
-        (observer.jit_miss if miss else observer.jit_hit)(key)
-        if param_hit and hasattr(observer, "jit_param_hit"):
-            observer.jit_param_hit(key)
-    return fn
+        existing = aot.get(arg_sig)
+        if existing is not None:
+            return existing     # lost the race: one executable, one event
+        aot[arg_sig] = compiled
+        _STATS["compiles"] += 1
+        _STATS["compile_s"] += wall
+        _STATS["hlo_ops"] += ops
+    observer = get_observer()
+    if observer is not None and hasattr(observer, "add_compile"):
+        observer.add_compile(wall, hlo_ops=ops,
+                             flops=cost.get("flops", 0.0),
+                             nbytes=cost.get("bytes", 0.0))
+    return compiled
+
+
+def profiled_kernel(key: Hashable, build: Callable[[], Callable],
+                    params: Optional[Any] = None) -> Callable:
+    """cached_kernel with compile-vs-execute accounting: dispatch runs
+    through per-input-signature AOT executables owned by the cache entry,
+    so every XLA compile is a timed, attributed event instead of a stall
+    hidden inside jax.jit's first call. Same key space, same hit/miss/
+    param-hit counters as cached_kernel — a key warmed by one path is
+    warm for the other."""
+    entry = _lookup(key, build, params)
+    fn = entry[0]
+    if len(entry) < 3:          # entry created by an older layout
+        with _LOCK:
+            while len(entry) < 3:
+                entry.append({})
+    aot: Dict[Any, Any] = entry[2]
+    from trino_tpu.obs import profiler
+
+    def _fallback(*args):
+        # never fail (or silently slow) a query over accounting: the
+        # plain jitted callable always works; the counter makes a
+        # systematic fallback visible in /v1/metrics
+        with _LOCK:
+            _STATS["aot_fallbacks"] += 1
+        return fn(*args)
+
+    def dispatch(*args):
+        # per-dispatch signature cost is ~10us of pytree flattening —
+        # small against the >=100us python dispatch + kernel launch a
+        # page already pays, and it is what detects the retrace
+        # (new-signature) compiles the accounting exists to expose
+        try:
+            arg_sig = profiler.tree_signature(args)
+            compiled = aot.get(arg_sig)
+            if compiled is None:
+                compiled = _aot_compile(key, fn, args, arg_sig, aot)
+        except Exception:
+            return _fallback(*args)
+        try:
+            return compiled(*args)
+        except (TypeError, ValueError):
+            # aval/sharding mismatch at CALL time (signature drift the
+            # tree signature failed to capture) — re-dispatch through
+            # the jitted callable. Real kernel failures (device OOM,
+            # runtime errors) are neither TypeError nor ValueError and
+            # PROPAGATE: swallowing them would silently re-execute the
+            # whole program at the worst possible moment.
+            return _fallback(*args)
+    return dispatch
 
 
 def cache_info() -> int:
@@ -135,12 +249,17 @@ def cache_info() -> int:
 def stats() -> dict:
     """Snapshot for metrics: resident kernels + lifetime hits/misses/
     param-hits (hit on a canonical key with changed literal values) /
-    evictions."""
+    evictions, and the compile ledger (profiled-path XLA compiles, their
+    summed wall and HLO instruction counts, AOT dispatch fallbacks)."""
     with _LOCK:
         return {"size": len(_CACHE), "hits": _STATS["hits"],
                 "misses": _STATS["misses"],
                 "param_hits": _STATS["param_hits"],
-                "evictions": _STATS["evictions"]}
+                "evictions": _STATS["evictions"],
+                "compiles": _STATS["compiles"],
+                "compile_s": _STATS["compile_s"],
+                "hlo_ops": _STATS["hlo_ops"],
+                "aot_fallbacks": _STATS["aot_fallbacks"]}
 
 
 def clear():  # for tests

@@ -102,11 +102,20 @@ class PageStream:
     into the composed kernel as traced scalar operands — so every literal
     variant of a chain shape shares one XLA executable. Builders therefore
     return fn(page, params), with params=() for literal-free ops.
+
+    Operator attribution (round 13): under operator-level stats
+    collection each entry may carry a FOURTH element — the owning plan
+    node's OperatorStats slot. The slot never enters the chain cache key
+    (canonical keys stay literal- and query-free), and it never splits
+    the chain: compose_chain times the fused dispatch once and
+    apportions the measured wall across the tagged entries by XLA cost
+    analysis (obs/profiler.py). Entries without a slot are plain
+    3-tuples, so the untagged fast path is byte-identical to before.
     """
 
     pages: Iterator[Page]
     symbols: Tuple[Symbol, ...]
-    pending: Tuple[Tuple[object, object, tuple], ...] = ()
+    pending: Tuple[tuple, ...] = ()
 
     def with_op(self, key, builder, params=()) -> "PageStream":
         return PageStream(self.pages, self.symbols,
@@ -131,12 +140,22 @@ def chain_params(pending) -> Tuple:
     return tuple(tuple(e[2]) for e in pending)
 
 
-def compose_chain(pending, tail_key=None, tail_builder=None):
+def compose_chain(pending, tail_key=None, tail_builder=None,
+                  tail_slot=None):
     """One cached jitted kernel running every pending transform (+ optional
     tail op, e.g. a partial aggregation) in a single device program. The
     cache key holds only canonical (literal-free) op keys; hoisted literal
     values are passed per call, so `fn(page)` for a new literal variant of
-    a warm chain dispatches the existing executable."""
+    a warm chain dispatches the existing executable.
+
+    Dispatch goes through the jit cache's profiled path, so every XLA
+    compile a chain triggers is a timed, query-attributed event
+    (compile_time_ms). When any entry carries an OperatorStats slot
+    (operator-level collection — `tail_slot` is the blocking consumer's
+    slot for fused tails), each dispatch is additionally fenced at CHAIN
+    granularity and the measured device wall is apportioned across the
+    chain's operators by XLA cost analysis: stats collection observes
+    the SAME executables the plain query runs — no chain splitting."""
     if not pending and tail_builder is None:
         return None
     key = ("chain",) + chain_keys(pending) + \
@@ -154,10 +173,82 @@ def compose_chain(pending, tail_key=None, tail_builder=None):
                 page = tail(page)
             return page
         return run
-    kernel = cached_kernel(key, build, params=param_groups)
+    from trino_tpu.exec.jit_cache import profiled_kernel
+    kernel = profiled_kernel(key, build, params=param_groups)
+
+    slots = tuple(e[3] if len(e) > 3 else None for e in pending)
+    if all(s is None for s in slots) and tail_slot is None:
+        def call(page):
+            return kernel(page, param_groups)
+        return call
+    return _attributed_chain_call(kernel, key, pending, param_groups,
+                                  slots, tail_builder, tail_slot)
+
+
+class DeviceShareSlot:
+    """Entry tag that attributes ONLY the device share to a slot — for
+    operators whose boundary wrapper already measures inclusive wall and
+    counts output rows (masked TopN: its kernel rides the chain, but its
+    node's output stream is separately wrapped — full tagging would
+    double-count wall and rows on one slot)."""
+
+    def __init__(self, st):
+        self.st = st
+
+
+def _attributed_chain_call(kernel, key, pending, param_groups, slots,
+                           tail_builder, tail_slot):
+    """The operator-attribution dispatch wrapper: fence once per chain
+    dispatch, subtract any compile wall that landed inside the timed
+    region (a first-signature dispatch AOT-compiles in place), and split
+    the remaining device wall across the tagged operators by the
+    profiler's cost weights. Fused chain operators jointly report the
+    chain's EXIT rows/pages/bytes (they are one kernel — intermediate
+    row counts are not observable without splitting the program, which
+    is exactly what this path exists to avoid). Cost weights resolve
+    ONCE per stream from the first page (they are ratios of a static
+    cost model — per-page re-derivation would just repeat the pytree
+    walk the dispatch already paid)."""
+    import time as _time
+
+    from trino_tpu.exec import jit_cache
+    from trino_tpu.exec.memory import live_page_bytes
+    from trino_tpu.obs import profiler
+
+    weights_box: list = []
 
     def call(page):
-        return kernel(page, param_groups)
+        observer = jit_cache.get_observer()
+        pre_compile = getattr(observer, "compile_time_s", 0.0)
+        t0 = _time.perf_counter()
+        out = kernel(page, param_groups)
+        jax.block_until_ready(out)
+        wall = _time.perf_counter() - t0
+        wall = max(wall - (getattr(observer, "compile_time_s", 0.0)
+                           - pre_compile), 0.0)
+        if observer is not None and hasattr(observer, "add_device_time"):
+            observer.add_device_time(wall)
+        if not weights_box:
+            weights_box.append(profiler.chain_weights(
+                key, pending, page, param_groups, tail_builder))
+        shares = profiler.apportion(wall, weights_box[0])
+        count_exit = isinstance(out, Page)
+        n = int(out.num_rows) if count_exit else 0
+        nbytes = live_page_bytes(out, n) if count_exit else 0
+        for st, share in zip(slots, shares):
+            if isinstance(st, DeviceShareSlot):
+                st.st.device_s += share     # wall/rows owned by wrapper
+            elif st is not None:
+                st.wall_s += share
+                st.device_s += share
+                st.fused = True
+                if count_exit:
+                    st.output_rows += n
+                    st.pages += 1
+                    st.output_bytes += nbytes
+        if tail_slot is not None and tail_builder is not None:
+            tail_slot.device_s += shares[-1]
+        return out
     return call
 
 
@@ -322,19 +413,38 @@ class LocalExecutionPlanner:
             return stream
         return self._instrument(node, stream)
 
+    def _slot(self, node: PlanNode):
+        """The node's OperatorStats slot under operator-level collection
+        (blocking nodes hand it to compose_chain as tail_slot so a fused
+        tail's device share attributes to them), else None."""
+        if self.collector is None or not self.collector.operator_level:
+            return None
+        return self.collector.register(node)
+
     def _instrument(self, node: PlanNode, stream: PageStream) -> PageStream:
-        """Operator-level stats wrapper (EXPLAIN ANALYZE /
-        collect_operator_stats): count rows/pages/bytes and inclusive wall
-        time at every node boundary. Forces the pending chain at each node
-        (the per-operator observability the reference pays for with
-        OperationTimer), so fused-chain timings split into their
-        operators; the row-count read syncs the device once per page, and
-        when the collector fences, `block_until_ready` pins asynchronously
-        dispatched device time on the operator that launched it."""
+        """Operator-level stats (EXPLAIN ANALYZE / collect_operator_stats)
+        WITHOUT chain splitting (round 13). A streaming node's stream
+        still carries its pending fused ops: tag the entries this node
+        contributed (the ones its children haven't tagged) with the
+        node's stats slot and hand the stream on UNCHANGED — the fused
+        chain composes exactly as it would uninstrumented, and
+        compose_chain apportions each dispatch's measured device wall
+        across the tagged operators by XLA cost analysis. Only
+        already-materialized boundaries (leaf scans, blocking operators)
+        get the classic counting wrapper: there is no fused chain to
+        split there, so per-page row/byte counts and inclusive wall are
+        free of observer effects; under EXPLAIN ANALYZE `fence`
+        additionally pins their asynchronously dispatched device work."""
         import time as _time
 
         from trino_tpu.exec.memory import live_page_bytes
         st = self.collector.register(node)
+        if stream.pending:
+            pending = tuple(
+                e if len(e) > 3 and e[3] is not None
+                else (e[0], e[1], e[2], st)
+                for e in stream.pending)
+            return PageStream(stream.pages, stream.symbols, pending)
         fence = self.collector.fence
 
         def gen():
@@ -909,7 +1019,8 @@ class LocalExecutionPlanner:
         # page (ScanFilterAndProjectOperator + partial-agg fusion)
         partial_op = compose_chain(
             src.pending, ("agg-partial", key_channels_t, specs_t),
-            lambda: hash_aggregate(key_channels, specs, Step.PARTIAL))
+            lambda: hash_aggregate(key_channels, specs, Step.PARTIAL),
+            tail_slot=self._slot(node))
         # the adaptive bypass kernel: same fused chain, but the tail maps
         # each row to a PARTIAL-layout state row with NO sort (O(n) — the
         # "Partial Partial Aggregates" bypass for effectively-high NDV);
@@ -917,7 +1028,8 @@ class LocalExecutionPlanner:
         from trino_tpu.ops.aggregate import passthrough_partial
         bypass_op = compose_chain(
             src.pending, ("agg-bypass", key_channels_t, specs_t),
-            lambda: passthrough_partial(key_channels, specs))
+            lambda: passthrough_partial(key_channels, specs),
+            tail_slot=self._slot(node))
 
         # FINAL consumes the partial layout: keys first, then each agg's
         # state columns in sequence
@@ -1381,8 +1493,11 @@ class LocalExecutionPlanner:
             fn = top_n_masked(keys)
             return lambda page, g: fn(page, g[0])
         # per-page partial top-n fused with the upstream chain
+        slot = self._slot(node)
         partial_topn = compose_chain(
-            src.pending + ((key, builder, (count,)),))
+            src.pending + ((key, builder, (count,),
+                            None if slot is None
+                            else DeviceShareSlot(slot)),))
         merge_kernel = cached_kernel(key, lambda: top_n_masked(keys),
                                      params=(count,))
 

@@ -32,10 +32,22 @@ with that site's capacity doubled until everything fits. Programs are
 keyed in the jit cache on (canonical structure, ladder, mesh size), so a
 repeated query shape dispatches a warm executable.
 
-Fallback: any unsupported node (or chaos/operator-stats runs) raises
-MeshUnsupported and the caller transparently uses the per-shard dispatch
-loop; the obs exchange counters then record 'staged' instead of 'fused'
-exchanges, which is exactly what the mesh test suite asserts against.
+Fallback: any unsupported node (or chaos runs — per-shard fault sites
+must fire) raises MeshUnsupported and the caller transparently uses the
+per-shard dispatch loop; the obs exchange counters then record 'staged'
+instead of 'fused' exchanges, which is exactly what the mesh test suite
+asserts against.
+
+Operator-level stats (round 13) run ON the mesh instead of forcing the
+fallback: the converged program dispatch is timed once
+(block_until_ready — the program is one XLA call, so the fence is free
+at this granularity) and emits PROGRAM-LEVEL operator rows — the wall
+apportions across the co-scheduled fragments by their psum'd exchanged
+data volume (the in-program cost signal the aux channel already
+carries), then equally across each fragment's plan nodes; fragment
+roots carry the psum'd rows/bytes that crossed their exchange. Turning
+`collect_operator_stats` on no longer changes the data plane:
+exchanges_staged stays 0 and the same jitted program dispatches.
 """
 
 from __future__ import annotations
@@ -122,6 +134,10 @@ class MeshLowerer:
         self.sites: List[str] = []       # site id -> kind (a2a | join)
         self.key_parts: List = []        # canonical structure key
         self.exchange_sites: List[int] = []
+        # fragment id -> the exchange site that carries ITS output
+        # (program-level stats apportion the measured wall by each
+        # fragment's psum'd exchanged volume read off these sites)
+        self.fragment_sites: Dict[int, int] = {}
         self._skew = bool(session.get("skewed_exchange_enabled"))
         self._skew_k = max(1, int(session.get("skew_heavy_key_limit")))
 
@@ -151,12 +167,14 @@ class MeshLowerer:
         inner = self.lower_node(frag.root, frag)
         return self._lower_exchange(inner, remote.kind,
                                     remote.partition_keys, remote.order_by,
-                                    tuple(frag.root.outputs))
+                                    tuple(frag.root.outputs),
+                                    frag_id=frag.fragment_id)
 
     # ----------------------------------------------------------- exchange
 
     def _lower_exchange(self, inner: Callable, kind: str, partition_keys,
-                        ordering, symbols: Tuple[Symbol, ...]) -> Callable:
+                        ordering, symbols: Tuple[Symbol, ...],
+                        frag_id: Optional[int] = None) -> Callable:
         self._key("exchange", kind,
                   tuple(s.name for s in partition_keys))
         if kind == ExchangeKind.REPARTITION:
@@ -164,6 +182,8 @@ class MeshLowerer:
             keys = tuple(lay[s.name] for s in partition_keys)
             site = self._site("a2a")
             self.exchange_sites.append(site)
+            if frag_id is not None:
+                self.fragment_sites[frag_id] = site
 
             def fn(env: _Env) -> Page:
                 page = inner(env)
@@ -181,6 +201,8 @@ class MeshLowerer:
         # every shard (GATHER consumers read shard 0's replica)
         site = self._site("bcast")
         self.exchange_sites.append(site)
+        if frag_id is not None:
+            self.fragment_sites[frag_id] = site
         sort_op = None
         if kind == ExchangeKind.MERGE and ordering:
             lay = {s.name: i for i, s in enumerate(symbols)}
@@ -224,7 +246,8 @@ class MeshLowerer:
         inner = self.lower_node(child.root, child)
         return self._lower_exchange(inner, node.kind, node.partition_keys,
                                     node.order_by,
-                                    tuple(child.root.outputs))
+                                    tuple(child.root.outputs),
+                                    frag_id=child.fragment_id)
 
     def _lower_FilterNode(self, node: FilterNode, frag) -> Callable:
         src = self.lower_node(node.source, frag)
@@ -480,6 +503,8 @@ class MeshLowerer:
         psite = self._site("a2a")
         bsite = self._site("a2a")
         self.exchange_sites += [psite, bsite]
+        self.fragment_sites[lchild.fragment_id] = psite
+        self.fragment_sites[rchild.fragment_id] = bsite
         self._key("skewed-pair", ppre, bpre, self._skew, self._skew_k)
         return probe_fn, build_fn, ppre, bpre, psite, bsite
 
@@ -715,15 +740,35 @@ def run_co_scheduled(runner, frag: PlanFragment,
                 reserved.append((nbytes, shard))
 
     struct_key = ("mesh-prog", tuple(lowerer.key_parts), mesh.n)
+    col = runner._collector
+    stats_on = col is not None and col.operator_level
+    program_wall = 0.0
     try:
         ladder: Dict[int, int] = {}
+        import time as _time
         for _round in range(_MAX_LADDER_ROUNDS):
             runner._check_deadline()
+            pre_compile = col.compile_time_s if col is not None else 0.0
+            t0 = _time.perf_counter()
             out_global, aux = _run_program(
                 runner, lowerer, top_fn, staged, struct_key, ladder)
+            if stats_on:
+                # the round's device wall: the program is ONE XLA call,
+                # so fencing it costs nothing extra. The clock stops at
+                # block_until_ready — BEFORE the aux host transfer and
+                # the ladder's NumPy analysis (those are host time), and
+                # any in-flight compile wall (profiled dispatch compiled
+                # this signature just now) comes out, so device means
+                # device. Only the CONVERGED round's wall is kept.
+                jax.block_until_ready(out_global)
+                round_wall = max(
+                    _time.perf_counter() - t0
+                    - (col.compile_time_s - pre_compile), 0.0)
             host_aux = jax.device_get(aux)
             bumps = _ladder_bumps(lowerer, host_aux)
             if not bumps:
+                if stats_on:
+                    program_wall = round_wall
                 break
             ladder.update(bumps)
         else:
@@ -747,7 +792,6 @@ def run_co_scheduled(runner, frag: PlanFragment,
                 ledger.reserve(nbytes, "mesh-exchange", device=shard)
                 ledger.free(nbytes, "mesh-exchange", device=shard)
 
-    col = runner._collector
     if col is not None:
         col.mesh_devices = mesh.n
         for site in lowerer.exchange_sites:
@@ -756,7 +800,63 @@ def run_co_scheduled(runner, frag: PlanFragment,
                 "fused",
                 rows=int(np.max(np.asarray(d.get("rows", 0)))),
                 nbytes=int(np.max(np.asarray(d.get("bytes", 0)))))
+    if stats_on:
+        col.add_device_time(program_wall)
+        _record_program_stats(col, lowerer, frag, program_wall, host_aux)
     return per_shard
+
+
+def _collect_fragments(frag: PlanFragment) -> List[PlanFragment]:
+    out = [frag]
+    for child in frag.children:
+        out.extend(_collect_fragments(child))
+    return out
+
+
+def _plan_nodes(node) -> List:
+    out = [node]
+    for s in node.sources:
+        out.extend(_plan_nodes(s))
+    return out
+
+
+def _record_program_stats(col, lowerer: MeshLowerer, frag: PlanFragment,
+                          wall_s: float, host_aux: Dict[int, dict]
+                          ) -> None:
+    """Program-level operator rows for a co-scheduled mesh program: the
+    measured program wall apportions across the co-scheduled fragments
+    by their psum'd exchanged data volume (rows + bytes off each
+    fragment's exchange-site aux — the cost signal the program already
+    computes in-program and psums across chips), then equally across
+    each fragment's plan nodes. Fragment roots additionally carry the
+    global rows/bytes that crossed their exchange, so
+    `collect_operator_stats` on a mesh run yields rows for every node
+    of every co-scheduled fragment WITHOUT leaving the fused data
+    plane."""
+    frags = _collect_fragments(frag)
+    volumes: Dict[int, Tuple[float, int, int]] = {}
+    for f in frags:
+        site = lowerer.fragment_sites.get(f.fragment_id)
+        d = host_aux.get(site, {}) if site is not None else {}
+        rows = int(np.max(np.asarray(d.get("rows", 0)))) if d else 0
+        nbytes = int(np.max(np.asarray(d.get("bytes", 0)))) if d else 0
+        volumes[f.fragment_id] = (float(max(rows + nbytes, 1)), rows,
+                                  nbytes)
+    total = sum(w for w, _, _ in volumes.values()) or 1.0
+    for f in frags:
+        weight, rows, nbytes = volumes[f.fragment_id]
+        share = wall_s * weight / total
+        nodes = _plan_nodes(f.root)
+        per_node = share / max(len(nodes), 1)
+        for n in nodes:
+            st = col.register(n)
+            st.wall_s += per_node
+            st.device_s += per_node
+            st.fused = True     # exclusive share, not an inclusive wall
+        root_st = col.register(f.root)
+        root_st.output_rows += rows
+        root_st.output_bytes += nbytes
+        root_st.pages += 1
 
 
 def _run_program(runner, lowerer: MeshLowerer, top_fn, staged,
@@ -771,7 +871,11 @@ def _run_program(runner, lowerer: MeshLowerer, top_fn, staged,
             out = top_fn(env)
             return out, env.aux
         return mesh.shard_map(per_shard)
-    prog = cached_kernel(key, build)
+    # profiled dispatch: a mesh program is the most expensive compile in
+    # the engine — its XLA compile wall must land on compile_time_ms,
+    # not hide inside the first dispatch
+    from trino_tpu.exec.jit_cache import profiled_kernel
+    prog = profiled_kernel(key, build)
     return prog(*staged)
 
 

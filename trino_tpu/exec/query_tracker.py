@@ -62,15 +62,20 @@ class QueryInfo:
     pool_peak_bytes: int = 0
     memory_kills: int = 0        # times the low-memory killer chose us
     leaked_bytes: int = 0        # nonzero ledger at successful end
-    # observability rollup (obs/stats.py): cumulative device-inclusive
-    # execution time, output bytes, and the full snapshot + span dump the
-    # runner stamps before the terminal transition
+    # observability rollup (obs/stats.py): HOST execution time (the
+    # measured device and compile walls live in stats as
+    # device_time_ms/compile_time_ms — cpu_time_ms stopped being
+    # device-inclusive in round 13), output bytes, and the full
+    # snapshot + span dump the runner stamps before the terminal
+    # transition. trace_file is the exported Chrome-trace path when the
+    # session ran with trace_export on.
     cpu_time_ms: int = 0
     output_bytes: int = 0
     stats: Optional[dict] = dataclasses.field(
         default=None, repr=False, compare=False)
     trace: Optional[dict] = dataclasses.field(
         default=None, repr=False, compare=False)
+    trace_file: Optional[str] = None
     warnings: List[str] = dataclasses.field(default_factory=list)
     # the live memory context while executing (None before/after): lets
     # system.runtime.queries read the current pool reservation

@@ -158,11 +158,20 @@ class LocalQueryRunner:
         # the per-query QueryStatsCollector (obs/stats.py): phases,
         # output rows/bytes, jit hit/miss, spill bytes, operator stats
         self._collector = None
+        # Chrome-trace export directory (TrinoServer(trace_dir=...) /
+        # $TRINO_TPU_TRACE_DIR); None defers to the session's
+        # trace_export property with a tempdir default
+        self._trace_dir: Optional[str] = None
         # cumulative counters across the runner's lifetime (bench.py
         # emits these alongside timings) + the last query's snapshot
         # (the collector's full snapshot dict after each execute)
         self.stats = {"retries": 0, "faults_injected": 0}
         self.last_query_stats = {"retries": 0, "faults_injected": 0}
+        # warm the query-history module at CONSTRUCTION: its listener
+        # registers on first import, and paying that import inside the
+        # first query's completion window would sit exactly in the
+        # streaming protocol's producer-finish critical path
+        from trino_tpu.obs import history as _history  # noqa: F401
 
     def for_query(self) -> "LocalQueryRunner":
         """Per-query view of this runner: shared catalogs/metadata/
@@ -307,6 +316,14 @@ class LocalQueryRunner:
                 # attempt's observations left off
                 from trino_tpu.exec.adaptive import AdaptiveQueryState
                 self._adaptive = AdaptiveQueryState()
+                # query-history retention: the OWNING runner's session
+                # sizes the process ring (same discipline as the plan
+                # cache — per-request header overrides on pooled clones
+                # must not shrink history out from under everyone)
+                if self._owns_plan_cache:
+                    from trino_tpu.obs.history import HISTORY
+                    HISTORY.resize(
+                        int(self.session.get("history_max_entries")))
             except (TypeError, ValueError) as e:
                 from trino_tpu.errors import InvalidSessionPropertyError
                 raise InvalidSessionPropertyError(
@@ -441,7 +458,11 @@ class LocalQueryRunner:
             col.retries = self._retries
             col.faults_injected = faults
             col.finish()
-            info.cpu_time_ms = int(col.execution_s * 1000)
+            # cpu_time_ms means HOST time (round 13): execution wall
+            # minus the measured device walls (fenced chain dispatches)
+            # minus the measured XLA compile walls — the device/compile
+            # halves live in stats as device_time_ms/compile_time_ms
+            info.cpu_time_ms = int(col.host_time_s * 1000)
             info.output_bytes = col.output_bytes
             # mesh shape the query executed over (QueryMesh axis), for
             # system.runtime.queries consumers and event listeners
@@ -449,6 +470,7 @@ class LocalQueryRunner:
                          if col.mesh_devices else None)
             info.stats = col.snapshot()
             info.trace = col.trace_json()
+            self._export_trace(info)
             self.last_query_stats = info.stats
         else:
             self.last_query_stats = {"retries": self._retries,
@@ -460,6 +482,37 @@ class LocalQueryRunner:
             # then reads 0 instead of double-counting this query's faults
             self._faults.injected = 0
             self._faults.by_site.clear()
+
+    def _export_trace(self, info) -> None:
+        """Chrome-trace export (session `trace_export` / a server
+        trace_dir): serialize the query's span dump as Perfetto-loadable
+        JSON under the trace directory and stamp QueryInfo.trace_file.
+        Export failure degrades to a warning — observability must not
+        fail queries."""
+        import os
+        if info.trace is None:
+            return
+        try:
+            if not bool(self.session.get("trace_export")):
+                return
+        except Exception:
+            return
+        try:
+            import json
+            import tempfile
+
+            from trino_tpu.obs.spans import to_chrome_trace
+            trace_dir = self._trace_dir \
+                or os.environ.get("TRINO_TPU_TRACE_DIR") \
+                or os.path.join(tempfile.gettempdir(), "trino_tpu_traces")
+            os.makedirs(trace_dir, exist_ok=True)
+            path = os.path.join(trace_dir,
+                                f"{info.query_id}.trace.json")
+            with open(path, "w") as fh:
+                json.dump(to_chrome_trace(info.trace, info.query_id), fh)
+            info.trace_file = path
+        except Exception as e:   # noqa: BLE001
+            info.warnings.append(f"trace export failed: {e}")
 
     def _backoff(self, attempt: int) -> None:
         """Exponential backoff + jitter between retry attempts

@@ -167,18 +167,38 @@ SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     # query as files_pruned / row_groups_pruned. Set false to force
     # full-table reads (debugging / pruning-correctness comparisons).
     "lake_zone_maps_enabled": True,
-    # observability (obs/stats.py): per-operator stats collection for
-    # EVERY query on the session (EXPLAIN ANALYZE forces it regardless).
-    # Off by default: instrumenting node boundaries splits fused kernel
-    # chains and syncs the device once per page per operator.
+    # observability (obs/stats.py + obs/profiler.py): per-operator stats
+    # collection for EVERY query on the session (EXPLAIN ANALYZE forces
+    # it regardless). Since round 13 this does NOT split fused kernel
+    # chains or change which executables run: a chain is timed once per
+    # dispatch (block_until_ready at chain granularity) and the measured
+    # device wall apportions across the chain's operators by XLA cost
+    # analysis. Off by default because the per-chain fence still costs
+    # host/device pipelining, not because it changes the plan.
     "collect_operator_stats": False,
+    # Chrome-trace export (obs/spans.to_chrome_trace): at query end the
+    # span tree (query -> phase -> fragment -> exchange -> operator,
+    # plus slice/checkpoint/spill/adaptive spans) serializes as
+    # Perfetto-loadable JSON into $TRINO_TPU_TRACE_DIR (or the server's
+    # trace_dir, or <tmp>/trino_tpu_traces), and QueryInfo.trace_file /
+    # GET /v1/query/{id}/trace point at it. Off by default (one file
+    # per query).
+    "trace_export": False,
+    # query-history ring (obs/history.py): completed/failed/canceled
+    # queries retained past the live tracker's pruning bound, queryable
+    # via system.runtime.completed_queries and GET /v1/query/{id}.
+    # Sized by the OWNING runner's session (server deployments:
+    # TrinoServer(history_max_entries=...)); eviction is FIFO by
+    # completion order.
+    "history_max_entries": 512,
     # multi-chip sharded execution (exec/mesh_exec.py): co-schedule
     # eligible fragment chains as ONE jitted shard_map program over the
     # device mesh — per-shard scan/filter/join/aggregate pipelines with
     # the inter-fragment exchanges as in-program collectives (all_to_all /
     # all_gather), so multi-stage plans never stage pages through the
-    # host. Unsupported shapes (and chaos/operator-stats runs) fall back
-    # to the per-shard dispatch loop transparently.
+    # host. Unsupported shapes (and chaos runs — per-shard fault sites
+    # must fire) fall back to the per-shard dispatch loop transparently;
+    # operator-stats runs STAY on the mesh and emit program-level rows.
     "mesh_execution": True,
     # partitioned vs. global GROUP BY strategy threshold ("Global Hash
     # Tables Strike Back"): estimated group NDV at or above this

@@ -11,9 +11,16 @@ and trace dump when the runner recorded them.
 
 Metric side-effects are NOT a listener: the fire_* functions update the
 process metrics registry unconditionally, so unregistering every
-listener cannot silence /v1/metrics. Listener exceptions are swallowed
-(logged) — a broken plugin must not fail queries (the reference wraps
-every listener call the same way).
+listener cannot silence /v1/metrics. Listener exceptions are swallowed —
+a broken plugin must not fail queries (the reference wraps every
+listener call the same way) — but never silently: each failure counts on
+`trino_tpu_listener_errors_total{listener=...}` and the FIRST failure
+per listener type logs the full traceback (one line of log noise per
+broken plugin, not one per query).
+
+The query-history ring (obs/history.py) is itself a listener on this
+bus; the fire path imports it lazily so the ring is armed the moment any
+query completes, without a module-level cycle.
 """
 
 from __future__ import annotations
@@ -48,6 +55,7 @@ class QueryEvent:
     error_name: Optional[str] = None
     stats: Optional[Dict[str, Any]] = None    # QueryStatsCollector.snapshot()
     trace: Optional[Dict[str, Any]] = None    # structured span dump
+    trace_file: Optional[str] = None          # exported Chrome-trace path
 
 
 class EventListener:
@@ -114,7 +122,13 @@ def event_from_info(info) -> QueryEvent:
         resource_group=info.resource_group,
         peak_memory_bytes=info.pool_peak_bytes,
         error=info.error, error_name=info.error_name,
-        stats=info.stats, trace=info.trace)
+        stats=info.stats, trace=info.trace,
+        trace_file=info.trace_file)
+
+
+# listener types whose failure has already been logged (log ONCE per
+# listener, count every failure — the counter is the ongoing signal)
+_ERROR_LOGGED: set = set()
 
 
 def _dispatch(method: str, event: QueryEvent) -> None:
@@ -122,8 +136,15 @@ def _dispatch(method: str, event: QueryEvent) -> None:
         try:
             getattr(listener, method)(event)
         except Exception:   # noqa: BLE001 — a plugin must not fail queries
-            log.exception("event listener %r failed on %s",
-                          type(listener).__name__, method)
+            name = type(listener).__name__
+            from trino_tpu.obs import metrics as m
+            m.LISTENER_ERRORS_TOTAL.inc(listener=name)
+            if name not in _ERROR_LOGGED:
+                _ERROR_LOGGED.add(name)
+                log.exception(
+                    "event listener %r failed on %s (logged once; "
+                    "further failures count on "
+                    "trino_tpu_listener_errors_total)", name, method)
 
 
 def fire_query_created(info) -> None:
@@ -162,15 +183,35 @@ def _record_terminal_metrics(info) -> None:
             n = info.stats.get(kind, 0)
             if n:
                 m.ADAPTIVE_EVENTS_TOTAL.inc(n, kind=kind)
+    if info.stats:
+        m.COMPILE_SECONDS_TOTAL.inc(
+            float(info.stats.get("compile_time_ms", 0) or 0) / 1000.0)
+        m.DEVICE_SECONDS_TOTAL.inc(
+            float(info.stats.get("device_time_ms", 0) or 0) / 1000.0)
     if info.wall_ms is not None:
         m.QUERY_WALL_SECONDS.observe(info.wall_ms / 1000.0)
+        # the serving tier's SLO surface: per-resource-group latency by
+        # outcome — a group's p99 regression or failure-rate spike is one
+        # PromQL query away (histogram_quantile over group series)
+        m.GROUP_WALL_SECONDS.observe(
+            info.wall_ms / 1000.0,
+            group=info.resource_group or "global", outcome=info.state)
+
+
+def _ensure_history() -> None:
+    """Arm the query-history ring (its listener registers on import):
+    lazy so listeners.py has no module-level dependency on history.py,
+    unconditional so the ring records no matter who drove the query."""
+    from trino_tpu.obs import history  # noqa: F401 — import side effect
 
 
 def fire_query_completed(info) -> None:
+    _ensure_history()
     _record_terminal_metrics(info)
     _dispatch("query_completed", event_from_info(info))
 
 
 def fire_query_failed(info) -> None:
+    _ensure_history()
     _record_terminal_metrics(info)
     _dispatch("query_failed", event_from_info(info))

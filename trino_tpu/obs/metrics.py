@@ -97,12 +97,18 @@ class Counter:
 
 
 class Histogram:
-    """Cumulative-bucket histogram family (Prometheus semantics)."""
+    """Cumulative-bucket histogram family (Prometheus semantics).
+    `labeled` families render no samples until the first observation —
+    same phantom-series discipline as labeled counters (an unlabeled
+    zero series that vanishes after the first real labeled observation
+    reads as a reset)."""
 
     def __init__(self, registry: "MetricsRegistry", name: str, help: str,
-                 buckets: Tuple[float, ...] = WALL_BUCKETS):
+                 buckets: Tuple[float, ...] = WALL_BUCKETS,
+                 labeled: bool = False):
         self.name = name
         self.help = help
+        self.labeled = labeled
         self.buckets = tuple(sorted(buckets))
         self._registry = registry
         self._counts: Dict[LabelSet, List[int]] = {}
@@ -135,7 +141,7 @@ class Histogram:
 
     def samples(self) -> Iterable[Tuple[str, LabelSet, float]]:
         with self._registry._lock:
-            keys = list(self._counts) or [()]
+            keys = list(self._counts) or ([] if self.labeled else [()])
             counts = {k: list(v) for k, v in self._counts.items()}
             sums, totals = dict(self._sums), dict(self._totals)
         for key in keys:
@@ -177,12 +183,13 @@ class MetricsRegistry:
             return c
 
     def histogram(self, name: str, help: str,
-                  buckets: Tuple[float, ...] = WALL_BUCKETS) -> Histogram:
+                  buckets: Tuple[float, ...] = WALL_BUCKETS,
+                  labeled: bool = False) -> Histogram:
         with self._lock:
             h = self._histograms.get(name)
             if h is None:
                 h = self._histograms[name] = Histogram(self, name, help,
-                                                       buckets)
+                                                       buckets, labeled)
             return h
 
     def register_gauges(self, callback: Callable[[], Iterable[tuple]]
@@ -327,13 +334,38 @@ PREEMPT_LATENCY_SECONDS = REGISTRY.histogram(
     "Cancel-request to unwind wall per preempted query — bounded by "
     "one slice's wall under sliced execution.",
     buckets=PREEMPT_BUCKETS)
+GROUP_WALL_SECONDS = REGISTRY.histogram(
+    "trino_tpu_group_wall_seconds",
+    "Query wall-clock duration by resource group and terminal outcome "
+    "(FINISHED/FAILED/CANCELED) — the per-group latency/SLO surface the "
+    "serving tier alerts on.", labeled=True)
+LISTENER_ERRORS_TOTAL = REGISTRY.counter(
+    "trino_tpu_listener_errors_total",
+    "Event-listener callbacks that raised, by listener type. Failures "
+    "are swallowed (a broken plugin must not fail queries) and logged "
+    "once per listener; this counter is the ongoing signal.",
+    labeled=True)
+COMPILE_SECONDS_TOTAL = REGISTRY.counter(
+    "trino_tpu_query_compile_seconds_total",
+    "Summed XLA compile wall attributed to queries (measured at the "
+    "jit cache's AOT compile sites) — the compile half of "
+    "compile-vs-execute accounting.")
+DEVICE_SECONDS_TOTAL = REGISTRY.counter(
+    "trino_tpu_query_device_seconds_total",
+    "Summed measured device wall attributed to queries (fused-chain "
+    "dispatches fenced at chain granularity under operator-level "
+    "collection).")
 
 
 def set_wall_buckets(buckets) -> None:
-    """Deployment-time bucket configuration for the query wall
-    histogram (TrinoServer(metrics_wall_buckets=...)); resets the
-    family — see Histogram.set_buckets."""
-    QUERY_WALL_SECONDS.set_buckets(tuple(float(b) for b in buckets))
+    """Deployment-time bucket configuration for the wall histograms
+    (TrinoServer(metrics_wall_buckets=...)); resets the families — see
+    Histogram.set_buckets. Applies to BOTH wall families: the per-group
+    SLO histogram alerts on the same latency envelope the deployment
+    tuned the query-wall buckets for."""
+    bounds = tuple(float(b) for b in buckets)
+    QUERY_WALL_SECONDS.set_buckets(bounds)
+    GROUP_WALL_SECONDS.set_buckets(bounds)
 
 
 def _engine_gauges():
@@ -416,6 +448,38 @@ def _engine_gauges():
            "Kernels evicted from the in-process LRU since process start "
            "(evicted shapes reload from the persistent XLA cache).",
            js["evictions"], {})
+    yield ("trino_tpu_jit_compiles_total",
+           "XLA compiles performed through the profiled dispatch path "
+           "(one per new input signature of a chain/program kernel) — "
+           "each one a timed, query-attributed event.",
+           js["compiles"], {})
+    yield ("trino_tpu_jit_compile_seconds_total",
+           "Summed wall of profiled-path XLA compiles since process "
+           "start.", js["compile_s"], {})
+    yield ("trino_tpu_jit_compiled_hlo_ops_total",
+           "Summed HLO instruction count of profiled-path compiles.",
+           js["hlo_ops"], {})
+    yield ("trino_tpu_jit_aot_fallbacks_total",
+           "Profiled dispatches that fell back to the plain jitted "
+           "callable (signature mismatch at call time) — a systematic "
+           "nonzero rate means the AOT accounting path is misfiring.",
+           js["aot_fallbacks"], {})
+
+    from trino_tpu.obs.history import HISTORY
+    hs = HISTORY.stats()
+    hist = "Query-history ring (obs/history.py): "
+    yield ("trino_tpu_history_entries",
+           hist + "completed queries currently retained.",
+           hs["entries"], {})
+    yield ("trino_tpu_history_max_entries",
+           hist + "retention bound (history_max_entries).",
+           hs["max_entries"], {})
+    yield ("trino_tpu_history_recorded_total",
+           hist + "terminal queries recorded since process start.",
+           hs["recorded"], {})
+    yield ("trino_tpu_history_evicted_total",
+           hist + "records dropped by the FIFO bound.",
+           hs["evicted"], {})
 
     from trino_tpu.exec import plan_cache
     ps = plan_cache.stats()

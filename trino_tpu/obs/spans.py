@@ -55,3 +55,58 @@ class Span:
         if self.children:
             out["children"] = [c._to_json(origin) for c in self.children]
         return out
+
+
+def to_chrome_trace(dump: Dict[str, Any],
+                    query_id: str = "") -> Dict[str, Any]:
+    """Serialize a structured span dump (Span.to_json / QueryInfo.trace)
+    as Chrome-trace JSON — the `traceEvents` object format Perfetto and
+    chrome://tracing open directly.
+
+    Mapping: every span becomes one complete event (`ph: "X"`) with
+    microsecond `ts`/`dur` relative to the query root. The span tree
+    flattens onto tracks (`tid`): the query/phase/fragment/exchange
+    hierarchy nests by time containment on the main track, while
+    synthesized operator spans — which all start at the root origin and
+    would overlap — each get their own track so per-operator walls render
+    side by side. Span attrs ride in `args` verbatim.
+    """
+    events = [
+        {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+         "args": {"name": f"trino_tpu query {query_id}".strip()}},
+        {"name": "thread_name", "ph": "M", "pid": 1, "tid": 1,
+         "args": {"name": "query"}},
+    ]
+    op_tid = [100]
+
+    def walk(span: Dict[str, Any]) -> None:
+        kind = span.get("kind", "internal")
+        if kind == "operator":
+            tid = op_tid[0]
+            op_tid[0] += 1
+            events.append(
+                {"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+                 "args": {"name": f"operator {span['name']}"}})
+        else:
+            tid = 1
+        event: Dict[str, Any] = {
+            "name": str(span.get("name", "")),
+            "cat": str(kind),
+            "ph": "X",
+            "ts": float(span.get("start_ms", 0.0)) * 1000.0,
+            "dur": float(span.get("wall_ms", 0.0)) * 1000.0,
+            "pid": 1,
+            "tid": tid,
+        }
+        attrs = span.get("attrs")
+        if attrs:
+            event["args"] = {str(k): v if isinstance(
+                v, (int, float, bool, str, type(None))) else str(v)
+                for k, v in attrs.items()}
+        events.append(event)
+        for child in span.get("children", ()) or ():
+            walk(child)
+
+    if dump:
+        walk(dump)
+    return {"displayTimeUnit": "ms", "traceEvents": events}

@@ -10,17 +10,26 @@ EXPLAIN ANALYZE, system.runtime.queries, event listeners, bench.py —
 reports the SAME numbers.
 
 Two collection levels, because per-operator instrumentation is not free
-on this engine: wrapping a node boundary forces the pending fused-kernel
-chain at that node (the composed scan->filter->project program splits
-into per-operator programs) and reading a page's row count syncs the
-device. Query-level collection (phases, output rows/bytes, jit cache
-hits/misses, spill bytes) is therefore ALWAYS on, while operator-level
-collection turns on per query via the `collect_operator_stats` session
-property or EXPLAIN ANALYZE. Under EXPLAIN ANALYZE `fence` additionally
-`block_until_ready`s every page at the node boundary, so asynchronously
-dispatched device time is attributed to the operator that launched it
-instead of hiding in whichever downstream read happens to sync first
-(the OperationTimer discipline, TPU edition).
+on this engine. Query-level collection (phases, output rows/bytes, jit
+cache hits/misses, compile walls, spill bytes) is ALWAYS on;
+operator-level collection turns on per query via the
+`collect_operator_stats` session property or EXPLAIN ANALYZE. Since
+round 13 operator-level collection NO LONGER splits fused kernel chains:
+a chain records one measured device wall per dispatch
+(`block_until_ready` at chain granularity only) and obs/profiler.py
+apportions it across the chain's operators by XLA cost analysis — the
+instrumented query executes the SAME executables as the plain one (the
+jit cache stays warm across the toggle). Blocking operators are still
+timed inclusively at their output boundary; under EXPLAIN ANALYZE
+`fence` additionally pins their asynchronously dispatched device work
+with `block_until_ready` (the OperationTimer discipline, TPU edition).
+
+Device-time truth: `device_time_ms` is the summed measured chain wall
+(collected only when operator-level collection fences chains),
+`compile_time_ms` is the summed wall of every XLA compile this query
+triggered (measured at the jit cache's AOT compile sites, always on),
+and host time = execution - device - compile is what
+`QueryInfo.cpu_time_ms` now means.
 
 Threading contract: one collector belongs to one query, mutated by that
 query's executor thread only (distributed shards dispatch sequentially
@@ -50,6 +59,16 @@ class OperatorStats:
     pages: int = 0
     output_bytes: int = 0
     wall_s: float = 0.0
+    # measured device wall apportioned to this operator by the XLA cost
+    # model (obs/profiler.py): the operator's share of its fused chain's
+    # block_until_ready wall. Sums to the measured chain walls across a
+    # query's operators — the device-attribution contract.
+    device_s: float = 0.0
+    # True when wall_s holds an EXCLUSIVE cost-model share (fused chain
+    # entries, mesh program nodes) rather than the inclusive boundary
+    # wall the counting wrapper measures — the renderer must not
+    # subtract children from a share that never contained them
+    fused: bool = False
     source_ids: Tuple[int, ...] = ()
 
 
@@ -68,6 +87,20 @@ class QueryStatsCollector:
         self.spilled_bytes = 0
         self.jit_hits = 0
         self.jit_misses = 0
+        # device-time truth (round 13, obs/profiler.py + exec/jit_cache):
+        # device_time_s sums the measured per-dispatch chain walls
+        # (fenced at chain granularity under operator-level collection;
+        # 0.0 when the query ran unfenced — device time then remains
+        # folded into execution wall). compile_time_s sums the wall of
+        # every XLA compile this query triggered, measured at the jit
+        # cache's AOT compile sites with the compiled program's HLO
+        # instruction count and cost-model flops/bytes alongside.
+        self.device_time_s = 0.0
+        self.compile_time_s = 0.0
+        self.jit_compiles = 0
+        self.compiled_hlo_ops = 0
+        self.estimated_flops = 0.0
+        self.estimated_bytes = 0.0
         # hits on a canonical key whose literal parameter values differ
         # from that key's previous call — kernel sharing that per-literal
         # keying could not have expressed
@@ -203,6 +236,20 @@ class QueryStatsCollector:
     def jit_param_hit(self, key=None) -> None:
         self.jit_param_hits += 1
 
+    def add_device_time(self, wall_s: float) -> None:
+        """One fused chain dispatch's measured device wall (the whole
+        chain fenced once); per-operator shares land on OperatorStats."""
+        self.device_time_s += float(wall_s)
+
+    def add_compile(self, wall_s: float, hlo_ops: int = 0,
+                    flops: float = 0.0, nbytes: float = 0.0) -> None:
+        """One XLA compile this query triggered (jit-cache AOT site)."""
+        self.compile_time_s += float(wall_s)
+        self.jit_compiles += 1
+        self.compiled_hlo_ops += int(hlo_ops)
+        self.estimated_flops += float(flops)
+        self.estimated_bytes += float(nbytes)
+
     def plan_cache_hit(self) -> None:
         self.plan_cache_hits += 1
 
@@ -265,6 +312,16 @@ class QueryStatsCollector:
     def planning_s(self) -> float:
         return self.phases.get("planning", 0.0)
 
+    @property
+    def host_time_s(self) -> float:
+        """Execution wall with measured device and compile time taken
+        out: what the HOST spent scheduling, staging, and shuffling —
+        the number cpu_time_ms now reports. Without fenced device
+        measurement (plain queries) device_time_s is 0 and this still
+        subtracts the always-measured compile walls."""
+        return max(self.execution_s - self.device_time_s
+                   - self.compile_time_s, 0.0)
+
     def operator_rows(self) -> List[Dict[str, Any]]:
         out = []
         for st in self.operators.values():
@@ -275,6 +332,7 @@ class QueryStatsCollector:
                 "output_bytes": st.output_bytes,
                 "pages": st.pages,
                 "wall_ms": round(st.wall_s * 1000, 3),
+                "device_ms": round(st.device_s * 1000, 3),
             })
         return out
 
@@ -292,6 +350,13 @@ class QueryStatsCollector:
             "jit_hits": self.jit_hits,
             "jit_misses": self.jit_misses,
             "jit_param_hits": self.jit_param_hits,
+            "device_time_ms": round(self.device_time_s * 1000, 3),
+            "compile_time_ms": round(self.compile_time_s * 1000, 3),
+            "host_time_ms": round(self.host_time_s * 1000, 3),
+            "jit_compiles": self.jit_compiles,
+            "compiled_hlo_ops": self.compiled_hlo_ops,
+            "estimated_flops": self.estimated_flops,
+            "estimated_bytes": self.estimated_bytes,
             "plan_cache_hits": self.plan_cache_hits,
             "plan_cache_misses": self.plan_cache_misses,
             "result_cache_hits": self.result_cache_hits,
@@ -342,7 +407,9 @@ class QueryStatsCollector:
                 op = Span(st.name, kind="operator", start_s=origin,
                           attrs={"output_rows": st.output_rows,
                                  "output_bytes": st.output_bytes,
-                                 "pages": st.pages})
+                                 "pages": st.pages,
+                                 "device_ms": round(st.device_s * 1000,
+                                                    3)})
                 op.end_s = origin + st.wall_s
                 ops.append(op._to_json(origin))
             dump.setdefault("children", []).extend(ops)
@@ -374,26 +441,50 @@ def render_analyzed_plan(plan, collector: QueryStatsCollector,
     parent's read)."""
     from trino_tpu.planner.nodes import format_plan
 
+    def cumulative(st) -> float:
+        """Inclusive wall estimate: fused slots hold an EXCLUSIVE
+        cost-model share, so their subtree adds the children's
+        cumulative walls; wrapper-measured slots are already
+        inclusive."""
+        if not st.fused:
+            return st.wall_s
+        return st.wall_s + sum(
+            cumulative(collector.operators[s]) for s in st.source_ids
+            if s in collector.operators)
+
     def annotate(node):
         st = collector.operators.get(id(node))
         if st is None:
             return ""
-        child_wall = sum(collector.operators[s].wall_s
-                         for s in st.source_ids
-                         if s in collector.operators)
-        own = max(st.wall_s - child_wall, 0.0)
-        return (f"output: {st.output_rows} rows ({st.pages} pages, "
+        if st.fused:
+            # the share IS this operator's own time (exclusive by
+            # construction — subtracting inclusive children from it
+            # would clamp every fused operator to 0.00ms)
+            own = st.wall_s
+        else:
+            child_wall = sum(collector.operators[s].wall_s
+                             for s in st.source_ids
+                             if s in collector.operators)
+            own = max(st.wall_s - child_wall, 0.0)
+        text = (f"output: {st.output_rows} rows ({st.pages} pages, "
                 f"{_fmt_bytes(st.output_bytes)}), "
                 f"time: {own * 1000:.2f}ms "
-                f"({st.wall_s * 1000:.2f}ms cumulative)")
+                f"({cumulative(st) * 1000:.2f}ms cumulative)")
+        if st.device_s > 0:
+            text += f", device: {st.device_s * 1000:.2f}ms"
+        return text
 
     text = format_plan(plan, annotate=annotate)
     text += (f"\n\nQuery: {total_rows} rows, "
              f"wall {total_wall_s * 1000:.2f}ms ({label}), "
              f"planning {collector.planning_s * 1000:.2f}ms, "
+             f"device {collector.device_time_s * 1000:.2f}ms / "
+             f"compile {collector.compile_time_s * 1000:.2f}ms / "
+             f"host {collector.host_time_s * 1000:.2f}ms, "
              f"jit {collector.jit_hits} hits / "
              f"{collector.jit_misses} misses / "
-             f"{collector.jit_param_hits} param hits, "
+             f"{collector.jit_param_hits} param hits / "
+             f"{collector.jit_compiles} compiles, "
              f"plan cache {collector.plan_cache_hits} hits / "
              f"{collector.plan_cache_misses} misses")
     if collector.spilled_bytes:

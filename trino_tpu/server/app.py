@@ -178,7 +178,9 @@ class TrinoServer:
                  stream_stall_timeout_s: float = 300.0,
                  warmup_manifest=None,
                  otlp_export: Optional[str] = None,
-                 metrics_wall_buckets=None):
+                 metrics_wall_buckets=None,
+                 trace_dir: Optional[str] = None,
+                 history_max_entries: Optional[int] = None):
         self.runner = runner
         # serving tier defaults: the server IS the production front door,
         # so result/scan caching default ON for server sessions (clones
@@ -210,6 +212,22 @@ class TrinoServer:
         # via $TRINO_TPU_OTLP_ENDPOINT / $TRINO_TPU_OTLP_FILE
         from trino_tpu.obs.otlp import install_otlp_exporter
         self.otlp_exporter = install_otlp_exporter(otlp_export)
+        # Chrome-trace export: a server constructed with trace_dir
+        # exports EVERY query's span tree as Perfetto-loadable JSON into
+        # that directory (QueryInfo.trace_file / GET
+        # /v1/query/{id}/trace); the session property rides to
+        # for_query() clones through the shared property bag
+        if trace_dir is not None:
+            runner._trace_dir = str(trace_dir)
+            runner.session.set("trace_export", True)
+        # query-history retention (obs/history.py): deployment-level
+        # bound on the completed-queries ring, same owning-runner
+        # discipline as plan_cache_max_entries
+        if history_max_entries is not None:
+            from trino_tpu.obs.history import HISTORY
+            runner.session.set("history_max_entries",
+                               int(history_max_entries))
+            HISTORY.resize(int(history_max_entries))
         # deployment-tuned wall histogram buckets: the process default
         # is session-independent ($TRINO_TPU_METRICS_WALL_BUCKETS or the
         # static obs/metrics.DEFAULT_WALL_BUCKETS); a server that knows
@@ -653,6 +671,74 @@ class TrinoServer:
             # runner already transitioned it (this is then a no-op)
             self._fail_tracker(q, e)
 
+    # ----------------------------------------------------- query REST API
+
+    @staticmethod
+    def _query_info_payload(qid: str) -> Optional[dict]:
+        """GET /v1/query/{id} (QueryResource.getQueryInfo analog): the
+        live tracker entry while it exists, the history-ring record
+        after pruning — a just-finished query's stats stay queryable
+        past the tracker's retention bound."""
+        from trino_tpu.exec.query_tracker import TRACKER
+        from trino_tpu.obs.history import HISTORY, record_from_info
+        for info in TRACKER.list():
+            if info.query_id == qid:
+                # the SAME record shape the history branch serves (one
+                # builder — a consumer must never see fields flicker in
+                # and out with prune timing), plus the live-only extras
+                from trino_tpu.exec.query_tracker import TERMINAL
+                rec = record_from_info(info)
+                payload = TrinoServer._record_payload(rec, "tracker")
+                if info.state not in TERMINAL:
+                    payload["endedAt"] = None   # still executing
+                return payload
+        entry = HISTORY.get(qid)
+        if entry is None:
+            return None
+        return TrinoServer._record_payload(entry, "history")
+
+    @staticmethod
+    def _record_payload(rec, source: str) -> dict:
+        return {
+            "queryId": rec.query_id, "state": rec.state,
+            "user": rec.user, "query": rec.query,
+            "rows": rec.rows, "outputBytes": rec.output_bytes,
+            "wallMillis": rec.wall_ms,
+            "cpuTimeMillis": rec.cpu_time_ms,
+            "deviceTimeMillis": rec.device_time_ms,
+            "compileTimeMillis": rec.compile_time_ms,
+            "error": rec.error, "errorName": rec.error_name,
+            "errorType": rec.error_type, "retryable": rec.retryable,
+            "retries": rec.retries,
+            "resourceGroup": rec.resource_group,
+            "peakMemoryBytes": rec.peak_memory_bytes,
+            "stats": rec.stats, "endedAt": rec.ended_at,
+            "traceFile": rec.trace_file,
+            "source": source,
+        }
+
+    @staticmethod
+    def _query_trace_payload(qid: str) -> Optional[dict]:
+        """GET /v1/query/{id}/trace: the query's span tree as
+        Chrome-trace JSON (generated on demand — works whether or not
+        the session exported a trace file), served from the live
+        tracker or the history ring."""
+        from trino_tpu.exec.query_tracker import TRACKER
+        from trino_tpu.obs.spans import to_chrome_trace
+        trace = None
+        for info in TRACKER.list():
+            if info.query_id == qid:
+                trace = info.trace
+                break
+        if trace is None:
+            from trino_tpu.obs.history import HISTORY
+            entry = HISTORY.get(qid)
+            if entry is not None:
+                trace = entry.trace
+        if trace is None:
+            return None
+        return to_chrome_trace(trace, qid)
+
     # ------------------------------------------------------------ paging
 
     def _page_uri(self, q: _Query, token: int) -> str:
@@ -857,6 +943,22 @@ class TrinoServer:
                     elapsed_ms=q.elapsed_ms), q)
 
             def do_GET(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) >= 3 and parts[:2] == ["v1", "query"]:
+                    # /v1/query/{id} + /v1/query/{id}/trace: query info
+                    # and Chrome-trace export, live or from history
+                    qid = parts[2]
+                    if len(parts) == 4 and parts[3] == "trace":
+                        payload = server._query_trace_payload(qid)
+                    elif len(parts) == 3:
+                        payload = server._query_info_payload(qid)
+                    else:
+                        payload = None
+                    if payload is None:
+                        self.send_error(404, "Query not found")
+                        return
+                    self._send_json(payload)
+                    return
                 if self.path.rstrip("/") == "/v1/metrics":
                     # Prometheus scrape endpoint (the jmx-prometheus
                     # agent surface of a reference deployment, native)
