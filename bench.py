@@ -680,21 +680,55 @@ def run_lake(out_path=None) -> None:
             f.write(line + "\n")
 
 
-def run_qps(out_path=None) -> None:
-    """`bench.py --qps [OUT.json]`: the closed-loop serving-tier QPS
-    report (trino_tpu/serve/bench_serve.py) — N clients driving prepared
-    EXECUTEs through the HTTP server, sustained executions/s + latency
-    percentiles + cache hit rates. Like the main bench, the final JSON
+def run_qps(out_path=None, workers=None) -> None:
+    """`bench.py --qps [OUT.json] [--workers N1,N2,...]`: the serving
+    tier's QPS instrument. Without `--workers`, the PR-7 single-process
+    closed loop (trino_tpu/serve/bench_serve.py). With `--workers`, the
+    FLEET scaling curve (trino_tpu/fleet/bench_fleet.py): one rung per
+    worker count (0 = single-process baseline), subprocess load
+    generators, a cache-MISS pass proving the dispatch path doesn't
+    regress behind the proxy hop, and a mid-bench rolling restart
+    proving zero dropped queries. Like the main bench, the final JSON
     line ALWAYS prints: a failure lands in an `error` field instead of
     a bare nonzero exit with nothing parseable."""
     platform = _ensure_backend()
-    payload = {"metric": "serve_qps", "backend": platform}
-    try:
+    if workers is None and os.environ.get("TRINO_TPU_QPS_WORKERS"):
+        raw_workers = os.environ["TRINO_TPU_QPS_WORKERS"]
+        try:
+            workers = [int(x) for x in raw_workers.split(",")]
+        except ValueError:
+            # the contract: the final JSON line ALWAYS prints
+            line = json.dumps({
+                "metric": "fleet_qps", "backend": platform,
+                "error": f"bad TRINO_TPU_QPS_WORKERS value "
+                         f"{raw_workers!r} (want e.g. '0,1,2,4,8')"})
+            print(line, flush=True)
+            if out_path:
+                with open(out_path, "w") as f:
+                    f.write(line + "\n")
+            return
+    # one env read, mode-specific defaults: the fleet curve runs 5
+    # rungs + a miss pass + the restart pass, so its per-rung window is
+    # shorter than the single-process loop's
+    clients = int(os.environ.get("TRINO_TPU_QPS_CLIENTS", 8))
+    env_duration = os.environ.get("TRINO_TPU_QPS_DURATION_S")
+    if workers is not None:
+        from trino_tpu.fleet.bench_fleet import run_fleet_qps
+        metric = "fleet_qps"
+        bench = run_fleet_qps
+        kwargs = {"worker_counts": workers, "client_procs": clients,
+                  "duration_s": float(env_duration) if env_duration
+                  else 6.0}
+    else:
         from trino_tpu.serve.bench_serve import run_qps_bench
-        payload.update(run_qps_bench(
-            duration_s=float(os.environ.get(
-                "TRINO_TPU_QPS_DURATION_S", 8.0)),
-            clients=int(os.environ.get("TRINO_TPU_QPS_CLIENTS", 8))))
+        metric = "serve_qps"
+        bench = run_qps_bench
+        kwargs = {"clients": clients,
+                  "duration_s": float(env_duration) if env_duration
+                  else 8.0}
+    payload = {"metric": metric, "backend": platform}
+    try:
+        payload.update(bench(**kwargs))
     except KeyboardInterrupt:
         raise
     except BaseException as e:  # noqa: BLE001 — the line must print
@@ -1176,7 +1210,21 @@ if __name__ == "__main__":
     elif len(sys.argv) >= 2 and sys.argv[1] == "--lake":
         run_lake(sys.argv[2] if len(sys.argv) >= 3 else None)
     elif len(sys.argv) >= 2 and sys.argv[1] == "--qps":
-        run_qps(sys.argv[2] if len(sys.argv) >= 3 else None)
+        _qps_args = sys.argv[2:]
+        _qps_workers = None
+        if "--workers" in _qps_args:
+            _i = _qps_args.index("--workers")
+            try:
+                _qps_workers = [int(x)
+                                for x in _qps_args[_i + 1].split(",")]
+            except (IndexError, ValueError):
+                print("usage: bench.py --qps [OUT.json] "
+                      "[--workers N1,N2,...]  (e.g. --workers 0,1,2,4,8)",
+                      file=sys.stderr)
+                sys.exit(2)
+            _qps_args = _qps_args[:_i] + _qps_args[_i + 2:]
+        run_qps(_qps_args[0] if _qps_args else None,
+                workers=_qps_workers)
     elif len(sys.argv) >= 2 and sys.argv[1] == "--preempt":
         run_preempt(sys.argv[2] if len(sys.argv) >= 3 else None)
     elif len(sys.argv) >= 2 and sys.argv[1] == "--memory-ladder":

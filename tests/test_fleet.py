@@ -1,0 +1,562 @@
+"""Fleet serving (trino_tpu/fleet/): SO_REUSEPORT workers over one
+engine, cross-process cache tier, quotas, drain, rolling restart.
+
+The ISSUE-13 acceptance suite. Unit layers (shm tier seqlock +
+generation guard, bus, registry, keyer parity) run in-process; the
+end-to-end tests spawn REAL worker subprocesses sharing one port
+(JAX_PLATFORMS=cpu, hard ready/exit timeouts) against an engine in this
+process, so tier-1 exercises the production topology bounded.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from trino_tpu.fleet.shm import SharedCacheTier, key_fingerprint
+
+pytestmark = pytest.mark.skipif(
+    not hasattr(socket, "SO_REUSEPORT"),
+    reason="fleet serving needs SO_REUSEPORT")
+
+
+# ------------------------------------------------------------ shm tier
+
+
+def test_shm_roundtrip_and_generation_guard(tmp_path):
+    tier = SharedCacheTier(str(tmp_path / "c.shm"), create=True,
+                           data_bytes=1 << 20)
+    kh = key_fingerprint(("k", 1))
+    table = ("tpch", "tiny", "nation")
+    gen = tier.generation()
+    assert tier.get(kh) is None
+    assert tier.put(kh, {"rows": [1, 2]}, [table], gen)
+    entry, tables, put_gen, seq = tier.get(kh)
+    assert entry == {"rows": [1, 2]} and tables == (table,)
+    # peek matches the full read (the hot-copy revalidation contract)
+    assert tier.peek_slot(kh) == (seq, put_gen)
+    # invalidation drops it for every future read
+    tier.invalidate(table)
+    assert tier.get(kh) is None
+    # the _GenerationGuard discipline across processes: a put carrying a
+    # generation snapshot older than an invalidation of any referenced
+    # table is REJECTED — a stale publish is structurally impossible
+    stale_gen = tier.generation()
+    tier.invalidate(table)
+    assert not tier.put(kh, {"stale": True}, [table], stale_gen)
+    assert tier.get(kh) is None
+    # an unrelated table's entry survives
+    other = key_fingerprint(("k", 2))
+    assert tier.put(other, "v", [("c", "s", "other")], tier.generation())
+    tier.invalidate(table)
+    assert tier.get(other)[0] == "v"
+    tier.close()
+
+
+def test_shm_ring_wrap_no_corruption(tmp_path):
+    """Overwriting ring allocation must kill overlapped slots: old keys
+    either miss or return their OWN value, never another record's."""
+    tier = SharedCacheTier(str(tmp_path / "c.shm"), create=True,
+                           data_bytes=64 << 10, slots=256)
+    for i in range(800):
+        tier.put(key_fingerprint(("w", i)), {"i": i, "pad": "x" * 300},
+                 [("c", "s", "t")], tier.generation())
+    survivors = 0
+    for i in range(800):
+        found = tier.get(key_fingerprint(("w", i)))
+        if found is None:
+            continue
+        assert found[0]["i"] == i
+        survivors += 1
+    assert 0 < survivors < 800    # wrapped: some evicted, none corrupt
+    tier.close()
+
+
+def test_shm_quota_bucket_is_shared(tmp_path):
+    """Two handles on one file drain ONE bucket — the fleet-wide
+    semantics N worker processes get."""
+    path = str(tmp_path / "c.shm")
+    a = SharedCacheTier(path, create=True, data_bytes=1 << 16)
+    b = SharedCacheTier(path)
+    assert a.try_acquire("g", rate=1.0, burst=2.0)
+    assert b.try_acquire("g", rate=1.0, burst=2.0)
+    assert not a.try_acquire("g", rate=1.0, burst=2.0)
+    assert not b.try_acquire("g", rate=1.0, burst=2.0)
+    # refund (the all-or-nothing chain walk's rollback)
+    assert a.try_acquire("g", rate=1.0, burst=2.0, n=-1.0)
+    assert b.try_acquire("g", rate=1.0, burst=2.0)
+    a.close()
+    b.close()
+
+
+def test_quota_allows_chain_refund(tmp_path):
+    from trino_tpu.fleet.registry import quota_allows
+    tier = SharedCacheTier(str(tmp_path / "c.shm"), create=True,
+                           data_bytes=1 << 16)
+    quotas = {"root": {"rate": 0.0, "burst": 10.0},
+              "root.leaf": {"rate": 0.0, "burst": 1.0}}
+    assert quota_allows(tier, quotas, "root.leaf")      # 1 from each
+    assert not quota_allows(tier, quotas, "root.leaf")  # leaf empty
+    # the failed attempt refunded root: 9 left there, leaf still empty
+    assert quota_allows(tier, quotas, "root")
+    for _ in range(8):
+        assert quota_allows(tier, quotas, "root")
+    assert not quota_allows(tier, quotas, "root")
+    tier.close()
+
+
+# ------------------------------------------------------- bus + registry
+
+
+def test_bus_fanout_and_send_to(tmp_path):
+    from trino_tpu.fleet.bus import FleetBus
+    got_a, got_b = [], []
+    a = FleetBus(str(tmp_path), "a", on_message=got_a.append)
+    b = FleetBus(str(tmp_path), "b", on_message=got_b.append)
+    try:
+        assert a.publish({"kind": "x"}) == 2          # both members
+        assert a.publish({"kind": "y"}, exclude_self=True) == 1
+        assert b.send_to("a", {"kind": "direct"})
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and (
+                len(got_a) < 2 or len(got_b) < 2):
+            time.sleep(0.01)
+        assert {m["kind"] for m in got_a} == {"x", "direct"}
+        assert {m["kind"] for m in got_b} == {"x", "y"}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_prepared_registry_persistence(tmp_path):
+    from trino_tpu.fleet.registry import PreparedRegistry
+    r1 = PreparedRegistry(str(tmp_path))
+    r1.register("q1", "SELECT 1")
+    # a late joiner (restarted worker) sees statements PREPAREd before
+    # it was born — the sticky-routing durability half
+    r2 = PreparedRegistry(str(tmp_path))
+    assert r2.get("q1") == "SELECT 1"
+    r2.remove("q1")
+    assert PreparedRegistry(str(tmp_path)).get("q1") is None
+
+
+def test_load_quota_map(tmp_path):
+    from trino_tpu.fleet.registry import load_quota_map
+    path = tmp_path / "rg.json"
+    path.write_text(json.dumps({"rootGroups": [
+        {"name": "adhoc", "resultCacheQps": 5,
+         "subGroups": [{"name": "alice", "result_cache_qps": 2,
+                        "result_cache_qps_burst": 7}]},
+        {"name": "free"}]}))
+    quotas = load_quota_map(str(path))
+    assert quotas["adhoc"]["rate"] == 5
+    assert quotas["adhoc.alice"] == {"rate": 2.0, "burst": 7.0}
+    assert "free" not in quotas
+    assert load_quota_map(str(tmp_path / "missing.json")) == {}
+
+
+# --------------------------------------------- keyer/mirror parity (no
+# subprocesses: the engine runs here, the keyer plays the worker)
+
+
+@pytest.fixture(scope="module")
+def mirrored_server(tmp_path_factory):
+    from trino_tpu.exec import LocalQueryRunner
+    from trino_tpu.fleet.server import MirroredResultSetCache
+    from trino_tpu.server import TrinoServer
+    d = tmp_path_factory.mktemp("mirror")
+    tier = SharedCacheTier(str(d / "c.shm"), create=True)
+    runner = LocalQueryRunner.tpch("tiny")
+    cache = MirroredResultSetCache(tier)
+    runner._result_cache = cache
+    runner._plan_cache.add_invalidation_hook(cache.invalidate)
+    srv = TrinoServer(runner).start()
+    yield srv, runner, tier
+    srv.stop()
+
+
+def _http(base, sql, headers=None):
+    req = urllib.request.Request(f"{base}/v1/statement",
+                                 data=sql.encode(), method="POST")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    resp = urllib.request.urlopen(req, timeout=30)
+    payload = json.loads(resp.read())
+    hdrs = dict(resp.headers)
+    rows = list(payload.get("data", []))
+    while "nextUri" in payload:
+        r2 = urllib.request.urlopen(payload["nextUri"], timeout=30)
+        payload = json.loads(r2.read())
+        hdrs.update(dict(r2.headers))
+        rows.extend(payload.get("data", []))
+    return payload, rows, hdrs
+
+
+def test_keyer_digest_matches_engine_publish(mirrored_server):
+    """The load-bearing parity: a worker-side StatementKeyer — no
+    catalogs, no planner — must land on the byte-identical digest the
+    engine's mirrored put used, for plain SQL and EXECUTE ... USING."""
+    from trino_tpu.fleet.keys import StatementKeyer
+    srv, runner, tier = mirrored_server
+    _, _, hdrs = _http(srv.base_uri,
+                       "PREPARE kp FROM SELECT n_name FROM nation "
+                       "WHERE n_nationkey = ?")
+    added = next(v for k, v in hdrs.items()
+                 if k.lower() == "x-trino-added-prepare")
+    from urllib.parse import unquote
+    name, _, enc = added.partition("=")
+    name, psql = unquote(name), unquote(enc)
+    _, rows, _ = _http(srv.base_uri, "EXECUTE kp USING 3",
+                       headers={"X-Trino-Prepared-Statement": added})
+    assert rows == [["CANADA"]]
+    keyer = StatementKeyer(runner.session.catalog, runner.session.schema,
+                           runner.session.start_date)
+    digest = keyer.key_for("EXECUTE kp USING 3", {}, None, None,
+                           {name: psql})
+    assert digest is not None
+    found = tier.get(digest)
+    assert found is not None and found[0].rows == (("CANADA",),)
+    # a different parameter VALUE is a different result key
+    miss = keyer.key_for("EXECUTE kp USING 4", {}, None, None,
+                         {name: psql})
+    assert miss != digest
+    # plain SQL parity
+    _http(srv.base_uri, "SELECT count(*) FROM region")
+    d2 = keyer.key_for("SELECT count(*) FROM region", {}, None, None, {})
+    assert tier.get(d2)[0].rows == ((5,),)
+    # a plan-affecting session override fragments the key (it fragments
+    # the engine's plan-cache key too)
+    d3 = keyer.key_for("SELECT count(*) FROM region",
+                       {"join_distribution_type": "BROADCAST"},
+                       None, None, {})
+    assert d3 != d2
+    # non-keyable statements defer to the engine
+    assert keyer.key_for("INSERT INTO t VALUES (1)", {}, None, None,
+                         {}) is None
+    assert keyer.key_for("EXECUTE unknown USING 1", {}, None, None,
+                         {}) is None
+
+
+def test_mirrored_cache_invalidation_reaches_tier(mirrored_server):
+    from trino_tpu.fleet.keys import StatementKeyer
+    srv, runner, tier = mirrored_server
+    _http(srv.base_uri, "CREATE TABLE memory.default.minv (a BIGINT)")
+    _http(srv.base_uri, "INSERT INTO memory.default.minv VALUES (1)")
+    _, rows, _ = _http(srv.base_uri,
+                       "SELECT count(*) FROM memory.default.minv")
+    assert rows == [[1]]
+    keyer = StatementKeyer(runner.session.catalog, runner.session.schema,
+                           runner.session.start_date)
+    digest = keyer.key_for("SELECT count(*) FROM memory.default.minv",
+                           {}, None, None, {})
+    assert tier.get(digest) is not None
+    # ONE INSERT drops plans, local caches, AND the shared tier
+    _http(srv.base_uri, "INSERT INTO memory.default.minv VALUES (2)")
+    assert tier.get(digest) is None
+    _, rows, _ = _http(srv.base_uri,
+                       "SELECT count(*) FROM memory.default.minv")
+    assert rows == [[2]]
+
+
+# ----------------------------------------------- the fleet, end to end
+
+
+FLEET_RG = {"groups": [
+    {"name": "global"},
+    {"name": "fleetq", "resultCacheQps": 0, "resultCacheQpsBurst": 2},
+]}
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    from trino_tpu.fleet import FleetServer
+    d = tmp_path_factory.mktemp("fleet")
+    rg_path = str(d / "rg.json")
+    with open(rg_path, "w") as fh:
+        json.dump(FLEET_RG, fh)
+    server = FleetServer(
+        workers=2, resource_groups_path=rg_path,
+        warmup_manifest={"statements": [
+            {"name": "fleet_probe",
+             "sql": "SELECT n_name, n_regionkey FROM nation "
+                    "WHERE n_nationkey = ?",
+             "using": "0"}]}).start()
+    yield server
+    server.stop()
+
+
+def _fleet_status(fleet, worker_id=None):
+    out = []
+    for rec in fleet.workers():
+        if worker_id is not None and rec["worker_id"] != worker_id:
+            continue
+        uri = f"http://127.0.0.1:{rec['admin_port']}/v1/fleet/status"
+        out.append(json.loads(
+            urllib.request.urlopen(uri, timeout=10).read()))
+    return out
+
+
+def test_fleet_hit_served_by_worker(fleet):
+    """A repeated EXECUTE is answered from the shared tier by a WORKER
+    process — the engine never sees the second request."""
+    _http(fleet.base_uri, "EXECUTE fleet_probe USING 7")   # publish
+    deadline = time.monotonic() + 10
+    served = 0
+    while time.monotonic() < deadline and served == 0:
+        payload, rows, _ = _http(fleet.base_uri,
+                                 "EXECUTE fleet_probe USING 7")
+        assert payload["stats"]["state"] == "FINISHED"
+        assert rows == [["GERMANY", 3]]
+        served = sum(s["counters"]["hits"] for s in _fleet_status(fleet))
+    assert served >= 1
+
+
+def test_fleet_insert_invalidates_everywhere(fleet):
+    """Correctness under writes: one INSERT through any worker drops
+    the fleet-wide cached answer (generation check, not just the bus),
+    so the next read re-executes against the new data."""
+    _http(fleet.base_uri, "CREATE TABLE memory.default.finv (a BIGINT)")
+    _http(fleet.base_uri, "INSERT INTO memory.default.finv VALUES (1)")
+    sql = "SELECT count(*) FROM memory.default.finv"
+    _, rows, _ = _http(fleet.base_uri, sql)
+    assert rows == [[1]]
+    for _ in range(3):   # let a worker cache it locally
+        _http(fleet.base_uri, sql)
+    _http(fleet.base_uri, "INSERT INTO memory.default.finv VALUES (2)")
+    for _ in range(4):   # whichever worker we land on: fresh data
+        _, rows, _ = _http(fleet.base_uri, sql)
+        assert rows == [[2]]
+
+
+def test_fleet_sticky_prepared_statements(fleet):
+    """PREPARE through one connection, EXECUTE through another with NO
+    prepared header: the fleet registry + bus + engine ingestion make
+    the name resolve wherever the EXECUTE lands."""
+    _http(fleet.base_uri,
+          "PREPARE fleet_sticky FROM SELECT r_name FROM region "
+          "WHERE r_regionkey = ?")
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        payload, rows, _ = _http(fleet.base_uri,
+                                 "EXECUTE fleet_sticky USING 1")
+        if rows == [["AMERICA"]]:
+            return
+        time.sleep(0.1)
+    pytest.fail(f"sticky EXECUTE never resolved: {payload}")
+
+
+def test_fleet_quota_rejects_fleet_wide(fleet):
+    """The shared-memory token bucket binds across ALL workers: burst 2
+    at rate 0 admits exactly 2 fast-path hits fleet-wide, then
+    QUERY_QUEUE_FULL."""
+    sql = "SELECT count(*) FROM supplier"
+    hdr = {"X-Trino-Session": "resource_group=fleetq"}
+    _http(fleet.base_uri, sql, headers=hdr)     # executes (miss path)
+    ok = rejected = 0
+    for _ in range(8):
+        payload, _, _ = _http(fleet.base_uri, sql, headers=hdr)
+        if payload["stats"]["state"] == "FINISHED":
+            ok += 1
+        elif payload.get("error", {}).get("errorName") == \
+                "QUERY_QUEUE_FULL":
+            rejected += 1
+    assert rejected >= 1
+    assert ok <= 2 + 1   # burst 2 (+1 if a race served pre-publish)
+
+
+def test_fleet_aggregated_metrics_and_queries(fleet):
+    """One scrape of the fleet port sees engine families AND per-worker
+    fleet series; worker cache hits are ingested into the engine's
+    tracker so system.runtime.queries reflects fleet traffic."""
+    _http(fleet.base_uri, "EXECUTE fleet_probe USING 9")
+    _http(fleet.base_uri, "EXECUTE fleet_probe USING 9")
+    time.sleep(0.6)    # one hit-batch flush interval
+    text = urllib.request.urlopen(f"{fleet.base_uri}/v1/metrics",
+                                  timeout=15).read().decode()
+    assert "trino_tpu_fleet_worker_hits" in text
+    assert "trino_tpu_fleet_workers" in text
+    assert "trino_tpu_plan_cache_hits" in text      # engine family
+    _, rows, _ = _http(
+        fleet.base_uri,
+        "SELECT count(*) FROM system.runtime.queries "
+        "WHERE query LIKE 'EXECUTE fleet_probe%'")
+    assert rows[0][0] >= 1
+    # group accounting aggregated on the engine: served_from_cache sees
+    # worker-landed hits (exact counts ride the bus batches)
+    g = fleet.engine.groups.get_or_create("global")
+    assert g.served_from_cache >= 1
+
+
+def test_fleet_rolling_restart_zero_drop(fleet):
+    """The zero-drop upgrade: replace every worker mid-load; the closed
+    loop sees no errors and every worker pid changes."""
+    from trino_tpu.fleet.bench_client import run as client_run
+    _http(fleet.base_uri, "EXECUTE fleet_probe USING 2")
+    before = {r["pid"] for r in fleet.workers()}
+    assert len(before) == 2
+    result = {}
+
+    def _restart():
+        time.sleep(0.3)
+        result["fresh"] = fleet.rolling_restart()
+
+    th = threading.Thread(target=_restart, daemon=True)
+    th.start()
+    rec = client_run("127.0.0.1", fleet.port, duration_s=5.0,
+                     warmup_s=0.0, threads=3, mode="hit",
+                     probe="fleet_probe", values=25)
+    th.join(timeout=60)
+    after = {r["pid"] for r in fleet.workers()}
+    assert rec["errors"] == 0, rec
+    assert rec["completed"] > 50
+    assert not (before & after), (before, after)
+    assert len(after) == 2
+    assert len(result.get("fresh", [])) == 2
+
+
+# ------------------------------------------- single-process satellites
+
+
+def test_server_quota_over_http(tmp_path):
+    """Per-group QPS quota on the single-process fast path: over-quota
+    hits answer QUERY_QUEUE_FULL and count as rejections, not serves."""
+    from trino_tpu.exec import LocalQueryRunner
+    from trino_tpu.server import TrinoServer
+    rg = {"groups": [{"name": "capped", "result_cache_qps": 0,
+                      "result_cache_qps_burst": 3}]}
+    path = tmp_path / "rg.json"
+    path.write_text(json.dumps(rg))
+    srv = TrinoServer(LocalQueryRunner.tpch("tiny"),
+                      resource_groups_path=str(path)).start()
+    try:
+        hdr = {"X-Trino-Session": "resource_group=capped"}
+        _http(srv.base_uri, "SELECT count(*) FROM nation", headers=hdr)
+        ok = rejected = 0
+        for _ in range(8):
+            payload, _, _ = _http(srv.base_uri,
+                                  "SELECT count(*) FROM nation",
+                                  headers=hdr)
+            if payload["stats"]["state"] == "FINISHED":
+                ok += 1
+            else:
+                assert payload["error"]["errorName"] == \
+                    "QUERY_QUEUE_FULL"
+                rejected += 1
+        assert ok == 3 and rejected == 5
+        g = srv.groups.get_or_create("capped")
+        assert g.served_from_cache == 3
+        assert g.cache_hit_rejections == 5
+        # surfaced in the system table
+        _, rows, _ = _http(
+            srv.base_uri,
+            "SELECT served_from_cache, cache_hit_rejections FROM "
+            "system.runtime.resource_groups WHERE name = 'capped'")
+        assert rows == [[3, 5]]
+        # the deployment-knob docs are SQL-discoverable
+        _, rows, _ = _http(
+            srv.base_uri,
+            "SELECT count(*) FROM system.runtime.server_properties "
+            "WHERE name = 'drain_timeout_s'")
+        assert rows == [[1]]
+    finally:
+        srv.stop()
+
+
+def test_resource_group_config_hot_reload(tmp_path):
+    """Editing the JSON re-applies on mtime change without a restart —
+    limits AND quotas move; a malformed edit keeps the old tree."""
+    from trino_tpu.exec import LocalQueryRunner
+    from trino_tpu.server import TrinoServer
+    path = tmp_path / "rg.json"
+    path.write_text(json.dumps(
+        {"groups": [{"name": "hot", "maxQueued": 7}]}))
+    srv = TrinoServer(LocalQueryRunner.tpch("tiny"),
+                      resource_groups_path=str(path)).start()
+    try:
+        assert srv.groups.get_or_create("hot").max_queued == 7
+        path.write_text(json.dumps(
+            {"groups": [{"name": "hot", "maxQueued": 3,
+                         "resultCacheQps": 9}]}))
+        os.utime(path, (time.time() + 5, time.time() + 5))
+        srv._rg_watch._checked = 0.0   # skip the 1s stat throttle
+        _http(srv.base_uri, "SELECT 1")    # any POST triggers the check
+        g = srv.groups.get_or_create("hot")
+        assert g.max_queued == 3 and g.result_cache_qps == 9
+        assert srv._rg_reloads == 1
+        # malformed edit: warn, keep serving with the previous config
+        path.write_text("{not json")
+        os.utime(path, (time.time() + 10, time.time() + 10))
+        srv._rg_watch._checked = 0.0
+        _http(srv.base_uri, "SELECT 1")
+        assert srv.groups.get_or_create("hot").max_queued == 3
+    finally:
+        srv.stop()
+
+
+def test_server_stop_drains_open_stream():
+    """Satellite: stop() no longer strands open nextUri streams — a
+    mid-pagination client finishes its result during the drain window,
+    new POSTs are rejected, and teardown completes."""
+    from trino_tpu.exec import LocalQueryRunner
+    from trino_tpu.server import TrinoServer
+    srv = TrinoServer(LocalQueryRunner.tpch("tiny"),
+                      stream_ring_chunks=1, result_cache=False,
+                      scan_cache=False).start()
+    req = urllib.request.Request(f"{srv.base_uri}/v1/statement",
+                                 data=b"SELECT c_custkey FROM customer",
+                                 method="POST")
+    payload = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    while "nextUri" in payload and not payload.get("data"):
+        payload = json.loads(urllib.request.urlopen(
+            payload["nextUri"], timeout=30).read())
+    rows = list(payload.get("data", []))
+    stopped = threading.Event()
+    threading.Thread(target=lambda: (srv.stop(), stopped.set()),
+                     daemon=True).start()
+    time.sleep(0.2)
+    assert not stopped.is_set()    # stream open: drain is waiting
+    req2 = urllib.request.Request(f"{srv.base_uri}/v1/statement",
+                                  data=b"SELECT 1", method="POST")
+    rejected = json.loads(urllib.request.urlopen(req2, timeout=10).read())
+    assert rejected["error"]["errorName"] == "SERVER_SHUTTING_DOWN"
+    while "nextUri" in payload:
+        payload = json.loads(urllib.request.urlopen(
+            payload["nextUri"], timeout=30).read())
+        rows.extend(payload.get("data", []))
+    assert len(rows) == 1500
+    assert payload["stats"]["state"] == "FINISHED"
+    assert stopped.wait(20)
+
+
+def test_server_stop_fast_when_idle():
+    from trino_tpu.exec import LocalQueryRunner
+    from trino_tpu.server import TrinoServer
+    srv = TrinoServer(LocalQueryRunner.tpch("tiny")).start()
+    t0 = time.monotonic()
+    srv.stop()
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_prometheus_merge():
+    from trino_tpu.fleet.metrics import merge_prometheus
+    a = ("# HELP m_total things\n# TYPE m_total counter\n"
+         "m_total 3\nm_total{w=\"1\"} 2\n"
+         "wall_seconds_sum 5.1e-05\n")
+    b = ("# HELP m_total things\n# TYPE m_total counter\n"
+         "m_total 4\nm_total{w=\"2\"} 5\n"
+         "wall_seconds_sum 4.9e-05\n")
+    merged = merge_prometheus([a, b])
+    lines = merged.splitlines()
+    assert "m_total 7" in lines
+    assert 'm_total{w="1"} 2' in lines
+    assert 'm_total{w="2"} 5' in lines
+    assert lines.count("# TYPE m_total counter") == 1
+    # negative-exponent floats are legal exposition (a 51us histogram
+    # sum renders as 5.1e-05) and must merge, not silently drop
+    summed = next(float(line.split()[1]) for line in lines
+                  if line.startswith("wall_seconds_sum "))
+    assert abs(summed - 1e-4) < 1e-9, summed

@@ -106,6 +106,47 @@ def test_slow_client_backpressure_bounds_ring():
         srv.stop()
 
 
+def test_stall_timeout_cancels_over_real_http():
+    """A client that vanishes mid-stream without DELETE must not pin an
+    executor: `stream_stall_timeout_s` fires, the query unwinds as
+    CANCELED over the real HTTP path, the executor is freed for the
+    next statement, and the leak gate reads pool == 0."""
+    from trino_tpu.exec.memory import NODE_POOL
+    from trino_tpu.exec.query_tracker import TRACKER
+    srv = TrinoServer(LocalQueryRunner.tpch("tiny"),
+                      stream_ring_chunks=1, stream_stall_timeout_s=1.0,
+                      max_running=1, result_cache=False,
+                      scan_cache=False).start()
+    try:
+        payload = _post(srv, "SELECT o_orderkey FROM orders")
+        qid = payload["id"]
+        # read until the first data page, then VANISH (no DELETE): the
+        # 1-slot ring parks the producer in put()
+        while "nextUri" in payload and not payload.get("data"):
+            payload = _get(payload["nextUri"])
+        assert payload.get("data")
+        deadline = time.monotonic() + 15
+        info = None
+        while time.monotonic() < deadline:
+            info = next(q for q in TRACKER.list() if q.query_id == qid)
+            if info.state == "CANCELED":
+                break
+            time.sleep(0.05)
+        assert info is not None and info.state == "CANCELED", info.state
+        # the ONLY executor (max_running=1) is free again: a follow-up
+        # statement dispatches and completes
+        done, rows, _ = _drain(srv, "SELECT count(*) FROM nation")
+        assert rows == [[25]]
+        assert done["stats"]["state"] == "FINISHED"
+        # and the canceled query's reservations all rolled back
+        deadline = time.monotonic() + 5
+        while NODE_POOL.reserved != 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert NODE_POOL.reserved == 0
+    finally:
+        srv.stop()
+
+
 def test_stream_ring_unit():
     """ResultStream protocol unit: full chunks publish immediately, the
     partial remainder stages until flush/close (so every non-final

@@ -66,6 +66,8 @@ TABLES: Dict[str, tuple] = {
         ("queued", T.BIGINT), ("running", T.BIGINT),
         ("started", T.BIGINT), ("finished", T.BIGINT),
         ("served_from_cache", T.BIGINT),
+        ("cache_hit_rejections", T.BIGINT),
+        ("result_cache_qps", T.DOUBLE),
         ("hard_concurrency", T.BIGINT), ("max_queued", T.BIGINT),
         ("soft_memory_limit_bytes", T.BIGINT),
         ("scheduling_weight", T.BIGINT),
@@ -83,6 +85,12 @@ TABLES: Dict[str, tuple] = {
     "metrics": (
         ("name", T.VarcharType()), ("kind", T.VarcharType()),
         ("labels", T.VarcharType()), ("value", T.DOUBLE)),
+    # deployment-level server/fleet knobs (metadata.SERVER_PROPERTY_DOCS):
+    # constructor properties, not session properties — surfaced so
+    # operators can discover them the same way they discover session
+    # properties through SHOW SESSION
+    "server_properties": (
+        ("name", T.VarcharType()), ("description", T.VarcharType())),
 }
 
 
@@ -146,6 +154,9 @@ def _rows_for(table: str) -> List[tuple]:
                  g.parent.name if g.parent is not None else None,
                  g.queued, len(g.running), g.started, g.finished,
                  g.served_from_cache,
+                 g.cache_hit_rejections,
+                 g.result_cache_qps if g.result_cache_qps is not None
+                 else 0.0,
                  g.hard_concurrency, g.max_queued,
                  g.soft_memory_limit_bytes if
                  g.soft_memory_limit_bytes is not None else 0,
@@ -177,6 +188,9 @@ def _rows_for(table: str) -> List[tuple]:
     if table == "metrics":
         from trino_tpu.obs.metrics import REGISTRY
         return REGISTRY.samples()
+    if table == "server_properties":
+        from trino_tpu.metadata import SERVER_PROPERTY_DOCS
+        return sorted(SERVER_PROPERTY_DOCS.items())
     raise KeyError(table)
 
 

@@ -74,6 +74,17 @@ class ResourceGroup:
         # started/finished too, with this column splitting out how many
         # of those completions were zero-cost.
         self.served_from_cache = 0
+        # per-group QPS quota on that fast path (round 14): a token
+        # bucket refilled at `result_cache_qps` tokens/s up to
+        # `result_cache_qps_burst`; an over-quota hit is REJECTED with
+        # QUERY_QUEUE_FULL on the wire instead of served — the
+        # enforcement half of the served_from_cache accounting. None =
+        # unlimited. Enforced at every level of the chain.
+        self.result_cache_qps: Optional[float] = None
+        self.result_cache_qps_burst: Optional[float] = None
+        self._rc_tokens = 0.0
+        self._rc_stamp: Optional[float] = None
+        self.cache_hit_rejections = 0
         self.scheduled_wall_s = 0.0   # execution wall charged to subtree
         # EWMA of observed execution-slice wall: the stride quantum a
         # start pre-charges (reconciled by `charge` when the real slice
@@ -192,20 +203,38 @@ class ResourceGroupManager:
                 raise ValueError(
                     "resource group config needs a top-level 'groups' or "
                     f"'rootGroups' list (got keys: {sorted(tree)})")
+        visited: set = set()
         for spec in groups:
-            self._configure_group_spec(spec, prefix="")
+            self._configure_group_spec(spec, prefix="", visited=visited)
+        # quotas are DECLARATIVE all the way: a group whose spec was
+        # REMOVED from the file must lose its quota too (a hot reload
+        # that drops the group entirely means 'unlimited', matching the
+        # fleet workers' rebuilt-from-scratch quota map). Other limits
+        # keep their last configured values — they have safe in-code
+        # defaults; a lingering quota keeps rejecting users.
+        with self._cond:
+            for g in self._by_name.values():
+                if g.name not in visited and \
+                        g.result_cache_qps is not None:
+                    g.result_cache_qps = None
+                    g.result_cache_qps_burst = None
 
-    def _configure_group_spec(self, spec: dict, prefix: str) -> None:
+    def _configure_group_spec(self, spec: dict, prefix: str,
+                              visited: Optional[set] = None) -> None:
         name = str(spec.get("name", "")).strip()
         if not name:
             raise ValueError("resource group spec without a name")
         full = f"{prefix}.{name}" if prefix else name
+        if visited is not None:
+            visited.add(full)
         known = {"name", "subgroups", "subGroups",
                  "hard_concurrency", "hardConcurrencyLimit",
                  "max_queued", "maxQueued",
                  "weight", "scheduling_weight", "schedulingWeight",
                  "soft_memory_limit", "softMemoryLimit",
                  "soft_memory_limit_bytes",
+                 "result_cache_qps", "resultCacheQps",
+                 "result_cache_qps_burst", "resultCacheQpsBurst",
                  # reference keys with no engine counterpart yet —
                  # tolerated (valid config, unimplemented feature), NOT
                  # typos: scheduling here is always weighted-fair and
@@ -231,6 +260,23 @@ class ResourceGroupManager:
                             f"resource group {full!r}: bad {k} value "
                             f"{spec[k]!r}: {e}") from e
                     break
+        for key, aliases in (
+                ("result_cache_qps", ("resultCacheQps",)),
+                ("result_cache_qps_burst", ("resultCacheQpsBurst",))):
+            # quota config is DECLARATIVE per spec: an absent key means
+            # unlimited, so a hot-reload that deletes the quota clears
+            # it here exactly like the workers' rebuilt-from-scratch
+            # quota map does — the fleet cannot split-brain on a removal
+            config[key] = None
+            for k in (key,) + aliases:
+                if k in spec:
+                    try:
+                        config[key] = float(spec[k])
+                    except (TypeError, ValueError) as e:
+                        raise ValueError(
+                            f"resource group {full!r}: bad {k} value "
+                            f"{spec[k]!r}: {e}") from e
+                    break
         for k in ("soft_memory_limit", "softMemoryLimit",
                   "soft_memory_limit_bytes"):
             if k in spec:
@@ -245,7 +291,7 @@ class ResourceGroupManager:
                 break
         self.configure(full, **config)
         for sub in spec.get("subgroups", spec.get("subGroups", [])):
-            self._configure_group_spec(sub, prefix=full)
+            self._configure_group_spec(sub, prefix=full, visited=visited)
 
     @classmethod
     def from_file(cls, path: str, **manager_kwargs) -> "ResourceGroupManager":
@@ -266,6 +312,10 @@ class ResourceGroupManager:
                         key != "weight" else max(1, int(config.pop(key))))
         if "soft_memory_limit_bytes" in config:
             g.soft_memory_limit_bytes = config.pop("soft_memory_limit_bytes")
+        if "result_cache_qps" in config:
+            g.result_cache_qps = config.pop("result_cache_qps")
+        if "result_cache_qps_burst" in config:
+            g.result_cache_qps_burst = config.pop("result_cache_qps_burst")
         if config:
             raise TypeError(f"unknown resource group config: {config}")
 
@@ -337,24 +387,83 @@ class ResourceGroupManager:
                 a.finished += 1
             self._cond.notify_all()
 
-    def record_cache_hit(self, group_name: str) -> ResourceGroup:
-        """Account a result-cache fast-path completion to its group
+    def record_cache_hit_rejection(self, group_name: str,
+                                   n: int = 1) -> None:
+        """Account quota rejections that were ENFORCED elsewhere (the
+        fleet's shared-memory buckets — worker-side or the engine's
+        fast_path_quota seam): the group's rejection counters must read
+        true fleet-wide even though no in-process bucket fired."""
+        with self._cond:
+            if group_name.strip() not in self._by_name \
+                    and len(self._by_name) >= self.max_groups:
+                group_name = "global"
+            g = self._get_or_create_locked(group_name)
+            g.cache_hit_rejections += n
+
+    def record_cache_hit(self, group_name: str, n: int = 1,
+                         enforce: bool = True) -> Optional[ResourceGroup]:
+        """Account `n` result-cache fast-path completions to the group
         chain: the POST-time hit bypasses submit/take/finish entirely
         (zero executor cost to admit — that stays true), but without
         this the group's completed-query counters would under-read its
         real traffic and a group QPS quota would never see cached load.
-        No stride/pass movement: the hit consumed no executor wall."""
+        No stride/pass movement: the hit consumed no executor wall.
+
+        With `enforce` (the default), every chain level with a
+        configured `result_cache_qps` must grant a token from its
+        bucket FIRST; an over-quota hit returns None — nothing is
+        counted except the rejection — and the caller answers
+        QUERY_QUEUE_FULL instead of the cached data. `enforce=False` is
+        the accounting-only path for hits whose quota was already
+        checked elsewhere (the fleet's workers check the SHARED bucket
+        before serving; the engine then ingests their counts)."""
+        now = time.monotonic()
         with self._cond:
             if group_name.strip() not in self._by_name \
                     and len(self._by_name) >= self.max_groups:
                 group_name = "global"   # same bound as submit(): an
                 # untrusted header name must not mint server state
             g = self._get_or_create_locked(group_name)
-            for a in g._chain():
-                a.started += 1
-                a.finished += 1
-                a.served_from_cache += 1
+            chain = g._chain()
+            if enforce:
+                for a in chain:
+                    if not self._rc_bucket_take_locked(a, now, float(n)):
+                        for b in chain:     # refund the levels already
+                            if b is a:      # charged (all-or-nothing)
+                                break
+                            if b.result_cache_qps is not None:
+                                b._rc_tokens += float(n)
+                        a.cache_hit_rejections += n
+                        return None
+            for a in chain:
+                a.started += n
+                a.finished += n
+                a.served_from_cache += n
             return g
+
+    @staticmethod
+    def _rc_bucket_take_locked(g: ResourceGroup, now: float,
+                               n: float) -> bool:
+        rate = g.result_cache_qps
+        if rate is None:
+            return True
+        burst = g.result_cache_qps_burst \
+            if g.result_cache_qps_burst is not None else max(rate, 1.0)
+        if g._rc_stamp is None:
+            g._rc_tokens = burst
+            g._rc_stamp = now
+        else:
+            # `now` was read BEFORE the caller took the manager lock: a
+            # loser of the lock race can arrive with now < _rc_stamp,
+            # and an unclamped negative delta would drain tokens and
+            # rewind the stamp (double-crediting the next caller)
+            elapsed = max(0.0, now - g._rc_stamp)
+            g._rc_tokens = min(burst, g._rc_tokens + elapsed * rate)
+            g._rc_stamp = max(g._rc_stamp, now)
+        if g._rc_tokens < n:
+            return False
+        g._rc_tokens -= n
+        return True
 
     def charge(self, group: ResourceGroup, seconds: float,
                query_id: Optional[str] = None) -> None:

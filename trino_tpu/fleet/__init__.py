@@ -1,0 +1,26 @@
+"""Fleet serving: SO_REUSEPORT worker processes over one device runner.
+
+The serving tier past one process (ROADMAP item 2): `FleetServer` owns
+the single device runner (wrapped in a private TrinoServer — jit cache,
+plan cache, node pool, table cache stay single-owner) and spawns N
+`WorkerServer` processes that all accept on ONE port via SO_REUSEPORT.
+Workers answer result-cache hits locally from a cross-process mmap
+cache tier (`SharedCacheTier`) with fleet-wide per-group QPS quotas,
+funnel misses to the engine over local dispatch connections, keep
+prepared statements sticky fleet-wide, aggregate `/v1/metrics` and
+`system.runtime.queries` across the fleet, and drain gracefully so a
+rolling restart drops zero queries.
+"""
+
+from trino_tpu.fleet.bus import FleetBus
+from trino_tpu.fleet.keys import StatementKeyer
+from trino_tpu.fleet.registry import PreparedRegistry, load_quota_map
+from trino_tpu.fleet.server import FleetServer, MirroredResultSetCache
+from trino_tpu.fleet.shm import SharedCacheTier, key_fingerprint
+from trino_tpu.fleet.worker import WorkerServer
+
+__all__ = [
+    "FleetBus", "FleetServer", "MirroredResultSetCache",
+    "PreparedRegistry", "SharedCacheTier", "StatementKeyer",
+    "WorkerServer", "key_fingerprint", "load_quota_map",
+]

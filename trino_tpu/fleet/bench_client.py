@@ -1,0 +1,132 @@
+"""Closed-loop QPS bench client — one load-generator PROCESS.
+
+Stdlib-only on purpose: the fleet bench (fleet/bench_fleet.py) spawns
+one of these per client process so the LOAD GENERATOR scales past a
+single Python process's GIL the same way the serving fleet does —
+measuring the fleet through a single-process generator would cap the
+curve at the generator, not the server.
+
+Each thread runs the closed loop (exactly one request in flight:
+sustained QPS = completed / window), POSTing `EXECUTE <probe> USING k`
+on a persistent connection and following `nextUri`. Transport errors on
+an idle persistent connection retry once after reconnecting — that is
+the StatementClientV1 behavior, and it is what makes a rolling
+restart's `Connection: close` handoff invisible: the server finishes
+the in-flight response, closes, and the client's next request
+transparently reconnects (landing on a surviving listener). A query
+only counts as an error when it actually failed or the retry did too.
+
+Usage (spawned, not typed):
+    python -m trino_tpu.fleet.bench_client HOST PORT DURATION_S \
+        WARMUP_S THREADS MODE PROBE VALUES
+prints one JSON line: {"completed", "errors", "lat": [decimated sorted
+latencies, seconds]}.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import sys
+import threading
+import time
+from typing import Dict, List
+
+MAX_LAT_SAMPLES = 2000
+
+
+def _one_query(conn_box: List, host: str, port: int, body: str,
+               headers: Dict[str, str]) -> bool:
+    """POST + drain; True when the statement FINISHED. Reconnect-retry
+    once on a transport error that raced a connection close."""
+    for attempt in range(2):
+        conn = conn_box[0]
+        if conn is None:
+            conn = conn_box[0] = http.client.HTTPConnection(
+                host, port, timeout=30)
+        try:
+            conn.request("POST", "/v1/statement", body=body,
+                         headers=headers)
+            resp = conn.getresponse()
+            payload = json.loads(resp.read())
+            while "nextUri" in payload:
+                path = payload["nextUri"].split(f":{port}", 1)[1]
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                payload = json.loads(resp.read())
+            return payload["stats"]["state"] == "FINISHED" \
+                and "error" not in payload
+        except (http.client.HTTPException, OSError, ValueError):
+            try:
+                conn.close()
+            except OSError:
+                pass
+            conn_box[0] = None
+            if attempt:
+                return False
+    return False
+
+
+def _loop(host: str, port: int, idx: int, stop_at: float,
+          measure_from: float, mode: str, probe: str, values: int,
+          out: Dict, lock: threading.Lock) -> None:
+    conn_box: List = [None]
+    headers = {"X-Trino-User": f"bench-{idx}"}
+    if mode == "miss":
+        # misses on purpose: the statement dispatches and executes every
+        # time (the probe/result-cache is disabled for this session)
+        headers["X-Trino-Session"] = "result_cache_enabled=false"
+    n = 0
+    while time.monotonic() < stop_at:
+        value = (idx * 7 + n) % values
+        n += 1
+        t0 = time.monotonic()
+        ok = _one_query(conn_box, host, port,
+                        f"EXECUTE {probe} USING {value}", headers)
+        dt = time.monotonic() - t0
+        if t0 < measure_from:
+            continue
+        with lock:
+            if ok:
+                out["completed"] += 1
+                out["lat"].append(dt)
+            else:
+                out["errors"] += 1
+    if conn_box[0] is not None:
+        conn_box[0].close()
+
+
+def run(host: str, port: int, duration_s: float, warmup_s: float,
+        threads: int, mode: str, probe: str, values: int) -> Dict:
+    out: Dict = {"completed": 0, "errors": 0, "lat": []}
+    lock = threading.Lock()
+    now = time.monotonic()
+    stop_at = now + warmup_s + duration_s
+    measure_from = now + warmup_s
+    ts = [threading.Thread(
+        target=_loop, args=(host, port, i, stop_at, measure_from, mode,
+                            probe, values, out, lock), daemon=True)
+        for i in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=duration_s + warmup_s + 60)
+    lat = sorted(out["lat"])
+    if len(lat) > MAX_LAT_SAMPLES:   # decimate, keep the distribution
+        step = len(lat) / MAX_LAT_SAMPLES
+        lat = [lat[int(i * step)] for i in range(MAX_LAT_SAMPLES)]
+    return {"completed": out["completed"], "errors": out["errors"],
+            "lat": [round(x, 6) for x in lat]}
+
+
+def main(argv: List[str]) -> int:
+    host, port, duration_s, warmup_s, threads, mode, probe, values = (
+        argv[0], int(argv[1]), float(argv[2]), float(argv[3]),
+        int(argv[4]), argv[5], argv[6], int(argv[7]))
+    print(json.dumps(run(host, port, duration_s, warmup_s, threads,
+                         mode, probe, values)), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

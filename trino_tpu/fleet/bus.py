@@ -1,0 +1,139 @@
+"""Fleet bus: unix-datagram fan-out between fleet members.
+
+A tiny local pub/sub for the coordination traffic that is ADVISORY, not
+authoritative: invalidation notices (workers drop their hot local
+copies — the shm generation check in fleet/shm.py is the authority, so
+a lost datagram can delay eviction of a dead local copy but can never
+cause a stale answer), prepared-statement registration (a PREPARE on
+any worker becomes visible fleet-wide immediately; the on-disk registry
+covers late joiners), cache-hit accounting batches (workers -> engine,
+for fleet-aggregated group counters and system.runtime.queries), drain
+requests, and config-reload nudges.
+
+Every member binds `<fleet_dir>/bus/<name>.sock`; `publish` sends the
+JSON message to every socket in the directory (best-effort, non-
+blocking — a dead member's stale socket file is unlinked on the first
+failed send). `send_to` addresses one member by name.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+MAX_DGRAM = 60000
+
+
+class FleetBus:
+    def __init__(self, fleet_dir: str, name: str,
+                 on_message: Optional[Callable[[Dict], None]] = None):
+        self.dir = os.path.join(fleet_dir, "bus")
+        os.makedirs(self.dir, exist_ok=True)
+        self.name = name
+        self.path = os.path.join(self.dir, f"{name}.sock")
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        self._sock.bind(self.path)
+        self._sock.settimeout(0.25)
+        self._send = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        self._send.setblocking(False)
+        self._on_message = on_message
+        self._closed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if on_message is not None:
+            self._thread = threading.Thread(target=self._recv_loop,
+                                            daemon=True,
+                                            name=f"fleet-bus-{name}")
+            self._thread.start()
+
+    # ------------------------------------------------------------ sending
+
+    def members(self) -> List[str]:
+        try:
+            return sorted(f[:-5] for f in os.listdir(self.dir)
+                          if f.endswith(".sock"))
+        except FileNotFoundError:
+            return []
+
+    def publish(self, message: Dict, exclude_self: bool = False) -> int:
+        """Send to every live member socket; returns the delivered
+        count. Best-effort: full buffers and vanished members drop the
+        datagram (the shm generation check keeps that safe)."""
+        data = json.dumps(message).encode()
+        if len(data) > MAX_DGRAM:
+            return 0
+        delivered = 0
+        for member in self.members():
+            if exclude_self and member == self.name:
+                continue
+            if self._send_one(member, data):
+                delivered += 1
+        return delivered
+
+    def send_to(self, member: str, message: Dict) -> bool:
+        return self._send_one(member, json.dumps(message).encode())
+
+    def _send_one(self, member: str, data: bytes) -> bool:
+        path = os.path.join(self.dir, f"{member}.sock")
+        try:
+            self._send.sendto(data, path)
+            return True
+        except (ConnectionRefusedError, FileNotFoundError):
+            if member != self.name:
+                self._reap_stale(path)
+            return False
+        except (BlockingIOError, OSError):
+            return False
+
+    @staticmethod
+    def _reap_stale(path: str) -> None:
+        """Unlink a dead member's socket — but only when the path has
+        existed for a while: a member restarting under the SAME name
+        (engine warm restart) may have re-bound between our failed send
+        and this cleanup, and unlinking its fresh socket would mute it
+        on the bus forever. The binder unlinks its own stale path at
+        bind time, so skipping here is always safe."""
+        try:
+            if time.time() - os.stat(path).st_mtime > 5.0:
+                os.unlink(path)
+        except OSError:
+            pass
+
+    # ---------------------------------------------------------- receiving
+
+    def _recv_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                data, _ = self._sock.recvfrom(MAX_DGRAM)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                message = json.loads(data)
+            except ValueError:
+                continue
+            try:
+                self._on_message(message)
+            except Exception:   # noqa: BLE001 — a bad handler must not
+                continue        # kill the bus thread
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._sock.close()
+        finally:
+            self._send.close()
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=2)

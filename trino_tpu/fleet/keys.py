@@ -1,0 +1,211 @@
+"""Worker-side statement keying: the engine's result-cache key, without
+the engine.
+
+A fleet worker answers result-cache hits locally, so it must compute —
+from nothing but the SQL text, the request headers, and the fleet's
+prepared-statement registry — the EXACT key the engine's runner used
+when it published the result (exec/runner._result_cache_key): the
+plan-cache key (canonical literal-free statement fingerprint + masked
+literal values + catalog/schema/current_date + bound parameter types +
+plan-affecting session properties) plus the bound parameter values.
+Both sides then collapse the key to a 16-byte digest
+(fleet/shm.key_fingerprint), which is what the shared tier is keyed on.
+
+Parsing and fingerprinting are pure functions of the statement text, so
+no catalog resolution (and no device, no planner) is needed — and the
+result is memoized per (sql, context) so the steady-state hit path is a
+dict lookup, not a parse.
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from trino_tpu import types as T
+from trino_tpu.exec.plan_cache import PLAN_PROPERTIES, statement_fingerprint
+from trino_tpu.fleet.shm import key_fingerprint
+from trino_tpu.metadata import SESSION_PROPERTY_DEFAULTS, _coerce_property
+
+MEMO_MAX = 8192
+
+# request gates mirroring the server's POST-time probe: only these
+# statement heads can resolve to a cached result
+PROBE_HEADS = ("SELECT", "EXECUTE", "WITH", "VALUES", "(", "TABLE")
+
+
+class KeyInfo:
+    __slots__ = ("digest", "cacheable")
+
+    def __init__(self, digest: Optional[bytes]):
+        self.digest = digest
+        self.cacheable = digest is not None
+
+
+class StatementKeyer:
+    def __init__(self, catalog: Optional[str], schema: Optional[str],
+                 start_date: int,
+                 base_properties: Optional[Dict[str, Any]] = None):
+        self.catalog = catalog
+        self.schema = schema
+        self.start_date = start_date
+        # the engine base session's plan-affecting property values: a
+        # worker must key exactly like the engine's session would
+        self.base_properties = dict(base_properties or {})
+        self._lock = threading.Lock()
+        self._memo: "collections.OrderedDict[tuple, KeyInfo]" = \
+            collections.OrderedDict()
+
+    # ------------------------------------------------------------- context
+
+    def _plan_props(self, overrides: Dict[str, str]) -> Tuple:
+        out = []
+        for prop in PLAN_PROPERTIES:
+            if prop in overrides:
+                value = _coerce_property(prop, overrides[prop])
+            elif prop in self.base_properties:
+                value = self.base_properties[prop]
+            else:
+                value = SESSION_PROPERTY_DEFAULTS[prop]
+            out.append((prop, value))
+        return tuple(out)
+
+    # -------------------------------------------------------------- keying
+
+    def key_for(self, sql: str, overrides: Dict[str, str],
+                catalog: Optional[str], schema: Optional[str],
+                prepared: Dict[str, str]) -> Optional[bytes]:
+        """16-byte shared-tier digest for `sql` under the request's
+        session context, or None when the statement cannot be keyed
+        without the engine (non-query, NULL parameters, unknown prepared
+        name, parse trouble — all of which defer to the dispatch path).
+        `prepared` maps parser-normalized names to statement SQL (fleet
+        registry merged with the request's own header)."""
+        head = sql.lstrip()[:8].upper()
+        if not head.startswith(PROBE_HEADS):
+            return None
+        catalog = catalog or self.catalog
+        schema = schema or self.schema
+        plan_props = self._plan_props(overrides)
+        prepared_sig = None
+        if head.startswith("EXECUTE"):
+            # the memo must key on the prepared statement's TEXT, not
+            # its name — DEALLOCATE + re-PREPARE under one name must
+            # not serve the old statement's key
+            name = self._execute_name(sql)
+            if name is None:
+                return None
+            prepared_sig = prepared.get(name)
+            if prepared_sig is None:
+                return None
+        memo_key = (sql, catalog, schema, plan_props, prepared_sig)
+        with self._lock:
+            info = self._memo.get(memo_key)
+            if info is not None:
+                self._memo.move_to_end(memo_key)
+                return info.digest
+        info = KeyInfo(self._compute(sql, catalog, schema, plan_props,
+                                     prepared))
+        with self._lock:
+            self._memo[memo_key] = info
+            while len(self._memo) > MEMO_MAX:
+                self._memo.popitem(last=False)
+        return info.digest
+
+    _EXEC_NAME = re.compile(
+        r'^\s*execute\s+("(?:[^"]|"")*"|[A-Za-z_][A-Za-z0-9_]*)\b',
+        re.IGNORECASE)
+
+    @classmethod
+    def _execute_name(cls, sql: str) -> Optional[str]:
+        """Parser-normalized EXECUTE statement name. Regex fast path —
+        this runs BEFORE the memo on every EXECUTE, so a full parse
+        here would cost as much as the computation the memo avoids
+        (unquoted identifiers lowercase, quoted verbatim with ""
+        unescaped — the parser's normalization). Falls back to the
+        parser for anything the regex doesn't recognize."""
+        m = cls._EXEC_NAME.match(sql)
+        if m is not None:
+            name = m.group(1)
+            if name.startswith('"'):
+                return name[1:-1].replace('""', '"')
+            return name.lower()
+        from trino_tpu.sql import parse_statement
+        from trino_tpu.sql import tree as t
+        try:
+            stmt = parse_statement(sql)
+        except Exception:
+            return None
+        if not isinstance(stmt, t.ExecuteStatement):
+            return None
+        return stmt.name.value
+
+    def _compute(self, sql: str, catalog, schema, plan_props,
+                 prepared: Dict[str, str]) -> Optional[bytes]:
+        from trino_tpu.sql import parse_statement
+        from trino_tpu.sql import tree as t
+        from trino_tpu.sql.analyzer import count_parameters
+        try:
+            stmt = parse_statement(sql)
+        except Exception:
+            return None
+        params: Tuple[Any, ...] = ()
+        param_types = None
+        if isinstance(stmt, t.ExecuteStatement):
+            text = prepared.get(stmt.name.value)
+            if text is None:
+                return None
+            try:
+                target = parse_statement(text)
+            except Exception:
+                return None
+            if not isinstance(target, t.Query):
+                return None
+            if count_parameters(target) != len(stmt.parameters):
+                return None
+            if stmt.parameters:
+                bound = self._bind_parameters(stmt)
+                if bound is None:
+                    return None
+                param_types, params = bound
+                if any(v is None for v in params):
+                    return None    # NULLs re-plan engine-side
+            stmt = target
+        if not isinstance(stmt, t.Query):
+            return None
+        skeleton, values = statement_fingerprint(stmt)
+        plan_key = (skeleton, values, catalog, schema, self.start_date,
+                    None if param_types is None
+                    else tuple(t_.display() for t_ in param_types),
+                    plan_props)
+        return key_fingerprint((plan_key, params))
+
+    def _bind_parameters(self, stmt):
+        """USING values -> (types, python values); the runner's
+        _bind_execute_parameters contract (constants only, negation
+        folded, strings normalize to unbounded varchar)."""
+        from trino_tpu.expr.ir import Call as IRCall, Literal as IRLiteral
+        from trino_tpu.metadata import Session
+        from trino_tpu.planner.translate import ExpressionTranslator, Scope
+        session = Session(catalog=self.catalog, schema=self.schema,
+                          start_date=self.start_date)
+        tr = ExpressionTranslator(Scope([]), session=session)
+        types, values = [], []
+        for expr in stmt.parameters:
+            try:
+                lit = tr.translate(expr)
+            except Exception:
+                return None
+            if isinstance(lit, IRCall) and lit.name == "negate" and \
+                    isinstance(lit.args[0], IRLiteral):
+                lit = IRLiteral(-lit.args[0].value, lit.type)
+            if not isinstance(lit, IRLiteral):
+                return None
+            typ = lit.type
+            if T.is_string(typ):
+                typ = T.VARCHAR
+            types.append(typ)
+            values.append(lit.value)
+        return tuple(types), tuple(values)

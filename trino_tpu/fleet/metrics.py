@@ -1,0 +1,103 @@
+"""Fleet-aggregated metrics: merge Prometheus expositions across
+processes.
+
+`GET /v1/metrics` on the fleet's shared port answers with the SUM over
+the engine process and every live worker — one scrape sees the whole
+fleet, exactly like the jmx-prometheus federation a reference
+deployment fronts its coordinators with. Counters, gauges, and
+histogram bucket/sum/count samples with identical (name, labels) sum;
+HELP/TYPE headers keep their first-seen text.
+"""
+
+from __future__ import annotations
+
+import http.client
+import re
+from typing import Dict, List, Optional, Tuple
+
+# the value group must admit negative exponents (5.1e-05 is legal
+# exposition a 51us histogram sum actually renders — the PR-12 test
+# regex learned this the hard way) and +/-Inf/NaN; float() is the
+# actual validator
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)\s*$")
+
+
+def merge_prometheus(texts: List[str]) -> str:
+    """Sum samples with identical name+labels across expositions,
+    preserving first-seen ordering and headers."""
+    order: List[Tuple[str, Optional[str]]] = []   # sample keys in order
+    values: Dict[Tuple[str, Optional[str]], float] = {}
+    headers: Dict[str, List[str]] = {}            # family -> header lines
+    family_of: Dict[str, str] = {}                # sample name -> family
+    for text in texts:
+        family = None
+        for line in text.splitlines():
+            line = line.rstrip()
+            if not line:
+                continue
+            if line.startswith("#"):
+                parts = line.split(None, 3)
+                if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                    family = parts[2]
+                    headers.setdefault(family, [])
+                    if not any(f" {parts[1]} " in h
+                               for h in headers[family]):
+                        headers[family].append(line)
+                continue
+            m = _SAMPLE.match(line)
+            if m is None:
+                continue
+            name, labels, raw = m.group(1), m.group(2), m.group(3)
+            try:
+                value = float(raw)
+            except ValueError:
+                continue
+            key = (name, labels)
+            if key not in values:
+                values[key] = 0.0
+                order.append(key)
+            values[key] += value
+            # samples of one family share its prefix (name, name_bucket,
+            # name_sum, name_count); remember the family for grouping
+            if family is not None and name.startswith(family):
+                family_of.setdefault(name, family)
+    lines: List[str] = []
+    emitted_headers = set()
+    for name, labels in order:
+        family = family_of.get(name, name)
+        if family not in emitted_headers:
+            emitted_headers.add(family)
+            lines.extend(headers.get(family, []))
+        lines.append(f"{name}{labels or ''} "
+                     f"{_render_value(values[(name, labels)])}")
+    return "\n".join(lines) + "\n"
+
+
+def _render_value(value: float) -> str:
+    """Prometheus exposition rendering, incl. the non-finite values the
+    parser admits (int(inf)/int(nan) would raise mid-scrape)."""
+    import math
+    if math.isnan(value):
+        return "NaN"
+    if math.isinf(value):
+        return "+Inf" if value > 0 else "-Inf"
+    return repr(value) if value != int(value) else str(int(value))
+
+
+def scrape(host: str, port: int, path: str = "/v1/metrics",
+           timeout: float = 2.0) -> Optional[str]:
+    """One member's exposition, or None when it is unreachable (a
+    mid-restart worker must not fail the fleet scrape)."""
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return None
+            return resp.read().decode()
+        finally:
+            conn.close()
+    except OSError:
+        return None

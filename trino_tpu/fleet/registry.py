@@ -1,0 +1,306 @@
+"""Fleet on-disk state: prepared statements, worker registry, config.
+
+The fleet directory is the rendezvous point between the parent (engine
+owner), the worker processes, and tooling:
+
+    <fleet_dir>/fleet.json        fleet config (ports, shm path, context)
+    <fleet_dir>/cache.shm         the shared cache tier (fleet/shm.py)
+    <fleet_dir>/bus/<name>.sock   bus member sockets (fleet/bus.py)
+    <fleet_dir>/prepared/<name>   one statement's SQL per file
+    <fleet_dir>/workers/<id>.json live worker records (pid, admin port)
+
+Prepared statements: the STICKY-routing source of truth. A PREPARE that
+lands on any worker registers here (atomic tmp+rename write) and fans
+out over the bus; an EXECUTE landing on any other worker resolves the
+name from its bus-fed map with a registry fallback — so a restarted or
+late-joining worker sees every statement PREPAREd before it was born.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+from urllib.parse import quote, unquote
+
+
+class PreparedRegistry:
+    """Fleet-wide prepared-statement map: in-memory, bus-refreshed, with
+    the fleet directory as durable fallback."""
+
+    def __init__(self, fleet_dir: str):
+        self.dir = os.path.join(fleet_dir, "prepared")
+        os.makedirs(self.dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._map: Dict[str, str] = {}
+        self.reload()
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.dir, quote(name, safe=""))
+
+    def register(self, name: str, sql: str, persist: bool = True) -> None:
+        with self._lock:
+            self._map[name] = sql
+        if persist:
+            fd, tmp = tempfile.mkstemp(dir=self.dir, prefix=".tmp-")
+            try:
+                with os.fdopen(fd, "w") as fh:
+                    fh.write(sql)
+                os.replace(tmp, self._path(name))
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+
+    def remove(self, name: str, persist: bool = True) -> None:
+        with self._lock:
+            self._map.pop(name, None)
+        if persist:
+            try:
+                os.unlink(self._path(name))
+            except OSError:
+                pass
+
+    def get(self, name: str) -> Optional[str]:
+        with self._lock:
+            sql = self._map.get(name)
+        if sql is not None:
+            return sql
+        # late-joiner fallback: the statement may predate this process
+        try:
+            with open(self._path(name)) as fh:
+                sql = fh.read()
+        except OSError:
+            return None
+        with self._lock:
+            self._map[name] = sql
+        return sql
+
+    def snapshot(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._map)
+
+    def reload(self) -> None:
+        loaded: Dict[str, str] = {}
+        try:
+            names = os.listdir(self.dir)
+        except FileNotFoundError:
+            names = []
+        for fname in names:
+            if fname.startswith(".tmp-"):
+                continue
+            try:
+                with open(os.path.join(self.dir, fname)) as fh:
+                    loaded[unquote(fname)] = fh.read()
+            except OSError:
+                continue
+        with self._lock:
+            self._map.update(loaded)
+
+
+# ------------------------------------------------------- worker registry
+
+
+def workers_dir(fleet_dir: str) -> str:
+    path = os.path.join(fleet_dir, "workers")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def write_worker_record(fleet_dir: str, worker_id: str, record: Dict
+                        ) -> str:
+    record = dict(record, worker_id=worker_id, updated=time.time())
+    path = os.path.join(workers_dir(fleet_dir), f"{worker_id}.json")
+    fd, tmp = tempfile.mkstemp(dir=workers_dir(fleet_dir), prefix=".tmp-")
+    with os.fdopen(fd, "w") as fh:
+        json.dump(record, fh)
+    os.replace(tmp, path)
+    return path
+
+
+def remove_worker_record(fleet_dir: str, worker_id: str) -> None:
+    try:
+        os.unlink(os.path.join(workers_dir(fleet_dir),
+                               f"{worker_id}.json"))
+    except OSError:
+        pass
+
+
+def list_worker_records(fleet_dir: str) -> List[Dict]:
+    """Live worker records. A worker that died without cleanup (SIGKILL,
+    OOM) leaves its record behind; since the fleet is same-host by
+    design, a pid liveness probe reaps it here — otherwise the
+    workers-alive gauge lies forever and every fleet metrics scrape
+    pays a connect timeout against the dead admin port."""
+    out = []
+    for fname in sorted(os.listdir(workers_dir(fleet_dir))):
+        if not fname.endswith(".json"):
+            continue
+        path = os.path.join(workers_dir(fleet_dir), fname)
+        try:
+            with open(path) as fh:
+                record = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        pid = record.get("pid")
+        if isinstance(pid, int):
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                try:
+                    os.unlink(path)    # crashed worker's stale record
+                except OSError:
+                    pass
+                continue
+            except OSError:
+                pass    # EPERM etc.: alive but not ours — keep it
+        out.append(record)
+    return out
+
+
+# --------------------------------------------------------- fleet config
+
+
+def config_path(fleet_dir: str) -> str:
+    return os.path.join(fleet_dir, "fleet.json")
+
+
+def write_fleet_config(fleet_dir: str, config: Dict) -> str:
+    path = config_path(fleet_dir)
+    fd, tmp = tempfile.mkstemp(dir=fleet_dir, prefix=".tmp-")
+    with os.fdopen(fd, "w") as fh:
+        json.dump(config, fh, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def read_fleet_config(fleet_dir: str) -> Dict:
+    with open(config_path(fleet_dir)) as fh:
+        return json.load(fh)
+
+
+# ----------------------------------------------------------- quota map
+
+
+def load_quota_map(path: Optional[str]) -> Dict[str, Dict[str, float]]:
+    """Per-group result-cache QPS quotas from a resource-group JSON
+    file: {dotted.group.path: {"rate": tokens/s, "burst": bucket cap}}.
+    Groups without a `result_cache_qps` key are unlimited. Tolerant of
+    a missing/malformed file (the engine's strict loader is the one
+    that surfaces config errors; workers fail open)."""
+    if not path:
+        return {}
+    try:
+        with open(path) as fh:
+            tree = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    groups = tree if isinstance(tree, list) else \
+        tree.get("groups", tree.get("rootGroups", []))
+    out: Dict[str, Dict[str, float]] = {}
+
+    def walk(specs, prefix):
+        for spec in specs or []:
+            if not isinstance(spec, dict):
+                continue
+            name = str(spec.get("name", "")).strip()
+            if not name:
+                continue
+            full = f"{prefix}.{name}" if prefix else name
+            rate = spec.get("result_cache_qps", spec.get("resultCacheQps"))
+            if rate is not None:
+                try:
+                    rate = float(rate)
+                    burst = float(spec.get(
+                        "result_cache_qps_burst",
+                        spec.get("resultCacheQpsBurst", max(rate, 1.0))))
+                    out[full] = {"rate": rate, "burst": burst}
+                except (TypeError, ValueError):
+                    pass
+            walk(spec.get("subgroups", spec.get("subGroups", [])), full)
+    walk(groups, "")
+    return out
+
+
+class FileWatch:
+    """The stat/throttle/compare half of config hot-reload, single-
+    sourced for every consumer (worker quota maps, the engine's quota
+    gate, TrinoServer's group-tree reload): at most one stat() per
+    `min_interval_s`, and `changed()` is True exactly when the mtime
+    moved since the last True — including to None (file deleted).
+    What to DO about a change stays with the caller: quota maps reload
+    declaratively (deleted file = no quotas), while the group tree
+    keeps its last good config on an unreadable file."""
+
+    def __init__(self, path: Optional[str], min_interval_s: float = 1.0):
+        self.path = path
+        self.min_interval_s = min_interval_s
+        self._lock = threading.Lock()
+        self._mtime = self._stat(path)
+        self._checked = 0.0
+
+    @staticmethod
+    def _stat(path: Optional[str]) -> Optional[float]:
+        try:
+            return os.stat(path).st_mtime if path else None
+        except OSError:
+            return None
+
+    def changed(self, force: bool = False) -> bool:
+        if self.path is None:
+            return False
+        with self._lock:
+            now = time.monotonic()
+            if not force and now - self._checked < self.min_interval_s:
+                return False
+            self._checked = now
+            mtime = self._stat(self.path)
+            if not force and mtime == self._mtime:
+                return False
+            self._mtime = mtime
+            return True
+
+
+class ReloadableQuotaMap:
+    """The quota map on a FileWatch — the engine gate and every worker
+    share this one implementation, so they cannot drift on when a
+    quota edit takes effect."""
+
+    def __init__(self, path: Optional[str], min_interval_s: float = 1.0):
+        self._watch = FileWatch(path, min_interval_s)
+        self._quotas = load_quota_map(path)
+
+    def current(self, force: bool = False) -> Dict[str, Dict[str, float]]:
+        if self._watch.changed(force=force):
+            self._quotas = load_quota_map(self._watch.path)
+        return self._quotas
+
+
+def quota_allows(shared, quotas: Dict[str, Dict[str, float]],
+                 group: str) -> bool:
+    """Fleet-wide fast-path quota check: walk the group chain
+    root-to-leaf; every level with a configured result-cache QPS quota
+    must grant a token from its SHARED-MEMORY bucket (fleet/shm.py), so
+    N processes enforcing rate R admit R total. A failed level refunds
+    the ancestors it already charged (all-or-nothing, matching the
+    in-process ResourceGroupManager discipline)."""
+    if not quotas:
+        return True
+    parts = group.split(".")
+    charged = []
+    for i in range(len(parts)):
+        name = ".".join(parts[:i + 1])
+        quota = quotas.get(name)
+        if quota is None:
+            continue
+        if not shared.try_acquire(name, quota["rate"], quota["burst"]):
+            for done in charged:
+                q = quotas[done]
+                shared.try_acquire(done, q["rate"], q["burst"], n=-1.0)
+            return False
+        charged.append(name)
+    return True

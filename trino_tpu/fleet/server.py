@@ -1,0 +1,450 @@
+"""FleetServer: N SO_REUSEPORT worker processes over one device runner.
+
+Reference parity: Trino's production story is a dispatcher fronting many
+coordinators; this engine's analog keeps the DEVICE single-owner — one
+process holds the runner (jit cache, plan cache, node pool, table
+cache) and executes every cache miss — while N worker processes share
+the accept load on ONE port and answer result-cache hits from the
+cross-process shared tier (fleet/shm.py) without ever touching the
+engine. The parent process:
+
+- owns the engine: a full TrinoServer (server/app.py) on a private
+  loopback port, its result cache swapped for a MirroredResultSetCache
+  that PUBLISHES every cacheable answer into the shared tier (carrying
+  the tier's generation snapshot, so the _GenerationGuard stale-publish
+  race guard holds across processes) and whose invalidations fan out:
+  plan-cache hook -> local caches -> shared tier -> bus notice.
+- spawns/monitors the worker subprocesses, writes the fleet.json
+  rendezvous config (ports, shm path, the engine session's keying
+  context), and ingests the workers' cache-hit accounting batches into
+  the engine's resource-group counters and query tracker — so
+  system.runtime.queries and the group columns reflect FLEET traffic,
+  not just engine dispatches (per-hit rows are sampled, counts exact).
+- performs the zero-drop rolling restart: spawn a replacement worker
+  (N+1 listeners), drain the old one (grace window with
+  `Connection: close`, then listener close, then straggler wait), wait
+  for its exit, move to the next — the fleet upgrades worker-by-worker
+  while persistent clients transparently re-land on live listeners.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from trino_tpu.exec.plan_cache import PLAN_PROPERTIES
+from trino_tpu.fleet.bus import FleetBus
+from trino_tpu.fleet.registry import (ReloadableQuotaMap,
+                                      list_worker_records, quota_allows,
+                                      read_fleet_config,
+                                      write_fleet_config)
+from trino_tpu.fleet.shm import (DEFAULT_DATA_BYTES, SharedCacheTier,
+                                 key_fingerprint)
+from trino_tpu.serve.caches import (DEFAULT_RESULT_MAX_ENTRIES,
+                                    ResultSetCache)
+
+WORKER_READY_TIMEOUT_S = 90.0
+
+
+class MirroredResultSetCache(ResultSetCache):
+    """The engine's result cache with the shared tier as a write-through
+    mirror. `generation()` snapshots BOTH counters (tier first — the
+    wider scope must not be newer than the narrower one), `put` publishes
+    to the tier only when the local put survived its own generation
+    guard AND the tier's guard accepts the tier-side snapshot, and
+    `get` falls back to the tier on a local miss (a restarted engine
+    re-adopts the fleet's warm results). Stale publishes stay
+    structurally impossible in either direction."""
+
+    def __init__(self, tier: SharedCacheTier,
+                 max_entries: int = DEFAULT_RESULT_MAX_ENTRIES):
+        super().__init__(max_entries)
+        self.tier = tier
+
+    def generation(self):
+        tier_gen = self.tier.generation()
+        return (tier_gen, super().generation())
+
+    @staticmethod
+    def _split(gen):
+        return gen if isinstance(gen, tuple) else (None, gen)
+
+    def put(self, key, entry, gen=None) -> bool:
+        tier_gen, local_gen = self._split(gen)
+        ok = super().put(key, entry, gen=local_gen)
+        if ok:
+            self.tier.put(key_fingerprint(key), entry, entry.tables,
+                          gen=tier_gen)
+        return ok
+
+    def get(self, key, count_miss: bool = True):
+        entry = super().get(key, count_miss=count_miss)
+        if entry is not None:
+            return entry
+        local_gen = super().generation()    # BEFORE the tier read: an
+        # invalidation racing the adoption below must reject it
+        found = self.tier.get(key_fingerprint(key))
+        if found is None:
+            return None
+        entry = found[0]
+        super().put(key, entry, gen=local_gen)
+        return entry
+
+    def invalidate(self, table) -> int:
+        n = super().invalidate(table)
+        self.tier.invalidate(table)
+        return n
+
+
+class _QuotaGate:
+    """The engine's fast-path quota check, rebased onto the fleet-wide
+    shared-memory buckets so engine-landed and worker-landed hits drain
+    ONE bucket per group. Hot-reloads the quota map on file mtime
+    through the same ReloadableQuotaMap the workers use."""
+
+    def __init__(self, shared: SharedCacheTier, rg_path: Optional[str]):
+        self.shared = shared
+        self.quotas = ReloadableQuotaMap(rg_path)
+
+    def __call__(self, group: str) -> bool:
+        return quota_allows(self.shared, self.quotas.current(), group)
+
+
+class FleetServer:
+    def __init__(self, runner=None, workers: int = 2,
+                 host: str = "127.0.0.1", port: int = 0,
+                 fleet_dir: Optional[str] = None,
+                 schema: str = "tiny",
+                 resource_groups_path: Optional[str] = None,
+                 warmup_manifest=None,
+                 in_process: bool = False,
+                 drain_grace_s: float = 0.5,
+                 drain_timeout_s: float = 10.0,
+                 shm_data_bytes: int = DEFAULT_DATA_BYTES,
+                 worker_env: Optional[Dict[str, str]] = None,
+                 **engine_kwargs):
+        if runner is None:
+            from trino_tpu.exec import LocalQueryRunner
+            runner = LocalQueryRunner.tpch(schema)
+        self.runner = runner
+        self.host = host
+        self.n_workers = int(workers)
+        self.in_process = bool(in_process)
+        self.drain_grace_s = float(drain_grace_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.worker_env = dict(worker_env or {})
+        self._owns_dir = fleet_dir is None
+        self.fleet_dir = fleet_dir or tempfile.mkdtemp(prefix="tpu_fleet_")
+        os.makedirs(self.fleet_dir, exist_ok=True)
+        self.shm_path = os.path.join(self.fleet_dir, "cache.shm")
+        self.shared = SharedCacheTier(self.shm_path, create=True,
+                                      data_bytes=int(shm_data_bytes))
+        self.resource_groups_path = resource_groups_path
+        # the engine: a full single-process TrinoServer on a private
+        # loopback port, the sole owner of the device runner
+        from trino_tpu.server import TrinoServer
+        self.engine = TrinoServer(
+            runner, host="127.0.0.1", port=0,
+            resource_groups_path=resource_groups_path,
+            warmup_manifest=warmup_manifest, **engine_kwargs)
+        # swap the engine's result cache for the mirrored one and hang
+        # it on the SAME plan-cache invalidation fan-out DDL/INSERT
+        # drives — one INSERT drops plans, local caches, the shared
+        # tier, and (via the bus notice below) every worker's hot copies
+        self._mirrored = MirroredResultSetCache(self.shared)
+        runner._result_cache = self._mirrored
+        runner._plan_cache.add_invalidation_hook(self._mirrored.invalidate)
+        runner._plan_cache.add_invalidation_hook(self._publish_invalidate)
+        self.engine.fast_path_quota = _QuotaGate(self.shared,
+                                                 resource_groups_path)
+        self.bus = FleetBus(self.fleet_dir, "engine",
+                            on_message=self._on_bus)
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._inproc: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self.port = self._pick_port(host, port)
+        self.base_uri = f"http://{host}:{self.port}"
+        self.fleet_hits_ingested = 0
+        self._register_gauges()
+
+    # ----------------------------------------------------------- lifecycle
+
+    @staticmethod
+    def _pick_port(host: str, port: int) -> int:
+        """Reserve the fleet's shared port: bind with SO_REUSEPORT (so
+        the workers' later binds of the same port succeed), read the
+        assignment, release. The parent must NOT keep a bound socket —
+        a listener that never accepts would eat its share of the
+        kernel's SO_REUSEPORT distribution."""
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            if hasattr(socket, "SO_REUSEPORT"):
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            s.bind((host, port))
+            return s.getsockname()[1]
+        finally:
+            s.close()
+
+    def start(self) -> "FleetServer":
+        self.engine.start()
+        # sticky prepared statements, leg 0: the warmup manifest's named
+        # statements seed the FLEET registry too, so workers can key
+        # EXECUTEs of warmed shapes before any client ever PREPAREd one
+        # through the fleet
+        from trino_tpu.fleet.registry import PreparedRegistry
+        self.prepared = PreparedRegistry(self.fleet_dir)
+        if self.engine._warmup_manifest is not None:
+            from trino_tpu.serve.warmup import load_manifest
+            try:
+                for spec in load_manifest(self.engine._warmup_manifest):
+                    if spec.get("name") and spec.get("sql"):
+                        self.prepared.register(str(spec["name"]).lower(),
+                                               spec["sql"])
+            except Exception:   # noqa: BLE001 — warmup stays best-effort
+                pass
+        session = self.runner.session
+        config = {
+            "host": self.host, "port": self.port,
+            "engine_host": "127.0.0.1", "engine_port": self.engine.port,
+            "engine_base": self.engine.base_uri,
+            "fleet_dir": self.fleet_dir, "shm_path": self.shm_path,
+            "catalog": session.catalog, "schema": session.schema,
+            # the keying context workers must replicate EXACTLY:
+            # current_date is pinned at engine-session construction, and
+            # any plan-affecting property set on the base session is
+            # part of every key
+            "start_date": session.start_date,
+            "base_properties": {
+                p: session.properties[p] for p in PLAN_PROPERTIES
+                if p in session.properties},
+            "default_group": str(session.get("resource_group")),
+            "resource_groups_path": self.resource_groups_path,
+            "drain_grace_s": self.drain_grace_s,
+            "drain_timeout_s": self.drain_timeout_s,
+        }
+        write_fleet_config(self.fleet_dir, config)
+        ids = [self.spawn_worker(wait=False)
+               for _ in range(self.n_workers)]
+        self._wait_ready(ids)
+        return self
+
+    def spawn_worker(self, wait: bool = True,
+                     timeout_s: float = WORKER_READY_TIMEOUT_S) -> str:
+        worker_id = f"w-{uuid.uuid4().hex[:8]}"
+        if self.in_process:
+            from trino_tpu.fleet.worker import WorkerServer
+            server = WorkerServer(read_fleet_config(self.fleet_dir),
+                                  worker_id=worker_id).start()
+            with self._lock:
+                self._inproc[worker_id] = server
+        else:
+            env = dict(os.environ)
+            # workers never execute queries: pin them to the CPU backend
+            # so a TPU engine's workers don't fight over the device
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            env.update(self.worker_env)
+            log_path = os.path.join(self.fleet_dir, "workers",
+                                    f"{worker_id}.log")
+            os.makedirs(os.path.dirname(log_path), exist_ok=True)
+            log = open(log_path, "w")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "trino_tpu.fleet.worker",
+                 self.fleet_dir, worker_id],
+                stdout=log, stderr=subprocess.STDOUT, env=env,
+                start_new_session=True)
+            log.close()
+            with self._lock:
+                self._procs[worker_id] = proc
+        if wait:
+            self._wait_ready([worker_id], timeout_s)
+        return worker_id
+
+    def _wait_ready(self, worker_ids: List[str],
+                    timeout_s: float = WORKER_READY_TIMEOUT_S) -> None:
+        deadline = time.monotonic() + timeout_s
+        pending = set(worker_ids)
+        while pending and time.monotonic() < deadline:
+            for rec in list_worker_records(self.fleet_dir):
+                if rec.get("worker_id") in pending and \
+                        rec.get("state") == "active":
+                    pending.discard(rec["worker_id"])
+            with self._lock:
+                for wid in list(pending):
+                    proc = self._procs.get(wid)
+                    if proc is not None and proc.poll() is not None:
+                        raise RuntimeError(
+                            f"fleet worker {wid} died at startup "
+                            f"(rc={proc.returncode}); see "
+                            f"{self.fleet_dir}/workers/{wid}.log")
+            if pending:
+                time.sleep(0.05)
+        if pending:
+            raise TimeoutError(
+                f"fleet workers not ready within {timeout_s}s: "
+                f"{sorted(pending)}")
+
+    def workers(self) -> List[Dict]:
+        return list_worker_records(self.fleet_dir)
+
+    # ------------------------------------------------------ drain/restart
+
+    def drain_worker(self, worker_id: str,
+                     timeout_s: Optional[float] = None) -> None:
+        rec = next((r for r in self.workers()
+                    if r.get("worker_id") == worker_id), None)
+        if rec is not None:
+            import http.client
+            try:
+                body = json.dumps({"timeout_s": timeout_s}).encode() \
+                    if timeout_s is not None else None
+                conn = http.client.HTTPConnection(
+                    self.host, rec["admin_port"], timeout=5)
+                conn.request("POST", "/v1/fleet/drain", body=body)
+                conn.getresponse().read()
+                conn.close()
+                return
+            except OSError:
+                pass
+        self.bus.send_to(worker_id, {"kind": "drain",
+                                     "timeout_s": timeout_s})
+
+    def _wait_exit(self, worker_id: str, timeout_s: float) -> bool:
+        with self._lock:
+            proc = self._procs.pop(worker_id, None)
+            inproc = self._inproc.pop(worker_id, None)
+        if inproc is not None:
+            return inproc.join(timeout_s)
+        if proc is None:
+            return True
+        try:
+            proc.wait(timeout=timeout_s)
+            return True
+        except subprocess.TimeoutExpired:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5)
+            return False
+
+    def rolling_restart(self,
+                        timeout_s: Optional[float] = None) -> List[str]:
+        """Upgrade the fleet worker-by-worker without dropping a query:
+        spawn the replacement FIRST (the port briefly has N+1
+        listeners), then drain the old worker and wait for its exit.
+        Returns the new worker ids."""
+        timeout_s = timeout_s if timeout_s is not None else \
+            self.drain_timeout_s + self.drain_grace_s + 20.0
+        with self._lock:
+            old = list(self._procs) + list(self._inproc)
+        fresh = []
+        for worker_id in old:
+            fresh.append(self.spawn_worker(wait=True))
+            self.drain_worker(worker_id)
+            self._wait_exit(worker_id, timeout_s)
+        return fresh
+
+    def stop(self, cleanup: bool = True) -> None:
+        with self._lock:
+            alive = list(self._procs) + list(self._inproc)
+        for worker_id in alive:
+            self.drain_worker(worker_id, timeout_s=2.0)
+        for worker_id in alive:
+            self._wait_exit(
+                worker_id, self.drain_grace_s + 5.0)
+        self.engine.stop()
+        self.bus.close()
+        self.shared.close()
+        if cleanup and self._owns_dir:
+            shutil.rmtree(self.fleet_dir, ignore_errors=True)
+
+    # ------------------------------------------------------------- the bus
+
+    def _publish_invalidate(self, table) -> None:
+        """Plan-cache invalidation hook leg 5: tell every worker to drop
+        its hot local copies NOW. Advisory — the shm generation bump the
+        mirrored cache already performed is what makes staleness
+        impossible; this just evicts dead weight promptly."""
+        self.bus.publish({"kind": "invalidate", "table": list(table)},
+                         exclude_self=True)
+
+    def _on_bus(self, message: Dict) -> None:
+        kind = message.get("kind")
+        if kind == "hits":
+            self._ingest_hits(message)
+        elif kind == "prepare":
+            # sticky routing leg 2: statements PREPAREd through any
+            # worker land in the engine's base prepared map too, so an
+            # EXECUTE that reaches the engine without headers resolves
+            from trino_tpu.sql import parse_statement
+            try:
+                self.runner._prepared[message["name"]] = \
+                    parse_statement(message["sql"])
+            except Exception:   # noqa: BLE001 — a bad statement stays
+                pass            # a per-request error, not a bus crash
+        elif kind == "deallocate":
+            self.runner._prepared.pop(message.get("name"), None)
+
+    def _ingest_hits(self, message: Dict) -> None:
+        """Fleet-aggregated accounting: group counters get EXACT counts
+        (started/finished/served_from_cache move by n, quota already
+        enforced worker-side so enforce=False), the query tracker gets
+        the SAMPLED per-hit records — system.runtime.queries shows fleet
+        traffic with bounded ingest cost."""
+        from trino_tpu.exec.query_tracker import TRACKER
+        for group, n in (message.get("counts") or {}).items():
+            try:
+                self.engine.groups.record_cache_hit(group, n=int(n),
+                                                    enforce=False)
+                self.fleet_hits_ingested += int(n)
+            except Exception:   # noqa: BLE001
+                continue
+        for group, n in (message.get("rejections") or {}).items():
+            try:
+                self.engine.groups.record_cache_hit_rejection(group,
+                                                              n=int(n))
+            except Exception:   # noqa: BLE001
+                continue
+        for rec in (message.get("records") or []):
+            try:
+                info = TRACKER.begin(rec.get("sql", ""),
+                                     user=rec.get("user", "user"),
+                                     query_id=rec.get("query_id"),
+                                     resource_group=rec.get("group"))
+                TRACKER.running(info)
+                info.cpu_time_ms = 0
+                info.output_bytes = int(rec.get("bytes", 0))
+                info.stats = {"result_cache_hits": 1,
+                              "served_by": rec.get("worker", "")}
+                TRACKER.finish(info, int(rec.get("rows", 0)))
+            except Exception:   # noqa: BLE001
+                continue
+
+    # ------------------------------------------------------------- gauges
+
+    def _register_gauges(self) -> None:
+        from trino_tpu.obs.metrics import REGISTRY
+        fleet = self
+
+        def _fleet_gauges():
+            yield ("trino_tpu_fleet_workers",
+                   "Live fleet worker processes.",
+                   len(fleet.workers()), {})
+            yield ("trino_tpu_fleet_shared_cache_entries",
+                   "Live entries in the cross-process result cache.",
+                   fleet.shared.entry_count(), {})
+            yield ("trino_tpu_fleet_hits_ingested",
+                   "Worker cache hits ingested into fleet accounting.",
+                   fleet.fleet_hits_ingested, {})
+
+        REGISTRY.register_gauges(_fleet_gauges)

@@ -1,0 +1,426 @@
+"""Cross-process cache tier: an mmap'd result cache shared by the fleet.
+
+Reference parity: the reference engine scales its front door by putting a
+dispatcher in front of many coordinators; the per-coordinator state that
+makes the fast path fast (result sets, prepared statements) is external
+(client-side or a fronting cache). Here the fleet's worker processes
+share ONE file-backed mmap region so a result the engine computed once
+is answerable by EVERY worker with zero IPC on the hit path — a read is
+a couple of cache-line loads plus an unpickle, no socket, no lock.
+
+Layout (one file, created by the fleet parent, mapped by every member):
+
+    HEADER      generation counter, ring-allocator cursor, geometry
+    TABLE GENS  open-addressed (table-hash -> last-invalidation gen)
+    SLOTS       open-addressed (key-hash -> seq, data offset, put gen)
+    QUOTA       open-addressed token buckets (group-hash -> tokens, stamp)
+    DATA        ring-allocated pickled (tables, CachedResult) records
+
+Concurrency model: writers (the engine publishing results, invalidation,
+quota acquire) serialize through an fcntl lock on the backing file;
+readers are LOCK-FREE and validate with a seqlock — each slot carries a
+sequence number that goes odd while the slot (or the data it points at)
+is being rewritten, so a reader that raced a writer re-reads the
+sequence after copying the payload and retries/misses on a mismatch.
+Torn data is additionally caught by the key hash embedded at the front
+of every data record.
+
+Invalidation reuses the `_GenerationGuard` discipline from
+exec/plan_cache.py, lifted across process boundaries: `generation()`
+snapshots the global counter BEFORE the work whose output will be
+published; `put()` rejects when any referenced table was invalidated
+since; `get()` re-validates every entry's tables against the live
+table-generation region AT READ TIME. A stale publish — a result
+computed against pre-INSERT data landing after the INSERT's
+invalidation — is therefore structurally impossible fleet-wide, not
+just per process, and a worker that missed a bus message can never
+serve stale data (the bus is advisory; the generation check is the
+authority).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import hashlib
+import mmap
+import os
+import pickle
+import struct
+import threading
+import time
+from typing import Any, Iterable, Optional, Tuple
+
+MAGIC = b"TPUFLEET"
+VERSION = 1
+
+HEADER_FMT = "<8sIIIIQQQQQQQ"           # magic, ver, slots, tslots, qslots,
+HEADER_SIZE = 128                       # data_off, data_size, head, gen,
+                                        # flush_gen, puts, invalidations
+TABLE_REC = 32     # hash16 + gen u64 + pad
+SLOT_REC = 48      # seq u32 + len u32 + hash16 + offset u64 + put_gen u64
+QUOTA_REC = 48     # hash16 + tokens f64 + stamp f64 + pad
+PROBE = 32         # max open-addressing probe distance
+
+DEFAULT_SLOTS = 4096
+DEFAULT_TABLE_SLOTS = 512
+DEFAULT_QUOTA_SLOTS = 256
+DEFAULT_DATA_BYTES = 64 << 20
+
+
+def key_fingerprint(key: Any) -> bytes:
+    """Stable 16-byte digest of a cache key, identical across processes.
+
+    Keys are the runner's result-cache keys — nested tuples of
+    primitives, type-display strings, and literal values. `repr` is
+    value-deterministic for those (pickle is NOT: its memo encodes
+    object identity, so two processes building equal keys from interned
+    vs. non-interned strings would hash differently)."""
+    return hashlib.blake2b(repr(key).encode(), digest_size=16).digest()
+
+
+def table_fingerprint(table: Tuple[str, str, str]) -> bytes:
+    return hashlib.blake2b(repr(tuple(table)).encode(),
+                           digest_size=16).digest()
+
+
+def group_fingerprint(group: str) -> bytes:
+    return hashlib.blake2b(f"group:{group}".encode(),
+                           digest_size=16).digest()
+
+
+class SharedCacheTier:
+    """One member's view of the fleet cache file (engine or worker)."""
+
+    def __init__(self, path: str, create: bool = False,
+                 slots: int = DEFAULT_SLOTS,
+                 table_slots: int = DEFAULT_TABLE_SLOTS,
+                 quota_slots: int = DEFAULT_QUOTA_SLOTS,
+                 data_bytes: int = DEFAULT_DATA_BYTES):
+        self.path = path
+        self._wlock = threading.Lock()   # in-process writer serialization
+        if create:
+            self._create(path, slots, table_slots, quota_slots, data_bytes)
+        self._fd = os.open(path, os.O_RDWR)
+        total = os.fstat(self._fd).st_size
+        self._mm = mmap.mmap(self._fd, total)
+        hdr = struct.unpack_from(HEADER_FMT, self._mm, 0)
+        if hdr[0] != MAGIC or hdr[1] != VERSION:
+            raise ValueError(f"not a fleet cache file: {path}")
+        self.slots = hdr[2]
+        self.table_slots = hdr[3]
+        self.quota_slots = hdr[4]
+        self.data_off = hdr[5]
+        self.data_size = hdr[6]
+        self.table_off = HEADER_SIZE
+        self.slot_off = self.table_off + self.table_slots * TABLE_REC
+        self.quota_off = self.slot_off + self.slots * SLOT_REC
+        # process-local traffic counters (obs gauges; fleet status)
+        self.stats = {"hits": 0, "misses": 0, "puts": 0, "put_rejects": 0,
+                      "invalidations": 0, "quota_rejections": 0}
+
+    @staticmethod
+    def _create(path, slots, table_slots, quota_slots, data_bytes):
+        data_off = (HEADER_SIZE + table_slots * TABLE_REC
+                    + slots * SLOT_REC + quota_slots * QUOTA_REC)
+        total = data_off + data_bytes
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+        try:
+            os.ftruncate(fd, total)
+            header = struct.pack(HEADER_FMT, MAGIC, VERSION, slots,
+                                 table_slots, quota_slots, data_off,
+                                 data_bytes, 0, 0, 0, 0, 0)
+            os.pwrite(fd, header, 0)
+        finally:
+            os.close(fd)
+
+    def close(self) -> None:
+        try:
+            self._mm.close()
+        finally:
+            os.close(self._fd)
+
+    # ------------------------------------------------------ header fields
+
+    def _u64(self, off: int) -> int:
+        return struct.unpack_from("<Q", self._mm, off)[0]
+
+    def _put_u64(self, off: int, value: int) -> None:
+        struct.pack_into("<Q", self._mm, off, value)
+
+    # header u64 field offsets (after magic/ver/counts = 8+4+4+4+4 = 24)
+    _OFF_DATA_OFF = 24
+    _OFF_DATA_SIZE = 32
+    _OFF_HEAD = 40
+    _OFF_GEN = 48
+    _OFF_FLUSH_GEN = 56
+    _OFF_PUTS = 64
+    _OFF_INVALIDATIONS = 72
+
+    def generation(self) -> int:
+        """Global invalidation generation — snapshot BEFORE the work
+        whose output will be published (the _GenerationGuard contract)."""
+        return self._u64(self._OFF_GEN)
+
+    class _locked:
+        """fcntl write lock on the backing file + the in-process mutex
+        (flock is per-fd/process; two threads of one process must not
+        both think they hold it)."""
+
+        def __init__(self, tier):
+            self.tier = tier
+
+        def __enter__(self):
+            self.tier._wlock.acquire()
+            fcntl.flock(self.tier._fd, fcntl.LOCK_EX)
+
+        def __exit__(self, *exc):
+            fcntl.flock(self.tier._fd, fcntl.LOCK_UN)
+            self.tier._wlock.release()
+
+    # -------------------------------------------------- table generations
+
+    def _table_probe(self, digest: bytes) -> Iterable[int]:
+        base = int.from_bytes(digest[:8], "little") % self.table_slots
+        for i in range(min(PROBE, self.table_slots)):
+            yield self.table_off + ((base + i) % self.table_slots) * TABLE_REC
+
+    def table_generation(self, table) -> int:
+        """Last invalidation generation recorded for `table` (0 = never
+        invalidated since the file was created)."""
+        digest = table_fingerprint(table)
+        for off in self._table_probe(digest):
+            stored = self._mm[off:off + 16]
+            if stored == digest:
+                return self._u64(off + 16)
+            if stored == b"\x00" * 16:
+                return 0
+        return 0    # probe chain exhausted without a match
+
+    def invalidate(self, table) -> None:
+        """Bump the global generation and stamp it on the table's slot.
+        If the (bounded) table region is full, fall back to the nuclear
+        flush generation — EVERY entry older than this moment becomes
+        invalid, which is conservative but never stale."""
+        digest = table_fingerprint(table)
+        with self._locked(self):
+            gen = self._u64(self._OFF_GEN) + 1
+            self._put_u64(self._OFF_GEN, gen)
+            self._put_u64(self._OFF_INVALIDATIONS,
+                          self._u64(self._OFF_INVALIDATIONS) + 1)
+            for off in self._table_probe(digest):
+                stored = self._mm[off:off + 16]
+                if stored == digest or stored == b"\x00" * 16:
+                    self._mm[off:off + 16] = digest
+                    self._put_u64(off + 16, gen)
+                    break
+            else:
+                self._put_u64(self._OFF_FLUSH_GEN, gen)
+        self.stats["invalidations"] += 1
+
+    def _entry_valid(self, put_gen: int, tables) -> bool:
+        if self._u64(self._OFF_FLUSH_GEN) > put_gen:
+            return False
+        return all(self.table_generation(tk) <= put_gen for tk in tables)
+
+    # -------------------------------------------------------- result slots
+
+    def _slot_probe(self, digest: bytes) -> Iterable[int]:
+        base = int.from_bytes(digest[:8], "little") % self.slots
+        for i in range(min(PROBE, self.slots)):
+            yield self.slot_off + ((base + i) % self.slots) * SLOT_REC
+
+    def put(self, key_hash: bytes, entry: Any, tables, gen: Optional[int]
+            ) -> bool:
+        """Publish a pickled (tables, entry) record under `key_hash`.
+        `gen` is the generation snapshot taken before the execution that
+        produced `entry`; a concurrent invalidation of any referenced
+        table since then rejects the publish (stale-publish guard)."""
+        tables = tuple(sorted(tuple(tk) for tk in tables))
+        payload = pickle.dumps((tables, entry), protocol=4)
+        record = key_hash + struct.pack("<I", len(payload)) + payload
+        if len(record) > self.data_size // 2:
+            return False    # one oversized result must not wipe the ring
+        with self._locked(self):
+            if gen is not None:
+                flush = self._u64(self._OFF_FLUSH_GEN)
+                if flush > gen or any(
+                        self.table_generation(tk) > gen for tk in tables):
+                    self.stats["put_rejects"] += 1
+                    return False
+            start = self._alloc_locked(len(record))
+            self._mm[self.data_off + start:
+                     self.data_off + start + len(record)] = record
+            self._write_slot_locked(key_hash, start, len(record),
+                                    self._u64(self._OFF_GEN))
+            self._put_u64(self._OFF_PUTS, self._u64(self._OFF_PUTS) + 1)
+        self.stats["puts"] += 1
+        return True
+
+    def _alloc_locked(self, n: int) -> int:
+        """Ring-allocate `n` contiguous bytes in the data region; any
+        live slot whose record the allocation (or a wrap skip) would
+        overwrite is killed first, so a concurrent reader can only ever
+        observe a bumped sequence, never silently-swapped bytes."""
+        head = self._u64(self._OFF_HEAD)
+        start = head % self.data_size
+        ranges = []
+        if start + n > self.data_size:
+            ranges.append((start, self.data_size))    # wrap skip is dead
+            head += self.data_size - start
+            start = 0
+        ranges.append((start, start + n))
+        self._kill_overlaps_locked(ranges)
+        self._put_u64(self._OFF_HEAD, head + n)
+        return start
+
+    def _kill_overlaps_locked(self, ranges) -> None:
+        # one contiguous read of the slot region + iter_unpack, not
+        # `slots` individual unpack_from calls: this scan runs on EVERY
+        # put while holding the fleet-wide flock that quota try_acquire
+        # also serializes through, so its constant factor is what a
+        # publish stalls the whole fleet's quota-checked hit path by
+        region = bytes(self._mm[self.slot_off:
+                                self.slot_off + self.slots * SLOT_REC])
+        for i, rec in enumerate(struct.iter_unpack("<II16sQQQ", region)):
+            seq, length, _, rec_off, _, _ = rec
+            if length == 0:
+                continue
+            for lo, hi in ranges:
+                if rec_off < hi and rec_off + length > lo:
+                    off = self.slot_off + i * SLOT_REC
+                    struct.pack_into("<II", self._mm, off, seq + 2, 0)
+                    self._mm[off + 8:off + 24] = b"\x00" * 16
+                    break
+
+    def _write_slot_locked(self, key_hash, rec_off, length, put_gen):
+        target = reuse = None
+        for off in self._slot_probe(key_hash):
+            stored = self._mm[off + 8:off + 24]
+            if stored == key_hash:
+                target = off
+                break
+            length_here = struct.unpack_from("<I", self._mm, off + 4)[0]
+            if reuse is None and (stored == b"\x00" * 16
+                                  or length_here == 0):
+                reuse = off
+        if target is None:
+            target = reuse if reuse is not None else \
+                next(iter(self._slot_probe(key_hash)))    # evict chain head
+        seq = struct.unpack_from("<I", self._mm, target)[0]
+        struct.pack_into("<I", self._mm, target, seq + 1)      # odd: writing
+        self._mm[target + 8:target + 24] = key_hash
+        self._put_u64(target + 24, rec_off)
+        self._put_u64(target + 32, put_gen)
+        struct.pack_into("<I", self._mm, target + 4, length)
+        struct.pack_into("<I", self._mm, target, seq + 2)      # even: live
+
+    def peek_slot(self, key_hash: bytes) -> Optional[Tuple[int, int]]:
+        """(seq, put_gen) of the live slot for `key_hash`, or None — the
+        cheap revalidation read a worker's hot local copy rides on."""
+        for off in self._slot_probe(key_hash):
+            seq = struct.unpack_from("<I", self._mm, off)[0]
+            if seq & 1:
+                return None
+            if self._mm[off + 8:off + 24] == key_hash:
+                if struct.unpack_from("<I", self._mm, off + 4)[0] == 0:
+                    return None
+                return seq, self._u64(off + 32)
+        return None
+
+    def get(self, key_hash: bytes
+            ) -> Optional[Tuple[Any, tuple, int, int]]:
+        """Lock-free read: (entry, tables, put_gen, slot_seq) or None.
+        Validates the seqlock around the payload copy AND the entry's
+        table generations — a hit can never be stale."""
+        for _ in range(3):
+            found = self._locate(key_hash)
+            if found is None:
+                self.stats["misses"] += 1
+                return None
+            slot_off, seq, rec_off, length, put_gen = found
+            raw = bytes(self._mm[self.data_off + rec_off:
+                                 self.data_off + rec_off + length])
+            if struct.unpack_from("<I", self._mm, slot_off)[0] != seq:
+                continue    # writer raced the copy — retry
+            if raw[:16] != key_hash:
+                continue
+            (paylen,) = struct.unpack_from("<I", raw, 16)
+            if paylen != length - 20:
+                continue
+            try:
+                tables, entry = pickle.loads(raw[20:])
+            except Exception:   # torn record that beat the seq check
+                continue
+            if not self._entry_valid(put_gen, tables):
+                self.stats["misses"] += 1
+                return None
+            self.stats["hits"] += 1
+            return entry, tables, put_gen, seq
+        self.stats["misses"] += 1
+        return None
+
+    def _locate(self, key_hash):
+        for off in self._slot_probe(key_hash):
+            seq = struct.unpack_from("<I", self._mm, off)[0]
+            if seq & 1:
+                continue
+            if self._mm[off + 8:off + 24] != key_hash:
+                continue
+            length = struct.unpack_from("<I", self._mm, off + 4)[0]
+            if length == 0:
+                return None
+            rec_off = self._u64(off + 24)
+            if rec_off + length > self.data_size:
+                return None
+            return off, seq, rec_off, length, self._u64(off + 32)
+        return None
+
+    def entry_count(self) -> int:
+        n = 0
+        for i in range(self.slots):
+            off = self.slot_off + i * SLOT_REC
+            if struct.unpack_from("<I", self._mm, off + 4)[0] > 0:
+                n += 1
+        return n
+
+    # ------------------------------------------------------ quota buckets
+
+    def _quota_probe(self, digest: bytes) -> Iterable[int]:
+        base = int.from_bytes(digest[:8], "little") % self.quota_slots
+        for i in range(min(PROBE, self.quota_slots)):
+            yield self.quota_off + ((base + i) % self.quota_slots) * QUOTA_REC
+
+    def try_acquire(self, group: str, rate: float, burst: float,
+                    n: float = 1.0) -> bool:
+        """Fleet-wide token bucket for `group`: refill at `rate`
+        tokens/s up to `burst`, consume `n`. The bucket state lives in
+        shared memory, so the quota binds across every worker process —
+        N workers enforcing rate R admit R total, not N*R. Clocked on
+        CLOCK_MONOTONIC, which is system-wide on Linux."""
+        digest = group_fingerprint(group)
+        now = time.monotonic()
+        with self._locked(self):
+            slot = None
+            for off in self._quota_probe(digest):
+                stored = self._mm[off:off + 16]
+                if stored == digest:
+                    slot = off
+                    break
+                if stored == b"\x00" * 16 and slot is None:
+                    slot = off
+            if slot is None:
+                return True    # quota region full: fail open, never wedge
+            if self._mm[slot:slot + 16] != digest:
+                self._mm[slot:slot + 16] = digest
+                tokens, stamp = burst, now
+            else:
+                tokens, stamp = struct.unpack_from("<dd", self._mm,
+                                                   slot + 16)
+                tokens = min(burst, tokens + max(0.0, now - stamp) * rate)
+            ok = tokens >= n
+            if ok:
+                tokens -= n
+            struct.pack_into("<dd", self._mm, slot + 16, tokens, now)
+        if not ok:
+            self.stats["quota_rejections"] += 1
+        return ok

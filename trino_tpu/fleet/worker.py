@@ -1,0 +1,756 @@
+"""Fleet worker: one SO_REUSEPORT HTTP process of the serving fleet.
+
+N workers bind the SAME (host, port) with SO_REUSEPORT — the kernel
+load-balances accepted connections across them, so the fleet scales
+accepts past one process's GIL without a userspace balancer. Each
+worker:
+
+- answers RESULT-CACHE HITS locally from the cross-process shared tier
+  (fleet/shm.py): statement -> key digest (fleet/keys.py, memoized) ->
+  lock-free mmap read -> wire JSON. No socket to the engine, no
+  planning, no device. Per-group QPS quotas (token buckets in the same
+  shared region, so the quota binds fleet-wide) reject over-quota hits
+  with QUERY_QUEUE_FULL before any work happens.
+- funnels EVERYTHING ELSE over its local dispatch connection to the ONE
+  engine process that owns the device runner (jit cache, plan cache,
+  node pool, table cache stay single-owner), rewriting `nextUri` so the
+  client keeps talking to the fleet port — any worker can serve any
+  engine query's pages, which is what makes rolling restarts invisible.
+- keeps prepared statements STICKY: a PREPARE answered by the engine
+  echoes X-Trino-Added-Prepare; the worker that saw it registers the
+  statement in the fleet registry and fans it out on the bus, so an
+  EXECUTE landing on ANY worker (or the engine itself) resolves the
+  name even when the client never re-sends the prepared header.
+- drains gracefully: on a drain request it first answers every response
+  with `Connection: close` for a short grace window (persistent clients
+  finish their in-flight request and transparently reconnect — landing
+  on a surviving worker), then closes its listener (the kernel stops
+  routing new connections here), finishes what's left, and exits. The
+  rolling restart is: spawn replacement, drain old, repeat — zero
+  dropped queries.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import uuid
+from http.server import (BaseHTTPRequestHandler, HTTPServer,
+                         ThreadingHTTPServer)
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import quote, unquote
+
+from trino_tpu.fleet import metrics as fleet_metrics
+from trino_tpu.fleet.bus import FleetBus
+from trino_tpu.fleet.keys import StatementKeyer
+from trino_tpu.fleet.registry import (PreparedRegistry, ReloadableQuotaMap,
+                                      list_worker_records,
+                                      read_fleet_config,
+                                      remove_worker_record,
+                                      write_worker_record)
+from trino_tpu.fleet.shm import SharedCacheTier
+from trino_tpu.server import protocol
+
+PAGE_ROWS = 1000
+_HOP_HEADERS = {"connection", "keep-alive", "host", "content-length",
+                "transfer-encoding", "te", "upgrade", "trailer"}
+_URI_FIELDS = ("infoUri", "nextUri", "partialCancelUri")
+
+
+class _SharedPortServer(ThreadingHTTPServer):
+    def server_bind(self):
+        if hasattr(socket, "SO_REUSEPORT"):
+            self.socket.setsockopt(socket.SOL_SOCKET,
+                                   socket.SO_REUSEPORT, 1)
+        else:   # the parent-acceptor fallback never landed: be loud
+            raise OSError("fleet workers need SO_REUSEPORT")
+        HTTPServer.server_bind(self)
+
+
+class _AdminServer(ThreadingHTTPServer):
+    allow_reuse_address = True
+
+
+class WorkerServer:
+    def __init__(self, config: Dict[str, Any],
+                 worker_id: Optional[str] = None):
+        self.config = config
+        self.worker_id = worker_id or f"w-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+        self.host = config["host"]
+        self.port = int(config["port"])
+        self.engine_host = config["engine_host"]
+        self.engine_port = int(config["engine_port"])
+        self.engine_base = config["engine_base"]
+        self.fleet_dir = config["fleet_dir"]
+        self.public_base = f"http://{self.host}:{self.port}"
+        self.default_group = config.get("default_group", "global")
+        self.drain_grace_s = float(config.get("drain_grace_s", 0.5))
+        self.drain_timeout_s = float(config.get("drain_timeout_s", 10.0))
+        self.shared = SharedCacheTier(config["shm_path"])
+        self.keyer = StatementKeyer(
+            config.get("catalog"), config.get("schema"),
+            int(config["start_date"]), config.get("base_properties"))
+        self.prepared = PreparedRegistry(self.fleet_dir)
+        self.bus = FleetBus(self.fleet_dir, self.worker_id,
+                            on_message=self._on_bus)
+        # quota config (per-group result-cache QPS): from the fleet's
+        # resource-group file, hot-reloaded on mtime change so a quota
+        # edit applies fleet-wide without a rolling restart
+        self._quotas = ReloadableQuotaMap(
+            config.get("resource_groups_path"))
+        # hot local copies of shared-tier entries (digest -> (entry,
+        # tables, put_gen, seq)); every serve revalidates seq + table
+        # generations against the mmap, so a dead copy can mislead a
+        # lookup into at most one extra shared-tier read, never a stale
+        # answer
+        self._hot: Dict[bytes, tuple] = {}
+        self._hot_lock = threading.Lock()
+        self._tls = threading.local()
+        self.counters = {"hits": 0, "hit_rows": 0, "forwarded": 0,
+                         "quota_rejected": 0, "errors": 0}
+        self._counters_lock = threading.Lock()
+        # cache-hit accounting batches -> engine (fleet-aggregated group
+        # counters + sampled system.runtime.queries rows)
+        self._pending_counts: Dict[str, int] = {}
+        self._pending_rejections: Dict[str, int] = {}
+        self._pending_records: List[Dict] = []
+        self._pending_lock = threading.Lock()
+        self.state = "starting"
+        self._draining = threading.Event()
+        self._stopped = threading.Event()
+        self._httpd = _SharedPortServer((self.host, self.port),
+                                        self._make_handler())
+        self._admin = _AdminServer((self.host, 0), self._make_admin())
+        self.admin_port = self._admin.server_address[1]
+        self._threads: List[threading.Thread] = []
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "WorkerServer":
+        for target, name in ((self._httpd.serve_forever, "fleet-http"),
+                             (self._admin.serve_forever, "fleet-admin"),
+                             (self._flush_loop, "fleet-flush")):
+            th = threading.Thread(target=target, daemon=True,
+                                  name=f"{name}-{self.worker_id}")
+            th.start()
+            self._threads.append(th)
+        self.state = "active"
+        self._write_record()
+        return self
+
+    def _write_record(self) -> None:
+        write_worker_record(self.fleet_dir, self.worker_id, {
+            "pid": os.getpid(), "admin_port": self.admin_port,
+            "port": self.port, "state": self.state})
+
+    def drain(self, timeout_s: Optional[float] = None) -> None:
+        """Begin the graceful exit; returns immediately (the drain runs
+        on its own thread so the admin request that asked for it can be
+        answered)."""
+        if self._draining.is_set():
+            return
+        self._draining.set()
+        self.state = "draining"
+        self._write_record()
+        th = threading.Thread(
+            target=self._drain_run,
+            args=(self.drain_timeout_s if timeout_s is None
+                  else float(timeout_s),),
+            daemon=True, name=f"fleet-drain-{self.worker_id}")
+        th.start()
+
+    def _drain_run(self, timeout_s: float) -> None:
+        deadline = time.monotonic() + max(timeout_s, 0.1)
+        # phase 1: keep accepting, answer with Connection: close — every
+        # persistent client completes its in-flight request here, then
+        # transparently reconnects and lands on a surviving worker
+        time.sleep(min(self.drain_grace_s, max(timeout_s, 0.0)))
+        # phase 2: stop accepting (the kernel's SO_REUSEPORT group
+        # rebalances new connections to the remaining listeners)
+        self._httpd.shutdown()
+        # phase 3: let the stragglers on still-open connections finish
+        while time.monotonic() < deadline:
+            with self._counters_lock:
+                active = self.counters.get("in_flight", 0)
+            if active == 0:
+                break
+            time.sleep(0.05)
+        self._flush_hits()
+        self.stop()
+
+    def stop(self) -> None:
+        with self._counters_lock:
+            if self.state == "stopped":
+                return
+            self.state = "stopped"
+        try:
+            self._httpd.shutdown()
+        except Exception:   # noqa: BLE001 — already shut down
+            pass
+        self._admin.shutdown()
+        self._httpd.server_close()
+        self._admin.server_close()
+        remove_worker_record(self.fleet_dir, self.worker_id)
+        self.bus.close()
+        self.shared.close()
+        # LAST: join()ers (the worker main) exit the process on this —
+        # everything above must already be cleaned up by then
+        self._stopped.set()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        return self._stopped.wait(timeout)
+
+    # ------------------------------------------------------------- the bus
+
+    def _on_bus(self, message: Dict) -> None:
+        kind = message.get("kind")
+        if kind == "invalidate":
+            table = tuple(message.get("table") or ())
+            with self._hot_lock:
+                dead = [d for d, (_, tables, _, _) in self._hot.items()
+                        if table in tables]
+                for d in dead:
+                    del self._hot[d]
+        elif kind == "prepare":
+            self.prepared.register(message["name"], message["sql"],
+                                   persist=False)
+        elif kind == "deallocate":
+            self.prepared.remove(message["name"], persist=False)
+        elif kind == "drain":
+            self.drain(message.get("timeout_s"))
+        elif kind == "reload":
+            self._quotas.current(force=True)
+            self.prepared.reload()
+
+    # ------------------------------------------------------------- quotas
+
+    def _quota_allows(self, group: str) -> bool:
+        from trino_tpu.fleet.registry import quota_allows
+        return quota_allows(self.shared, self._quotas.current(), group)
+
+    # ------------------------------------------------------ hit accounting
+
+    def _record_hit(self, group: str, sql: str, user: str, qid: str,
+                    rows: int, nbytes: int) -> None:
+        with self._counters_lock:
+            self.counters["hits"] += 1
+            self.counters["hit_rows"] += rows
+        with self._pending_lock:
+            self._pending_counts[group] = \
+                self._pending_counts.get(group, 0) + 1
+            if len(self._pending_records) < 25:
+                self._pending_records.append({
+                    "query_id": qid, "user": user, "sql": sql[:200],
+                    "group": group, "rows": rows, "bytes": nbytes})
+
+    def _flush_loop(self) -> None:
+        while not self._stopped.wait(0.25):
+            self._flush_hits()
+
+    def _flush_hits(self) -> None:
+        with self._pending_lock:
+            if not self._pending_counts and not self._pending_rejections:
+                return
+            counts, self._pending_counts = self._pending_counts, {}
+            rejections, self._pending_rejections = \
+                self._pending_rejections, {}
+            records, self._pending_records = self._pending_records, []
+        ok = self.bus.send_to(
+            "engine", {"kind": "hits", "counts": counts,
+                       "rejections": rejections, "records": records,
+                       "worker": self.worker_id})
+        if not ok:
+            # full engine socket buffer / engine mid-restart: the counts
+            # are EXACT by contract — put the batch back and retry on
+            # the next flush tick instead of silently undercounting
+            with self._pending_lock:
+                for g, n in counts.items():
+                    self._pending_counts[g] = \
+                        self._pending_counts.get(g, 0) + n
+                for g, n in rejections.items():
+                    self._pending_rejections[g] = \
+                        self._pending_rejections.get(g, 0) + n
+                if not self._pending_records:
+                    self._pending_records = records
+
+    # ------------------------------------------------------- the fast path
+
+    @staticmethod
+    def _session_overrides(headers) -> Dict[str, str]:
+        overrides = {}
+        for part in headers.get("x-trino-session", "").split(","):
+            if "=" in part:
+                k, _, v = part.partition("=")
+                overrides[k.strip()] = unquote(v.strip())
+        return overrides
+
+    @staticmethod
+    def _header_prepared(headers) -> Dict[str, str]:
+        out = {}
+        for part in headers.get("x-trino-prepared-statement", "").split(","):
+            if "=" in part:
+                name, _, enc = part.partition("=")
+                out[unquote(name.strip())] = unquote(enc.strip())
+        return out
+
+    def _try_hit(self, sql: str, headers: Dict[str, str]
+                 ) -> Optional[Tuple[int, dict]]:
+        """(status, payload) for a shared-tier hit or a quota rejection;
+        None defers to the engine. Mirrors the single-process server's
+        POST-time probe gates (TrinoServer._try_cached). Only results
+        that fit ONE page serve worker-locally: a multi-page result's
+        nextUri would point at worker-private paging state, and a stock
+        client's next page request — a fresh connection on the shared
+        port — lands on a different worker with probability (N-1)/N;
+        forwarding instead lets the ENGINE's own cache hit serve it,
+        whose pages any worker can proxy."""
+        overrides = self._session_overrides(headers)
+        if overrides.get("result_cache_enabled", "").lower() in \
+                ("false", "0", "off", "no"):
+            return None
+        try:
+            if float(overrides.get("fault_injection_rate", 0)) > 0:
+                return None
+        except ValueError:
+            return None
+        if overrides.get("collect_operator_stats", "").lower() in \
+                ("true", "1", "on", "yes"):
+            return None
+        prepared = self.prepared.snapshot()
+        prepared.update(self._header_prepared(headers))
+        try:
+            digest = self.keyer.key_for(
+                sql, overrides, headers.get("x-trino-catalog"),
+                headers.get("x-trino-schema"), prepared)
+        except Exception:   # noqa: BLE001 — e.g. a malformed
+            # plan-property value in X-Trino-Session: defer to the
+            # engine, which answers the structured USER_ERROR the
+            # single-process server would (a raise here would drop the
+            # connection with no response at all)
+            return None
+        if digest is None:
+            return None
+        found = self._lookup(digest)
+        if found is None or len(found.rows) > PAGE_ROWS:
+            return None
+        entry = found
+        group = overrides.get("resource_group") or self.default_group
+        qid = f"{time.strftime('%Y%m%d')}_fleet_{uuid.uuid4().hex[:10]}"
+        if not self._quota_allows(group):
+            with self._counters_lock:
+                self.counters["quota_rejected"] += 1
+            with self._pending_lock:
+                self._pending_rejections[group] = \
+                    self._pending_rejections.get(group, 0) + 1
+            return 200, protocol.query_results(
+                qid, self.public_base, state="FAILED",
+                error=protocol.error_json(
+                    f"Result-cache QPS quota exceeded for resource "
+                    f"group {group!r}",
+                    error_name="QUERY_QUEUE_FULL", error_code=131074,
+                    error_type="INSUFFICIENT_RESOURCES"))
+        self._record_hit(group, sql, headers.get("x-trino-user", "user"),
+                         qid, entry.row_count, entry.output_bytes)
+        cols = protocol.columns_json(entry.column_names, entry.column_types)
+        data = protocol.encode_rows(entry.rows, entry.column_types)
+        return 200, protocol.query_results(
+            qid, self.public_base, columns=cols, data=data,
+            state="FINISHED", rows=entry.row_count, cpu_time_ms=0,
+            processed_bytes=entry.output_bytes)
+
+    def _lookup(self, digest: bytes):
+        """Hot local copy fast path with authoritative revalidation:
+        the slot's seqlock AND the entry's table generations are
+        re-read from the mmap on EVERY serve, so invalidation binds
+        immediately even if the bus datagram was lost."""
+        with self._hot_lock:
+            hot = self._hot.get(digest)
+        if hot is not None:
+            entry, tables, put_gen, seq = hot
+            live = self.shared.peek_slot(digest)
+            if live is not None and live == (seq, put_gen) and \
+                    self.shared._entry_valid(put_gen, tables):
+                self.shared.stats["hits"] += 1
+                return entry
+            with self._hot_lock:
+                self._hot.pop(digest, None)
+        found = self.shared.get(digest)
+        if found is None:
+            return None
+        entry, tables, put_gen, seq = found
+        with self._hot_lock:
+            self._hot[digest] = (entry, tables, put_gen, seq)
+            while len(self._hot) > 512:
+                self._hot.pop(next(iter(self._hot)))
+        return entry
+
+    # ------------------------------------------------------ the dispatch
+
+    def _engine_conn(self):
+        conn = getattr(self._tls, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                self.engine_host, self.engine_port, timeout=300)
+            self._tls.conn = conn
+        return conn
+
+    def _forward(self, method: str, path: str, body: Optional[bytes],
+                 headers: Dict[str, str]
+                 ) -> Tuple[int, Dict[str, str], bytes]:
+        fwd = {k: v for k, v in headers.items()
+               if k.lower() not in _HOP_HEADERS}
+        if method == "POST" and body is not None:
+            lowered = {k.lower(): v for k, v in headers.items()}
+            merged = self._merged_prepared_header(
+                body.decode(errors="replace"), lowered)
+            if merged:
+                fwd = {k: v for k, v in fwd.items()
+                       if k.lower() != "x-trino-prepared-statement"}
+                fwd["X-Trino-Prepared-Statement"] = merged
+        for attempt in range(2):
+            conn = self._engine_conn()
+            sent = False
+            try:
+                conn.request(method, path, body=body, headers=fwd)
+                sent = True
+                resp = conn.getresponse()
+                data = resp.read()
+                return resp.status, dict(resp.getheaders()), data
+            except (OSError, http.client.HTTPException) as e:
+                self._tls.conn = None
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                # retry discipline: a failure during SEND means the
+                # engine never saw a complete request — safe to retry
+                # anything. A failure AFTER the send (OSError or an
+                # HTTPException like IncompleteRead from an engine
+                # dying mid-response) may have executed server-side, so
+                # only idempotent methods retry; a non-idempotent POST
+                # (INSERT/DDL) must surface the error rather than risk
+                # double execution
+                if attempt or (sent and method == "POST"):
+                    raise OSError(f"engine dispatch failed: {e}") \
+                        from e
+        raise OSError("unreachable")
+
+    def _merged_prepared_header(self, sql: str, headers) -> str:
+        """Sticky prepared-statement routing: when the forwarded
+        statement is an EXECUTE whose name the client did NOT re-send,
+        the fleet registry's entry for THAT ONE NAME rides along (the
+        client's own header always passes through verbatim, client
+        entries winning). Only the needed name is attached — shipping
+        the whole registry on every POST would grow the header without
+        bound (http.server rejects >64KB header lines) and pay
+        O(registry) encode per dispatch for statements that need none
+        of it; the engine also learns every PREPARE via the bus, so
+        this is the per-request safety net, not the primary channel."""
+        raw = headers.get("x-trino-prepared-statement", "")
+        name = StatementKeyer._execute_name(sql) \
+            if sql.lstrip()[:8].upper().startswith("EXECUTE") else None
+        if name is None:
+            return raw
+        client = self._header_prepared(headers)
+        if name in client:
+            return raw
+        text = self.prepared.get(name)
+        if text is None:
+            return raw
+        entry = f"{quote(name, safe='')}={quote(text, safe='')}"
+        return f"{raw},{entry}" if raw else entry
+
+    def _rewrite(self, body: bytes) -> bytes:
+        try:
+            payload = json.loads(body)
+        except ValueError:
+            return body
+        changed = False
+        for field in _URI_FIELDS:
+            uri = payload.get(field)
+            if isinstance(uri, str) and uri.startswith(self.engine_base):
+                payload[field] = self.public_base + \
+                    uri[len(self.engine_base):]
+                changed = True
+        return json.dumps(payload).encode() if changed else body
+
+    def _after_forward(self, resp_headers: Dict[str, str]) -> None:
+        added = next((v for k, v in resp_headers.items()
+                      if k.lower() == "x-trino-added-prepare"), None)
+        if added and "=" in added:
+            name, _, enc = added.partition("=")
+            name, sql = unquote(name), unquote(enc)
+            self.prepared.register(name, sql)
+            self.bus.publish({"kind": "prepare", "name": name,
+                              "sql": sql}, exclude_self=True)
+        dealloc = next((v for k, v in resp_headers.items()
+                        if k.lower() == "x-trino-deallocated-prepare"),
+                       None)
+        if dealloc:
+            name = unquote(dealloc)
+            self.prepared.remove(name)
+            self.bus.publish({"kind": "deallocate", "name": name},
+                             exclude_self=True)
+
+    # -------------------------------------------------------- aggregation
+
+    def _aggregate_metrics(self) -> str:
+        texts = []
+        local = self._local_metrics()
+        if local:
+            texts.append(local)
+        engine = fleet_metrics.scrape(self.engine_host, self.engine_port)
+        if engine:
+            texts.append(engine)
+        for rec in list_worker_records(self.fleet_dir):
+            if rec.get("worker_id") == self.worker_id:
+                continue
+            text = fleet_metrics.scrape(self.host, rec.get("admin_port"),
+                                        timeout=1.0)
+            if text:
+                texts.append(text)
+        return fleet_metrics.merge_prometheus(texts)
+
+    def _local_metrics(self) -> str:
+        """The worker's OWN exposition: its fleet gauges ONLY — not the
+        full process registry. A worker process carries the same
+        engine-gauge families as any trino_tpu process (pool limits,
+        cache bounds, history size — constants describing its IDLE
+        runner), and summing those across the fleet would report
+        capacity gauges at (workers+1)x reality. The engine's scrape is
+        the one authoritative engine exposition."""
+        with self._counters_lock:
+            counters = dict(self.counters)
+        labels = f'{{worker="{self.worker_id}"}}'
+        gauges = (
+            ("trino_tpu_fleet_worker_hits",
+             "Result-cache hits served locally by a fleet worker.",
+             counters["hits"]),
+            ("trino_tpu_fleet_worker_forwarded",
+             "Requests forwarded to the engine by a fleet worker.",
+             counters["forwarded"]),
+            ("trino_tpu_fleet_worker_quota_rejected",
+             "Fast-path hits rejected by group QPS quotas.",
+             counters["quota_rejected"]),
+            ("trino_tpu_fleet_shared_cache_hits",
+             "Shared-tier lookups that hit, per process.",
+             self.shared.stats["hits"]),
+            ("trino_tpu_fleet_shared_cache_misses",
+             "Shared-tier lookups that missed, per process.",
+             self.shared.stats["misses"]),
+        )
+        lines = []
+        for name, help_text, value in gauges:
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name}{labels} {value}")
+        return "\n".join(lines) + "\n"
+
+    def status(self) -> Dict[str, Any]:
+        with self._counters_lock:
+            counters = dict(self.counters)
+        return {"worker_id": self.worker_id, "pid": os.getpid(),
+                "state": self.state, "port": self.port,
+                "admin_port": self.admin_port, "counters": counters,
+                "shared_cache": dict(self.shared.stats),
+                "prepared": sorted(self.prepared.snapshot()),
+                "hot_entries": len(self._hot)}
+
+    # ----------------------------------------------------------- handlers
+
+    def _make_handler(self):
+        worker = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _begin(self):
+                with worker._counters_lock:
+                    worker.counters["in_flight"] = \
+                        worker.counters.get("in_flight", 0) + 1
+
+            def _end(self):
+                with worker._counters_lock:
+                    worker.counters["in_flight"] -= 1
+
+            def _send_json(self, payload: dict, status: int = 200,
+                           extra: Optional[Dict[str, str]] = None):
+                body = json.dumps(payload).encode() \
+                    if isinstance(payload, dict) else payload
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra or {}).items():
+                    self.send_header(k, v)
+                if worker._draining.is_set():
+                    # drain handoff: finish this response, then the
+                    # client transparently reconnects onto a surviving
+                    # listener (all worker state is connection-free —
+                    # engine queries proxy from ANY worker)
+                    self.send_header("Connection", "close")
+                    self.close_connection = True
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _proxy(self, method: str, body: Optional[bytes] = None):
+                headers = {k: v for k, v in self.headers.items()}
+                try:
+                    status, resp_headers, data = worker._forward(
+                        method, self.path, body, headers)
+                except OSError as e:
+                    with worker._counters_lock:
+                        worker.counters["errors"] += 1
+                    self._send_json(protocol.query_results(
+                        "fleet_dispatch", worker.public_base,
+                        state="FAILED",
+                        error=protocol.error_json(
+                            f"fleet dispatch to engine failed: {e}",
+                            error_name="REMOTE_TASK_ERROR",
+                            error_code=65542,
+                            error_type="INTERNAL_ERROR")), 200)
+                    return
+                with worker._counters_lock:
+                    worker.counters["forwarded"] += 1
+                worker._after_forward(resp_headers)
+                extra = {k: v for k, v in resp_headers.items()
+                         if k.lower().startswith("x-trino-")}
+                data = worker._rewrite(data)
+                self._send_json(data, status, extra)
+
+            def do_POST(self):
+                self._begin()
+                try:
+                    if self.path.rstrip("/") == "/v1/statement":
+                        length = int(self.headers.get("Content-Length", 0))
+                        sql = self.rfile.read(length).decode()
+                        lowered = {k.lower(): v
+                                   for k, v in self.headers.items()}
+                        hit = worker._try_hit(sql, lowered)
+                        if hit is not None:
+                            status, payload = hit
+                            self._send_json(payload, status)
+                            return
+                        self._proxy("POST", sql.encode())
+                        return
+                    self.send_error(404)
+                finally:
+                    self._end()
+
+            def do_GET(self):
+                self._begin()
+                try:
+                    if self.path.rstrip("/") == "/v1/metrics":
+                        body = worker._aggregate_metrics().encode()
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type",
+                            "text/plain; version=0.0.4; charset=utf-8")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    if self.path.rstrip("/") == "/v1/fleet/status":
+                        self._send_json(worker.status())
+                        return
+                    self._proxy("GET")
+                finally:
+                    self._end()
+
+            def do_DELETE(self):
+                self._begin()
+                try:
+                    self._proxy("DELETE")
+                finally:
+                    self._end()
+
+        return Handler
+
+    def _make_admin(self):
+        worker = self
+
+        class AdminHandler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                if self.path.rstrip("/") == "/v1/metrics":
+                    body = worker._local_metrics().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if self.path.rstrip("/") == "/v1/fleet/status":
+                    body = json.dumps(worker.status()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                self.send_error(404)
+
+            def do_POST(self):
+                if self.path.rstrip("/") == "/v1/fleet/drain":
+                    timeout_s = None
+                    length = int(self.headers.get("Content-Length", 0))
+                    if length:
+                        try:
+                            timeout_s = json.loads(
+                                self.rfile.read(length)).get("timeout_s")
+                        except ValueError:
+                            pass
+                    worker.drain(timeout_s)
+                    self.send_response(202)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                if self.path.rstrip("/") == "/v1/fleet/stop":
+                    self.send_response(202)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    threading.Thread(target=worker.stop,
+                                     daemon=True).start()
+                    return
+                self.send_error(404)
+
+        return AdminHandler
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: python -m trino_tpu.fleet.worker <fleet_dir> "
+              "[worker_id]", file=sys.stderr)
+        return 2
+    fleet_dir = argv[0]
+    worker_id = argv[1] if len(argv) > 1 else None
+    config = read_fleet_config(fleet_dir)
+    server = WorkerServer(config, worker_id=worker_id).start()
+
+    import signal
+
+    def _on_term(signum, frame):
+        server.drain()
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    server.join()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -231,6 +231,51 @@ SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     "slice_target_ms": 250,
 }
 
+# SERVER- and FLEET-level properties (round 14): deployment knobs that
+# live on the server/fleet constructors, NOT in the per-session bag —
+# documented here alongside the session properties because operators
+# reach for one list. The resource-group JSON file (TrinoServer
+# resource_groups_path / FleetServer resource_groups_path) additionally
+# accepts per-group `result_cache_qps` / `result_cache_qps_burst`
+# (camelCase aliases accepted): a token-bucket QPS quota on the
+# result-cache fast path — over-quota hits answer QUERY_QUEUE_FULL.
+# The file HOT-RELOADS on mtime change (engine and fleet workers alike),
+# so quota/limit edits apply without a restart.
+SERVER_PROPERTY_DOCS: Dict[str, str] = {
+    "drain_timeout_s":
+        "TrinoServer: how long stop() lets in-flight queries and "
+        "actively-consumed result streams finish before canceling the "
+        "rest and tearing down (default 10.0; 0 = immediate teardown).",
+    "drain_idle_grace_s":
+        "TrinoServer: an open result stream with no page request for "
+        "this long counts as abandoned and no longer holds the drain "
+        "(default 1.0).",
+    "resource_groups_path":
+        "TrinoServer/FleetServer: resource-group JSON config file; "
+        "re-applied automatically on mtime change (hot reload), "
+        "including per-group result_cache_qps quotas.",
+    "workers":
+        "FleetServer: number of SO_REUSEPORT worker processes sharing "
+        "the fleet port (default 2). Workers answer result-cache hits "
+        "from the cross-process shared tier; everything else funnels "
+        "to the one engine process that owns the device runner.",
+    "fleet_dir":
+        "FleetServer: rendezvous directory (shm cache file, bus "
+        "sockets, prepared-statement registry, worker records); a "
+        "private tempdir by default.",
+    "shm_data_bytes":
+        "FleetServer: byte size of the shared result-cache ring "
+        "(default 64MB).",
+    "drain_grace_s":
+        "FleetServer/worker: how long a draining worker keeps "
+        "accepting while answering `Connection: close` before closing "
+        "its listener (default 0.5) — the zero-drop handoff window of "
+        "a rolling restart.",
+    "in_process":
+        "FleetServer: run workers as in-process threads instead of "
+        "subprocesses (tests/debugging only — shares the GIL).",
+}
+
 
 @dataclasses.dataclass
 class Session:

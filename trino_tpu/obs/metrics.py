@@ -429,6 +429,12 @@ def _engine_gauges():
                "resource group (zero-dispatch fast path; counted so "
                "group QPS quotas see cached traffic).",
                g.served_from_cache, labels)
+        if g.cache_hit_rejections or g.result_cache_qps is not None:
+            yield ("trino_tpu_resource_group_cache_hit_rejections",
+                   "Fast-path hits rejected by the group's "
+                   "result_cache_qps token bucket (QUERY_QUEUE_FULL "
+                   "on the wire).",
+                   g.cache_hit_rejections, labels)
 
     from trino_tpu.exec import jit_cache
     js = jit_cache.stats()

@@ -68,6 +68,13 @@ class ResultStream:
         self.total_rows = 0
         self.high_watermark = 0     # max chunks ever resident (tests/gauges)
         self._last_progress = time.monotonic()
+        # consumer CONTACT (any get(), even one answered 'pending') is
+        # tracked separately from consumer PROGRESS (acks/serves): the
+        # stall guard keys on progress — a zombie client re-polling one
+        # token must still stall out — while the server's drain keys on
+        # contact, so a live client polling a slow producer is not
+        # mistaken for an abandoned stream
+        self._last_get = time.monotonic()
         _STREAMS.add(self)
 
     # ---------------------------------------------------------- producer
@@ -147,6 +154,7 @@ class ResultStream:
         ('error', None) after a producer failure (read `self.error`)."""
         deadline = time.monotonic() + timeout
         with self._cond:
+            self._last_get = time.monotonic()
             if token < self._base:
                 return "gone", None
             # requesting token t ACKS every earlier chunk — free their
@@ -193,6 +201,14 @@ class ResultStream:
         """Producer closed AND every chunk acked."""
         with self._cond:
             return self.closed and self._base >= self._next_put
+
+    @property
+    def last_consumer_contact(self) -> float:
+        """Monotonic stamp of the last consumer get() of ANY outcome —
+        what the server's drain watches: a client polling a slow
+        producer is alive even though no chunk moved yet."""
+        with self._cond:
+            return self._last_get
 
 
 def stream_stats() -> Dict[str, int]:

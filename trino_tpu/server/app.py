@@ -180,7 +180,9 @@ class TrinoServer:
                  otlp_export: Optional[str] = None,
                  metrics_wall_buckets=None,
                  trace_dir: Optional[str] = None,
-                 history_max_entries: Optional[int] = None):
+                 history_max_entries: Optional[int] = None,
+                 drain_timeout_s: float = 10.0,
+                 drain_idle_grace_s: float = 1.0):
         self.runner = runner
         # serving tier defaults: the server IS the production front door,
         # so result/scan caching default ON for server sessions (clones
@@ -282,12 +284,38 @@ class TrinoServer:
                 max_total_queued=max_queued)
         self.groups = resource_groups or ResourceGroupManager(
             default_max_queued=max_queued, max_total_queued=max_queued)
+        # resource-group config hot-reload (round 14): an edited config
+        # re-applies on mtime change WITHOUT a restart — fleet-wide
+        # quota/limit changes don't need a rolling restart. Checked
+        # (throttled) on the POST path, through the SAME FileWatch
+        # primitive the fleet's quota maps use so engine and workers
+        # cannot drift on when an edit takes effect.
+        from trino_tpu.fleet.registry import FileWatch
+        self._rg_path = resource_groups_path
+        self._rg_watch = FileWatch(resource_groups_path)
+        self._rg_reloads = 0
+        # graceful drain (round 14): stop() stops accepting, then lets
+        # RUNNING queries and actively-consumed result streams finish
+        # before teardown. `drain_idle_grace_s` bounds how long an
+        # ABANDONED stream (no page request) holds the drain.
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.drain_idle_grace_s = float(drain_idle_grace_s)
+        self.draining = threading.Event()
+        # fleet integration seam: when set (fleet/server.py), the
+        # result-cache fast path's per-group QPS quota check routes to
+        # the FLEET-WIDE shared-memory buckets instead of the manager's
+        # in-process ones, so engine-landed and worker-landed hits drain
+        # one bucket per group
+        self.fast_path_quota = None
         self._lock = threading.Lock()
         self._queries: Dict[str, _Query] = {}
         self._pruned: Dict[str, None] = {}   # ordered set of purged ids
         self._seq = itertools.count(1)
         self._stopping = threading.Event()
         handler = self._make_handler()
+        # ThreadingHTTPServer's handler threads are daemonic, so
+        # server_close() after the drain below never blocks on a parked
+        # keep-alive connection
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None
         self._executors: List[threading.Thread] = []
@@ -324,12 +352,44 @@ class TrinoServer:
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, drain_timeout_s: Optional[float] = None) -> None:
+        """Graceful shutdown with drain (round 14): stop accepting new
+        connections, reject new statements, then let in-flight work
+        finish before teardown — RUNNING queries complete, and open
+        `nextUri` result streams keep serving pages off still-open
+        connections until drained (or abandoned past the idle grace).
+        Queued-but-unstarted queries are canceled (they never produced
+        anything a client could lose), and whatever is left at the
+        drain deadline is canceled cooperatively. `drain_timeout_s=0`
+        restores the old immediate-teardown behavior."""
+        drain_s = self.drain_timeout_s if drain_timeout_s is None \
+            else float(drain_timeout_s)
+        # "stop accepting" means STATEMENTS, not connections: clients
+        # without keep-alive open a fresh connection per nextUri page,
+        # so the listener must keep serving GET/DELETE until the drain
+        # completes — new POSTs answer SERVER_SHUTTING_DOWN immediately
+        self.draining.set()
+        deadline = time.monotonic() + max(drain_s, 0.0)
+        with self._lock:
+            queries = list(self._queries.values())
+        for q in queries:            # never-started queries just cancel
+            if q.state == "QUEUED":
+                q.cancelled = True
+                q.cancel_event.cancel()
+        while time.monotonic() < deadline:
+            if not self._drain_pending():
+                break
+            time.sleep(0.05)
+        with self._lock:
+            leftovers = [q for q in self._queries.values() if not q.done]
+        for q in leftovers:          # past the deadline: cancel, don't hang
+            q.cancelled = True
+            q.cancel_event.cancel()
         self._httpd.shutdown()
-        self._httpd.server_close()
         self._stopping.set()
         for th in self._executors:
             th.join(timeout=10)
+        self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
         if self.otlp_exporter is not None:
@@ -339,6 +399,63 @@ class TrinoServer:
             from trino_tpu.obs.otlp import uninstall_otlp_exporter
             uninstall_otlp_exporter(self.otlp_exporter)
             self.otlp_exporter = None
+
+    def _drain_pending(self) -> bool:
+        """True while something a client could still lose is in flight:
+        a RUNNING query, or an opened result stream that is not drained
+        AND has seen consumer progress within the idle grace (an
+        abandoned stream — client gone without DELETE — must not hold
+        the drain for the full deadline; its query is canceled by the
+        deadline sweep or the stall guard)."""
+        now = time.monotonic()
+        with self._lock:
+            queries = list(self._queries.values())
+        for q in queries:
+            if q.state == "RUNNING":
+                stream = q.stream
+                if stream is not None and stream.opened and \
+                        now - stream.last_consumer_contact > \
+                        self.drain_idle_grace_s:
+                    q.cancel_event.cancel()   # parked on a gone client
+                    continue
+                return True
+            stream = q.stream
+            if stream is not None and stream.opened \
+                    and not stream.drained and q.error is None \
+                    and not q.cancelled:
+                if now - stream.last_consumer_contact <= \
+                        self.drain_idle_grace_s:
+                    return True    # actively consumed: let it finish
+        return False
+
+    def _maybe_reload_groups(self) -> None:
+        """Resource-group config hot-reload: re-apply the JSON file when
+        its mtime changes (throttled to one stat/s). A malformed or
+        deleted file logs a warning and keeps the previous tree — an
+        operator mishap must not strip a production server of its
+        limits (quota MAPS are declarative and clear instead; see
+        FileWatch's docstring for the split)."""
+        if not self._rg_watch.changed():
+            return
+        import json as _json
+        try:
+            with open(self._rg_path) as fh:
+                tree = _json.load(fh)
+            # validate the WHOLE tree on a throwaway manager first: a
+            # typo in group B must not leave group A half-reconfigured
+            # (configure_from_dict applies specs sequentially)
+            from trino_tpu.exec.resource_groups import _MANAGERS
+            staged = ResourceGroupManager()
+            _MANAGERS.discard(staged)   # not a live manager: keep it
+            # out of system.runtime.resource_groups and the gauges
+            staged.configure_from_dict(tree)
+            self.groups.configure_from_dict(tree)
+            self._rg_reloads += 1
+        except Exception as e:   # noqa: BLE001 — keep the old config
+            import logging
+            logging.getLogger("trino_tpu.server").warning(
+                "resource-group config reload failed for %s: %s",
+                self._rg_path, e)
 
     # ---------------------------------------------------------- execution
 
@@ -442,6 +559,36 @@ class TrinoServer:
         q = _Query(qid, uuid.uuid4().hex[:12], sql, hdrs)
         user = hdrs.get("x-trino-user", "user")
         group = self._group_for(hdrs)
+        # per-group QPS quota on the fast path (round 14): every chain
+        # level with a configured result_cache_qps must grant a token
+        # BEFORE the hit is served; over quota answers QUERY_QUEUE_FULL
+        # — the enforcement ROADMAP promised for the served_from_cache
+        # accounting. Under a fleet, the check routes to the shared-
+        # memory buckets (fast_path_quota) so the quota binds fleet-wide.
+        if self.fast_path_quota is not None:
+            allowed = self.fast_path_quota(group)
+            if allowed:
+                self.groups.record_cache_hit(group, enforce=False)
+            else:
+                # enforcement happened in the shared bucket; the group's
+                # rejection counters must still move
+                self.groups.record_cache_hit_rejection(group)
+        else:
+            allowed = self.groups.record_cache_hit(group) is not None
+        if not allowed:
+            q.state = "FAILED"
+            q.error = protocol.error_json(
+                f"Result-cache QPS quota exceeded for resource group "
+                f"{group!r}", error_name="QUERY_QUEUE_FULL",
+                error_code=131074, error_type="INSUFFICIENT_RESOURCES")
+            q.info = TRACKER.begin(sql, user=user, query_id=qid,
+                                   resource_group=group)
+            TRACKER.fail(q.info, "Result-cache QPS quota exceeded",
+                         error_name="QUERY_QUEUE_FULL")
+            with self._lock:
+                self._queries[qid] = q
+                self._prune_locked()
+            return q
         info = TRACKER.begin(sql, user=user, query_id=qid,
                              resource_group=group)
         q.info = info
@@ -462,11 +609,9 @@ class TrinoServer:
         q.result = MaterializedResult(
             list(entry.column_names), list(entry.column_types),
             list(entry.rows), row_count=entry.row_count)
-        # group accounting: the fast path skips submit/take/finish (a
-        # hit costs no executor resources to admit), but the completion
-        # still charges the group's completed/served-from-cache counters
-        # so group QPS quotas see cached traffic
-        self.groups.record_cache_hit(group)
+        # group accounting already happened at the quota gate above (the
+        # fast path still skips submit/take/finish: a hit costs no
+        # executor resources to admit)
         TRACKER.running(info)
         TRACKER.finish(info, entry.row_count)
         q.state = "FINISHED"
@@ -924,6 +1069,20 @@ class TrinoServer:
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 sql = self.rfile.read(length).decode()
+                if server.draining.is_set():
+                    # drain protocol: no NEW statements; in-flight
+                    # queries and open streams keep paging below
+                    self._send_json(protocol.query_results(
+                        "draining", server.base_uri, state="FAILED",
+                        error=protocol.error_json(
+                            "Server is shutting down",
+                            error_name="SERVER_SHUTTING_DOWN",
+                            error_code=131075,
+                            error_type="INSUFFICIENT_RESOURCES")))
+                    return
+                # group-config hot-reload check rides the submit path
+                # (throttled): an edited JSON file re-applies here
+                server._maybe_reload_groups()
                 # result-cache fast path: a hit answers FINISHED right
                 # here — data inline when it fits the first page, else
                 # paged off q.result — without touching the dispatcher
