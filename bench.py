@@ -821,6 +821,154 @@ def run_preempt(out_path=None) -> None:
             f.write(line + "\n")
 
 
+def run_join_micro(out_path=None) -> None:
+    """`bench.py --join-micro [OUT.json]`: matmul-vs-gather head-to-head
+    (ROADMAP item 1 / ops/join_mxu.py). Builds synthetic probe/build
+    tables from TPC-H data at several density/NDV rungs plus the
+    many-to-many AGGREGATING-join rung (the TPC-DS q64/q72 shape: match
+    multiplicities feed SUM/COUNT without materializing the cross
+    product), and times each rung with the MXU router enabled vs pinned
+    off. Per rung: warm walls, probe rows/s both ways, the speedup, the
+    mxu_joins/mxu_flops counters, the cold run's XLA cost-model compile
+    flops (nonzero matmul flops = the MXU kernels really compiled), and
+    a row-parity check. The final JSON line ALWAYS prints; failures
+    land in an `error` field. TPU re-run is noted as blocked per
+    ROADMAP item 5 — these are CPU numbers."""
+    platform = _ensure_backend()
+    schema = os.environ.get("TRINO_TPU_JOIN_MICRO_SCHEMA", "sf1")
+    payload = {"metric": "join_micro", "backend": platform,
+               "schema": schema,
+               "tpu_note": "CPU numbers; TPU re-run blocked on device "
+                           "access (ROADMAP item 5)"}
+    try:
+        import trino_tpu
+        trino_tpu.enable_persistent_cache()
+        from trino_tpu.exec import LocalQueryRunner
+
+        probe_rows = int(os.environ.get("TRINO_TPU_JOIN_MICRO_ROWS",
+                                        1 << 20))
+        runner = LocalQueryRunner.tpch(schema)
+        runner.execute(
+            "CREATE TABLE memory.default.jm_probe AS "
+            "SELECT l_partkey AS kp, l_orderkey % 2048 AS km, "
+            "l_orderkey % 64 AS g, l_quantity AS v "
+            f"FROM lineitem LIMIT {probe_rows}")
+        n_probe = runner.execute(
+            "SELECT count(*) FROM memory.default.jm_probe").rows[0][0]
+        runner.execute(
+            "CREATE TABLE memory.default.jm_build_m2m AS "
+            "SELECT l_orderkey % 2048 AS k, l_extendedprice AS w "
+            "FROM lineitem LIMIT 32768")
+        runner.execute(
+            "CREATE TABLE memory.default.jm_build_u4k AS "
+            "SELECT p_partkey AS k, p_retailprice AS w FROM part "
+            "WHERE p_partkey <= 4000")
+        runner.execute(
+            "CREATE TABLE memory.default.jm_build_u512 AS "
+            "SELECT p_partkey AS k, p_retailprice AS w FROM part "
+            "WHERE p_partkey <= 512")
+        runner.execute(
+            "CREATE TABLE memory.default.jm_build_sparse AS "
+            "SELECT p_partkey AS k, p_retailprice AS w FROM part "
+            "WHERE p_partkey <= 4000 AND p_partkey % 64 = 0")
+        # (name, build table, sql) — the non-fused rungs aggregate a
+        # COMPUTED expression so the join-project probe kernel itself
+        # is what runs; the m2m rung is the fused aggregating join
+        rungs = [
+            ("dense_unique_ndv4k", "jm_build_u4k",
+             "SELECT count(*), max(v + w) FROM memory.default.jm_probe "
+             "p, memory.default.jm_build_u4k b WHERE p.kp = b.k"),
+            ("dense_unique_ndv512", "jm_build_u512",
+             "SELECT count(*), max(v + w) FROM memory.default.jm_probe "
+             "p, memory.default.jm_build_u512 b WHERE p.kp = b.k"),
+            ("sparse_density_1_64", "jm_build_sparse",
+             "SELECT count(*), max(v + w) FROM memory.default.jm_probe "
+             "p, memory.default.jm_build_sparse b WHERE p.kp = b.k"),
+            ("m2m_aggregating", "jm_build_m2m",
+             "SELECT g, count(*) c, sum(v) sv, sum(w) sw "
+             "FROM memory.default.jm_probe p, "
+             "memory.default.jm_build_m2m b WHERE p.km = b.k "
+             "GROUP BY g ORDER BY g"),
+        ]
+        out_rungs = []
+        for name, build_table, sql in rungs:
+            info = runner.execute(
+                f"SELECT count(*), count(DISTINCT k), min(k), max(k) "
+                f"FROM memory.default.{build_table}").rows[0]
+            brows, ndv, kmin, kmax = (int(x) for x in info)
+            span = kmax - kmin + 1 if kmax >= kmin else 0
+            rung = {"name": name, "build_rows": brows, "ndv": ndv,
+                    "span": span,
+                    "density": round(ndv / span, 4) if span else 0.0,
+                    "duplication": round(brows / max(ndv, 1), 2)}
+
+            def timed(enabled):
+                runner.execute("SET SESSION mxu_join_enabled = "
+                               + ("true" if enabled else "false"))
+                t0 = time.perf_counter()
+                res = runner.execute(sql)
+                cold_wall = time.perf_counter() - t0
+                cold = dict(runner.last_query_stats)
+                t0 = time.perf_counter()
+                res = runner.execute(sql)
+                warm_wall = time.perf_counter() - t0
+                warm = dict(runner.last_query_stats)
+                return res.rows, cold_wall, warm_wall, cold, warm
+
+            mxu_rows, mxu_cold, mxu_wall, mxu_cstats, mxu_stats = \
+                timed(True)
+            g_rows, g_cold, g_wall, _g_c, _g_w = timed(False)
+            rung.update({
+                "routed": "mxu-matmul"
+                          if mxu_stats.get("mxu_joins", 0) else "gather",
+                "mxu_warm_wall_s": round(mxu_wall, 4),
+                "gather_warm_wall_s": round(g_wall, 4),
+                "speedup": round(g_wall / max(mxu_wall, 1e-9), 3),
+                "probe_rows_s_mxu": round(n_probe / max(mxu_wall, 1e-9)),
+                "probe_rows_s_gather": round(
+                    n_probe / max(g_wall, 1e-9)),
+                "mxu_joins": int(mxu_stats.get("mxu_joins", 0)),
+                "mxu_flops": float(mxu_stats.get("mxu_flops", 0)),
+                "compile_flops_cold": float(
+                    mxu_cstats.get("estimated_flops", 0)),
+                "rows_match": sorted(map(str, mxu_rows))
+                              == sorted(map(str, g_rows)),
+            })
+            out_rungs.append(rung)
+        payload["probe_rows"] = int(n_probe)
+        payload["rungs"] = out_rungs
+        # per-operator attribution over the m2m rung: the measured
+        # device wall apportions by XLA cost analysis (obs/profiler);
+        # the query-level counters carry the matmul flops proof
+        runner.execute("SET SESSION mxu_join_enabled = true")
+        runner.execute("SET SESSION collect_operator_stats = true")
+        runner.execute(rungs[-1][2])
+        st = runner.last_query_stats
+        ops = sorted(st.get("operators", []),
+                     key=lambda o: -o.get("device_ms", 0))[:4]
+        payload["m2m_attribution"] = {
+            "mxu_joins": int(st.get("mxu_joins", 0)),
+            "mxu_flops": float(st.get("mxu_flops", 0)),
+            "top_operators_by_device_ms": [
+                {"name": o["name"],
+                 "device_ms": o.get("device_ms", 0)} for o in ops],
+        }
+        m2m = out_rungs[-1]
+        payload["m2m_speedup"] = m2m["speedup"]
+        payload["mxu_beats_gather"] = bool(
+            m2m["routed"] == "mxu-matmul" and m2m["speedup"] > 1.0
+            and m2m["mxu_flops"] > 0)
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the line must print
+        payload["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+    line = json.dumps(payload)
+    print(line, flush=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+
+
 Q18_LADDER = """
 SELECT c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
        sum(l_quantity)
@@ -1231,5 +1379,7 @@ if __name__ == "__main__":
         run_memory_ladder(sys.argv[2] if len(sys.argv) >= 3 else None)
     elif len(sys.argv) >= 2 and sys.argv[1] == "--profile":
         run_profile(sys.argv[2] if len(sys.argv) >= 3 else None)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--join-micro":
+        run_join_micro(sys.argv[2] if len(sys.argv) >= 3 else None)
     else:
         main()

@@ -1,0 +1,353 @@
+"""MXU-native join path: density-partitioned matmul joins vs the gather
+path vs the sqlite oracle (ops/join_mxu.py, the router in
+exec/local_planner._prepare_probe, the fused aggregating join in
+_mxu_agg_join, and the mesh in-program variant).
+
+Parity discipline: every shape runs FORCED onto the matmul path
+(density threshold 0, widened slots so the router cannot decline) and
+FORCED off (mxu_join_enabled = false), compared against each other —
+the gather path is the reference semantics — and, where the result is
+cleanly comparable, against the sqlite oracle. The EXPLAIN strategy
+line, the mxu_joins/mxu_flops counters, 8-device mesh parity with
+exchanges_staged == 0, and chaos-under-TASK with the path pinned are
+asserted alongside.
+"""
+
+import jax
+import pytest
+
+from trino_tpu.exec import LocalQueryRunner
+
+from oracle import assert_same, load_tpch_sqlite
+
+SF = 0.01
+
+
+def _mxu_session(r):
+    r.execute("SET SESSION mxu_join_density_threshold = 0")
+    r.execute("SET SESSION mxu_join_max_slots = 65536")
+    return r
+
+
+@pytest.fixture(scope="module")
+def mxu_runner():
+    return _mxu_session(LocalQueryRunner.tpch("tiny"))
+
+
+@pytest.fixture(scope="module")
+def gather_runner():
+    r = LocalQueryRunner.tpch("tiny")
+    r.execute("SET SESSION mxu_join_enabled = false")
+    return r
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    conn = load_tpch_sqlite(SF)
+    yield conn
+    conn.close()
+
+
+def both_ways(mxu_runner, gather_runner, sql, expect_mxu=True):
+    """Run forced-on and forced-off; the rows must agree. Returns the
+    mxu run's rows + stats."""
+    got = mxu_runner.execute(sql)
+    stats = dict(mxu_runner.last_query_stats)
+    ref = gather_runner.execute(sql)
+    assert sorted(map(str, got.rows)) == sorted(map(str, ref.rows)), sql
+    if expect_mxu:
+        assert stats.get("mxu_joins", 0) > 0, sql
+        assert stats.get("mxu_flops", 0) > 0, sql
+    else:
+        assert stats.get("mxu_joins", 0) == 0, sql
+    return got, stats
+
+
+# ------------------------------------------------------------- parity
+
+
+def test_join_project_unique_build(mxu_runner, gather_runner, oracle):
+    sql = ("SELECT count(*), sum(l_extendedprice) FROM lineitem, part "
+           "WHERE l_partkey = p_partkey AND p_size > 25")
+    got, _ = both_ways(mxu_runner, gather_runner, sql)
+    assert_same(got.rows, oracle.execute(sql).fetchall(), False)
+
+
+def test_join_project_duplicate_build(mxu_runner, gather_runner, oracle):
+    # orders is NOT unique per custkey: the cumsum-expansion kernel
+    # runs with the matmul-provided (count, first-pos) pairs
+    sql = ("SELECT count(*) FROM customer, orders "
+           "WHERE c_custkey = o_custkey AND o_orderstatus = 'F'")
+    got, _ = both_ways(mxu_runner, gather_runner, sql)
+    assert_same(got.rows, oracle.execute(sql).fetchall(), False)
+
+
+def test_semijoin_and_anti(mxu_runner, gather_runner, oracle):
+    for sql in [
+        "SELECT count(*) FROM orders WHERE o_custkey IN "
+        "(SELECT c_custkey FROM customer WHERE c_acctbal > 0)",
+        "SELECT count(*) FROM orders WHERE o_custkey NOT IN "
+        "(SELECT c_custkey FROM customer WHERE c_acctbal > 0)",
+        "SELECT count(*) FROM customer c WHERE EXISTS "
+        "(SELECT 1 FROM orders o WHERE o.o_custkey = c.c_custkey)",
+    ]:
+        got, _ = both_ways(mxu_runner, gather_runner, sql)
+        assert_same(got.rows, oracle.execute(sql).fetchall(), False)
+
+
+def test_distinct_project(mxu_runner, gather_runner, oracle):
+    sql = ("SELECT DISTINCT s_nationkey FROM supplier, nation "
+           "WHERE s_nationkey = n_nationkey")
+    got, _ = both_ways(mxu_runner, gather_runner, sql)
+    assert_same(got.rows, oracle.execute(sql).fetchall(), False)
+
+
+def test_aggregating_join(mxu_runner, gather_runner, oracle):
+    # probe-side group keys + probe/build-side COUNT/SUM: the fused
+    # M = A·Bᵀ path (no cross-product materialization)
+    sql = ("SELECT s_nationkey, count(*), sum(s_acctbal), "
+           "sum(n_regionkey), count(n_comment) "
+           "FROM supplier, nation WHERE s_nationkey = n_nationkey "
+           "GROUP BY s_nationkey ORDER BY s_nationkey")
+    got, _ = both_ways(mxu_runner, gather_runner, sql)
+    assert_same(got.rows, oracle.execute(sql).fetchall(), ordered=True)
+
+
+def test_aggregating_join_many_to_many():
+    # both sides duplicate keys: the shape whose gather-path cross
+    # product the matmul path never materializes. One runner, toggled
+    # per run (the memory tables live in the runner's catalog).
+    r = _mxu_session(LocalQueryRunner.tpch("tiny"))
+    r.execute(
+        "CREATE TABLE memory.default.mm_probe AS SELECT "
+        "l_orderkey % 256 AS k, l_suppkey % 16 AS g, l_quantity AS v "
+        "FROM lineitem")
+    r.execute(
+        "CREATE TABLE memory.default.mm_build AS SELECT "
+        "o_orderkey % 256 AS k, o_totalprice AS w FROM orders")
+    sql = ("SELECT g, count(*), sum(v), sum(w) FROM "
+           "memory.default.mm_probe p, memory.default.mm_build b "
+           "WHERE p.k = b.k GROUP BY g ORDER BY g")
+    got = r.execute(sql)
+    assert r.last_query_stats.get("mxu_joins", 0) > 0
+    r.execute("SET SESSION mxu_join_enabled = false")
+    ref = r.execute(sql)
+    assert got.rows == ref.rows
+
+
+def test_aggregating_join_build_sum_null_groups():
+    # a key whose EVERY build value is NULL: SUM(w) must be NULL for
+    # groups that only joined such keys (the #valid-w helper mask),
+    # while COUNT(w) reads 0 there
+    r = _mxu_session(LocalQueryRunner.tpch("tiny"))
+    r.execute(
+        "CREATE TABLE memory.default.nb AS SELECT "
+        "o_orderkey % 8 AS k, CASE WHEN o_orderkey % 8 = 3 THEN NULL "
+        "ELSE o_custkey END AS w FROM orders")
+    # a precomputed probe so the group key is a plain probe column
+    # (computed group keys sit in a Project the fused path declines)
+    r.execute(
+        "CREATE TABLE memory.default.np AS SELECT "
+        "s_suppkey % 8 AS k, s_suppkey % 4 AS g FROM supplier")
+    sql = ("SELECT g, count(*), sum(w), count(w) FROM "
+           "memory.default.np p, memory.default.nb b "
+           "WHERE p.k = b.k GROUP BY g ORDER BY g")
+    got = r.execute(sql)
+    assert r.last_query_stats.get("mxu_joins", 0) > 0
+    # nulls excluded from count(w): the k=3 build rows are all NULL
+    assert any(row[3] < row[1] for row in got.rows)
+    r.execute("SET SESSION mxu_join_enabled = false")
+    ref = r.execute(sql)
+    assert got.rows == ref.rows
+
+
+def test_aggregating_join_int_sum_magnitude_guard():
+    # per-key integer sums at/past 2^53 are beyond f64's exact range:
+    # scatter_agg_table's mag_ok must decline the fused path so the
+    # gather join's exact int64 arithmetic answers
+    r = _mxu_session(LocalQueryRunner.tpch("tiny"))
+    r.execute(
+        "CREATE TABLE memory.default.huge AS SELECT s_suppkey % 4 AS k, "
+        "9007199254740993 + s_suppkey AS w FROM supplier")
+    r.execute(
+        "CREATE TABLE memory.default.hp AS SELECT s_suppkey % 4 AS k, "
+        "s_suppkey % 2 AS g FROM supplier")
+    sql = ("SELECT g, sum(w) FROM memory.default.hp p, "
+           "memory.default.huge b WHERE p.k = b.k GROUP BY g ORDER BY g")
+    got = r.execute(sql)
+    r.execute("SET SESSION mxu_join_enabled = false")
+    ref = r.execute(sql)
+    assert got.rows == ref.rows
+
+
+def test_sparse_build_declines(mxu_runner, gather_runner):
+    # density below the threshold: the router must keep the gather path
+    r = LocalQueryRunner.tpch("tiny")   # default threshold 0.05
+    sql = ("SELECT count(*) FROM lineitem, part "
+           "WHERE l_partkey = p_partkey AND p_partkey % 64 = 0")
+    got = r.execute(sql)
+    assert r.last_query_stats.get("mxu_joins", 0) == 0
+    ref = gather_runner.execute(sql)
+    assert got.rows == ref.rows
+
+
+# ----------------------------------------------- EXPLAIN + counters
+
+
+def test_explain_strategy_line(mxu_runner, gather_runner):
+    sql = ("SELECT count(*) FROM lineitem, part "
+           "WHERE l_partkey = p_partkey")
+    on = mxu_runner.execute("EXPLAIN " + sql).rows[0][0]
+    assert "join strategy: mxu-matmul" in on
+    off = gather_runner.execute("EXPLAIN " + sql).rows[0][0]
+    assert "join strategy: gather" in off
+    assert "join strategy: mxu-matmul" not in off
+
+
+def test_counters_in_snapshot_and_footer(mxu_runner):
+    sql = ("SELECT s_nationkey, count(*) FROM supplier, nation "
+           "WHERE s_nationkey = n_nationkey GROUP BY s_nationkey")
+    mxu_runner.execute(sql)
+    st = mxu_runner.last_query_stats
+    assert st["mxu_joins"] > 0 and st["mxu_flops"] > 0
+    # the cost-model compile ledger saw the matmul kernels (PR 12's
+    # attribution surface) at least once this process
+    analyzed = mxu_runner.execute("EXPLAIN ANALYZE " + sql).rows[0][0]
+    assert "mxu:" in analyzed and "matmul joins" in analyzed
+
+
+# ------------------------------------------------------------- mesh
+
+
+@pytest.mark.mesh
+def test_mesh_mxu_parity(gather_runner):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the forced 8-device CPU mesh")
+    from trino_tpu.exec.distributed import DistributedQueryRunner
+    r = _mxu_session(DistributedQueryRunner.tpch("tiny"))
+    for sql in [
+        "SELECT n_name, count(*) FROM supplier, nation "
+        "WHERE s_nationkey = n_nationkey GROUP BY n_name ORDER BY 1",
+        "SELECT count(*), sum(l_quantity) FROM lineitem, orders "
+        "WHERE l_orderkey = o_orderkey AND o_orderstatus = 'F'",
+    ]:
+        got = r.execute(sql)
+        st = r.last_query_stats
+        assert st.get("mesh_devices") == 8
+        assert st.get("exchanges_staged") == 0, sql
+        assert st.get("mxu_joins", 0) >= 1, sql
+        ref = gather_runner.execute(sql)
+        assert sorted(map(str, got.rows)) == sorted(map(str, ref.rows))
+
+
+# ------------------------------------------------------------ chaos
+
+
+def test_chaos_task_with_mxu_pinned(oracle):
+    r = _mxu_session(LocalQueryRunner.tpch("tiny"))
+    r.session.set("retry_policy", "TASK")
+    r.session.set("fault_injection_rate", 0.2)
+    r.session.set("fault_injection_seed", 42)
+    sql = ("SELECT s_nationkey, count(*), sum(s_acctbal) "
+           "FROM supplier, nation WHERE s_nationkey = n_nationkey "
+           "GROUP BY s_nationkey ORDER BY s_nationkey")
+    got = r.execute(sql)
+    assert_same(got.rows, oracle.execute(sql).fetchall(), ordered=True)
+
+
+# -------------------------------------------- spilled-build staging
+
+
+def test_spilled_build_chunked_staging(gather_runner, monkeypatch):
+    """PR 10 leftover fix: the keys-on-device spill path stages build
+    payload columns chunk-wise (many small transfers, one bounded
+    device transient) instead of materializing the whole build again."""
+    from trino_tpu.exec.local_planner import LocalExecutionPlanner
+    monkeypatch.setattr(LocalExecutionPlanner,
+                        "_SPILL_STAGE_CHUNK_BYTES", 1 << 12)
+    r = LocalQueryRunner.tpch("tiny")
+    r.execute("SET SESSION mxu_join_enabled = false")
+    r.execute("SET SESSION join_spill_threshold_bytes = 4096")
+    sql = ("SELECT count(*), sum(o_totalprice) FROM lineitem, orders "
+           "WHERE l_orderkey = o_orderkey")
+    got = r.execute(sql)
+    assert r.last_query_stats.get("spilled_bytes", 0) > 0
+    ref = gather_runner.execute(sql)
+    assert got.rows == ref.rows
+
+
+# -------------------------------- dispatch-loop cache promotion
+
+
+def test_dispatch_loop_table_cache_promotes():
+    """PR 11 leftover fix: the per-shard dispatch loop now records scan
+    frequency and promotes into the device table cache — the second
+    dispatch-loop scan serves from HBM with zero host->device bytes."""
+    from trino_tpu.exec.distributed import DistributedQueryRunner
+    r = DistributedQueryRunner.tpch("tiny")
+    r.execute("SET SESSION mesh_execution = false")
+    r.execute("SET SESSION table_cache_enabled = true")
+    r.execute("SET SESSION table_cache_min_scans = 1")
+    sql = "SELECT count(*), sum(s_acctbal) FROM supplier"
+    first = r.execute(sql)
+    assert r.last_query_stats.get("scan_staging_bytes", 0) > 0
+    second = r.execute(sql)
+    st = r.last_query_stats
+    assert st.get("table_cache_hits", 0) > 0
+    assert st.get("scan_staging_bytes") == 0
+    assert first.rows == second.rows
+
+
+# ------------------------------------------------- q64/q72 shapes
+
+
+@pytest.fixture(scope="module")
+def tpcds_oracle():
+    from oracle import load_tpcds_sqlite
+    conn = load_tpcds_sqlite(SF)
+    yield conn
+    conn.close()
+
+
+def test_q72_with_router_enabled(tpcds_oracle):
+    r = _mxu_session(LocalQueryRunner.tpch("tiny"))
+    r.execute("USE tpcds.tiny")
+    engine = """
+SELECT i_item_desc, w_warehouse_name, d1.d_week_seq, count(*) total_cnt
+FROM catalog_sales
+JOIN inventory ON (cs_item_sk = inv_item_sk)
+JOIN warehouse ON (w_warehouse_sk = inv_warehouse_sk)
+JOIN item ON (i_item_sk = cs_item_sk)
+JOIN date_dim d1 ON (cs_sold_date_sk = d1.d_date_sk)
+JOIN date_dim d2 ON (inv_date_sk = d2.d_date_sk)
+WHERE d1.d_week_seq = d2.d_week_seq
+  AND inv_quantity_on_hand < cs_quantity AND d1.d_year = 1999
+GROUP BY i_item_desc, w_warehouse_name, d1.d_week_seq
+ORDER BY total_cnt DESC, i_item_desc, w_warehouse_name, d1.d_week_seq
+LIMIT 100"""
+    got = r.execute(engine)
+    assert r.last_query_stats.get("mxu_joins", 0) > 0
+    assert_same(got.rows, tpcds_oracle.execute(engine).fetchall(),
+                ordered=True)
+
+
+def test_q64_core_with_router_enabled(tpcds_oracle):
+    r = _mxu_session(LocalQueryRunner.tpch("tiny"))
+    r.execute("USE tpcds.tiny")
+    engine = """
+SELECT i_product_name, d1.d_year, count(*) AS cnt,
+       sum(ss_wholesale_cost) AS s1
+FROM store_sales, store_returns, date_dim d1, item
+WHERE ss_sold_date_sk = d1.d_date_sk
+  AND ss_item_sk = i_item_sk
+  AND ss_item_sk = sr_item_sk
+  AND ss_ticket_number = sr_ticket_number
+  AND i_current_price BETWEEN 35 AND 45
+GROUP BY i_product_name, d1.d_year
+ORDER BY i_product_name, d1.d_year, cnt LIMIT 100"""
+    oracle_sql = engine.replace("BETWEEN 35 AND 45",
+                                "BETWEEN 3500 AND 4500")
+    got = r.execute(engine)
+    assert r.last_query_stats.get("mxu_joins", 0) > 0
+    assert_same(got.rows, tpcds_oracle.execute(oracle_sql).fetchall(),
+                ordered=True)

@@ -136,9 +136,33 @@ class ShardExecutionPlanner(LocalExecutionPlanner):
             handle, target_splits=self.n_shards)
         mine = [s for s in splits if s.part % self.n_shards == self.shard]
         cap = self._split_capacity(conn, node, splits)
+        # dispatch-loop promotion (round 15, the PR 11 leftover): the
+        # dispatch loop used to SERVE table-cache hits but never feed
+        # the tier — scan frequency now counts here too (shard 0, once
+        # per fragment attempt), and when the working set clears
+        # admission the attempt's shard executors pool their staged
+        # pages in the shared memo; the LAST shard to finish promotes
+        # the full row set, so repeated dispatch-loop scans reach zero
+        # host->device staging just like the local loop and mesh paths.
+        stage_key = None
+        if tcache is not None and self.table_cache_memo is not None \
+                and not _dyn and node.table.limit is None \
+                and (not getattr(conn.metadata, "supports_zone_maps",
+                                 False)
+                     or handle.constraint.is_all()):
+            dkey = ("promote", tkey, tuple(names))
+            if self.shard == 0 and dkey not in self.table_cache_memo:
+                count = tcache.note_scan(tkey, names)
+                ok = count >= max(int(self.table_cache_min_scans), 1) \
+                    and tcache.should_promote(tkey, names)
+                self.table_cache_memo[dkey] = (ok, tcache.generation())
+            decision = self.table_cache_memo.get(dkey)
+            if decision is not None and decision[0]:
+                stage_key = ("stage", tkey, tuple(names))
 
         def gen():
             from trino_tpu.exec.memory import page_bytes
+            staged = [] if stage_key is not None else None
             try:
                 for split in mine:
                     self._fault_site("scan",
@@ -150,6 +174,8 @@ class ShardExecutionPlanner(LocalExecutionPlanner):
                             col.add_scan_staging(page_bytes(page))
                         if self.device is not None:
                             page = jax.device_put(page, self.device)
+                        if staged is not None:
+                            staged.append(page)
                         yield page
             finally:
                 # shard executors dispatch sequentially on one thread;
@@ -161,7 +187,39 @@ class ShardExecutionPlanner(LocalExecutionPlanner):
                     take = getattr(conn, "take_scan_stats", None)
                     if take is not None:
                         take()
+            if staged is not None:
+                self._stage_for_promotion(stage_key, staged, node)
         return PageStream(self._sliced(gen()), symbols)
+
+    def _stage_for_promotion(self, stage_key, staged, node) -> None:
+        """Pool this shard's fully-scanned pages in the fragment
+        attempt's shared memo; the shard that completes the set
+        promotes the whole table into the device cache (partial
+        consumption — a LIMIT upstream — simply never completes the
+        set, which is the conservative outcome)."""
+        memo = self.table_cache_memo
+        entry = memo.setdefault(("pages",) + stage_key[1:], {})
+        entry[self.shard] = staged
+        if len(entry) < self.n_shards:
+            return
+        _, tkey, _names = stage_key
+        decision = memo.get(("promote",) + stage_key[1:])
+        pages = [p for s in range(self.n_shards) for p in entry[s]]
+        if not pages:
+            return
+        # resident columns live on the default device (the table
+        # cache's placement on the CPU mesh); colocate before the
+        # promotion's device concat
+        dev = jax.devices()[0]
+        pages = [jax.device_put(p, dev) for p in pages]
+        counts = [int(c) for c in jax.device_get(
+            [p.num_rows for p in pages])]
+        # the promoting shard is the LAST one drained (never shard 0);
+        # the collector is shared across the attempt's shard executors
+        self.table_cache.promote_from_pages(
+            tkey, [(c.name, c) for _, c in node.assignments], pages,
+            counts, collector=self.collector,
+            gen=None if decision is None else decision[1])
 
     def _split_capacity(self, conn, node: TableScanNode, splits) -> int:
         cap = split_scan_capacity(self.session, conn, node, splits)

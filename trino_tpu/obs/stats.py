@@ -174,6 +174,15 @@ class QueryStatsCollector:
         self.join_recursions = 0
         self.heavy_key_splits = 0
         self.spill_fallbacks = 0
+        # MXU join path (ops/join_mxu.py + the exec/local_planner
+        # router): joins this query actually ran as density-partitioned
+        # indicator matmuls on the matrix unit, and the summed
+        # cost-model MACs those dispatches issued (2 flops per
+        # multiply-accumulate — the same convention as the XLA
+        # cost-model estimated_flops above, which additionally counts
+        # the matmul flops of every mxu kernel at its compile)
+        self.mxu_joins = 0
+        self.mxu_flops = 0
 
     # ----------------------------------------------------------- spans
 
@@ -287,6 +296,14 @@ class QueryStatsCollector:
         self.streamed_chunks += int(chunks)
         self.streamed_rows += int(rows)
 
+    def mxu_join(self, n: int = 1) -> None:
+        """One join routed onto the matrix-unit matmul path."""
+        self.mxu_joins += int(n)
+
+    def add_mxu_flops(self, flops: int) -> None:
+        """One mxu probe dispatch's cost-model MAC count."""
+        self.mxu_flops += int(flops)
+
     def add_exchange(self, mode: str, rows: int = 0, nbytes: int = 0
                      ) -> None:
         """One inter-fragment exchange applied; mode 'fused' (collective
@@ -388,6 +405,8 @@ class QueryStatsCollector:
             "join_recursions": self.join_recursions,
             "heavy_key_splits": self.heavy_key_splits,
             "spill_fallbacks": self.spill_fallbacks,
+            "mxu_joins": self.mxu_joins,
+            "mxu_flops": self.mxu_flops,
         }
         if self.operators:
             snap["operators"] = self.operator_rows()
@@ -489,6 +508,9 @@ def render_analyzed_plan(plan, collector: QueryStatsCollector,
              f"{collector.plan_cache_misses} misses")
     if collector.spilled_bytes:
         text += f", spilled {_fmt_bytes(collector.spilled_bytes)}"
+    if collector.mxu_joins:
+        text += (f"\nmxu: {collector.mxu_joins} matmul joins, "
+                 f"{collector.mxu_flops:.3g} probe flops")
     if (collector.agg_mode_downgrades or collector.agg_mode_upgrades
             or collector.agg_recursions or collector.join_recursions
             or collector.heavy_key_splits or collector.spill_fallbacks):
