@@ -740,6 +740,33 @@ def run_qps(out_path=None, workers=None) -> None:
             f.write(line + "\n")
 
 
+def run_chaos_fleet(out_path=None) -> None:
+    """`bench.py --chaos-fleet [OUT.json]`: the process-level fault
+    matrix (trino_tpu/fleet/bench_fleet.py run_chaos_fleet). One phase
+    per process class against a live fleet: kill -9 the ENGINE under
+    load (shared-tier hits must stay fully available, misses classify
+    as retryable ENGINE_UNAVAILABLE, the supervisor restores an active
+    generation), kill -9 a WORKER (siblings hold the shared port, the
+    headcount respawns), then a PLANNED `engine_restart()` under a
+    closed loop of cache misses (the SCM_RIGHTS listener handoff must
+    land errors == 0). The final JSON line ALWAYS prints; `chaos_clean`
+    is the single acceptance bit."""
+    platform = _ensure_backend()
+    payload = {"metric": "chaos_fleet", "backend": platform}
+    try:
+        from trino_tpu.fleet.bench_fleet import run_chaos_fleet as _run
+        payload.update(_run())
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the line must print
+        payload["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+    line = json.dumps(payload)
+    print(line, flush=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+
+
 def run_preempt(out_path=None) -> None:
     """`bench.py --preempt [OUT.json]`: the DELETE->executor-freed
     smoke. Starts a long SF1 lineitem scan on a worker thread, cancels
@@ -1373,6 +1400,8 @@ if __name__ == "__main__":
             _qps_args = _qps_args[:_i] + _qps_args[_i + 2:]
         run_qps(_qps_args[0] if _qps_args else None,
                 workers=_qps_workers)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--chaos-fleet":
+        run_chaos_fleet(sys.argv[2] if len(sys.argv) >= 3 else None)
     elif len(sys.argv) >= 2 and sys.argv[1] == "--preempt":
         run_preempt(sys.argv[2] if len(sys.argv) >= 3 else None)
     elif len(sys.argv) >= 2 and sys.argv[1] == "--memory-ladder":

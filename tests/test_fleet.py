@@ -386,9 +386,14 @@ def test_fleet_aggregated_metrics_and_queries(fleet):
         "WHERE query LIKE 'EXECUTE fleet_probe%'")
     assert rows[0][0] >= 1
     # group accounting aggregated on the engine: served_from_cache sees
-    # worker-landed hits (exact counts ride the bus batches)
-    g = fleet.engine.groups.get_or_create("global")
-    assert g.served_from_cache >= 1
+    # worker-landed hits (exact counts ride the bus batches; queried
+    # over SQL because the engine is a subprocess now — no in-process
+    # groups object to reach into)
+    _, rows, _ = _http(
+        fleet.base_uri,
+        "SELECT served_from_cache FROM "
+        "system.runtime.resource_groups WHERE name = 'global'")
+    assert rows and rows[0][0] >= 1
 
 
 def test_fleet_rolling_restart_zero_drop(fleet):

@@ -75,3 +75,29 @@ def test_global_agg_unaffected_by_spill_threshold(r):
     r.execute("SET SESSION agg_spill_threshold_bytes = 65536")
     assert _rows(r, sql) == baseline
     assert baseline[0][0] > 50000
+
+
+def test_string_key_join_overflow_matches_memory(r):
+    """A STRING-keyed INNER build that overflows mid-collect hands off
+    to the streaming partitioned join through the union-pool restage
+    (_restage_string_build) — the gap the streaming handoff carried
+    since it landed. The build table is written in TWO inserts with
+    disjoint value sets, so its pages carry DISTINCT dictionary pools:
+    the co-partition hash only works because the restage rebased every
+    piece onto the union pool and the probe re-encoded against it."""
+    r.execute("CREATE TABLE memory.default.skj (k varchar, v bigint)")
+    r.execute("INSERT INTO memory.default.skj "
+              "SELECT o_clerk, o_orderkey FROM orders "
+              "WHERE o_orderkey % 2 = 0")
+    r.execute("INSERT INTO memory.default.skj "
+              "SELECT o_comment, o_orderkey FROM orders "
+              "WHERE o_orderkey % 2 = 1")
+    sql = ("SELECT count(*), sum(s.v) FROM orders o "
+           "JOIN memory.default.skj s ON o.o_clerk = s.k "
+           "WHERE o.o_orderkey < 4000")
+    baseline = _rows(r, sql)
+    assert baseline[0][0] > 1000
+    r.session.set("query_max_memory", 65536)
+    r.session.set("retry_policy", "TASK")
+    assert _rows(r, sql) == baseline
+    assert r.last_query_stats["spilled_bytes"] > 0

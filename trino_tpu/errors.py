@@ -57,6 +57,10 @@ NO_NODES_AVAILABLE = ErrorCode("NO_NODES_AVAILABLE", 65541, INTERNAL_ERROR,
 REMOTE_TASK_ERROR = ErrorCode("REMOTE_TASK_ERROR", 65542, INTERNAL_ERROR,
                               retryable=True)
 COMPILER_ERROR = ErrorCode("COMPILER_ERROR", 65543, INTERNAL_ERROR)
+# the fleet's engine process is down (crashed or restarting): retryable —
+# the supervisor respawns it, so a client retry lands on the replacement
+ENGINE_UNAVAILABLE = ErrorCode("ENGINE_UNAVAILABLE", 65544, INTERNAL_ERROR,
+                               retryable=True)
 
 # --------------------------------------------- INSUFFICIENT_RESOURCES (0x20000)
 GENERIC_INSUFFICIENT_RESOURCES = ErrorCode(

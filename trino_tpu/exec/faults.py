@@ -31,7 +31,8 @@ from typing import Dict, Optional, Tuple
 
 from trino_tpu.errors import CLUSTER_OUT_OF_MEMORY, InjectedFault
 
-SITES = ("fragment", "exchange", "scan", "spill", "memory", "slice")
+SITES = ("fragment", "exchange", "scan", "spill", "memory", "slice",
+         "engine")
 
 
 class InjectedMemoryPressure(InjectedFault):
@@ -127,6 +128,20 @@ class FaultInjector:
         self.by_site[site] = self.by_site.get(site, 0) + 1
         self.by_detail[(site, detail)] = \
             self.by_detail.get((site, detail), 0) + 1
+        if site == "engine":
+            # PROCESS-level chaos: when this runner lives inside a fleet
+            # engine child, the fault is the process dying mid-dispatch
+            # (SIGKILL by default; TRINO_TPU_FAULT_ENGINE_SIGNAL
+            # overrides, e.g. SIGSTOP to model a stall the supervisor's
+            # liveness probe must catch). Outside a fleet child the site
+            # falls through to a plain InjectedFault — single-process
+            # chaos must not kill the test runner.
+            import os
+            if os.environ.get("TRINO_TPU_ENGINE_CHILD"):
+                import signal as _signal
+                signum = int(os.environ.get(
+                    "TRINO_TPU_FAULT_ENGINE_SIGNAL", _signal.SIGKILL))
+                os.kill(os.getpid(), signum)
         exc = InjectedMemoryPressure if site == "memory" else InjectedFault
         raise exc(
             f"injected fault at {site}"

@@ -1506,8 +1506,7 @@ class LocalExecutionPlanner:
         build_stream = self.execute(join.right)
         build_iter = None
         if bool(self.session.get("spill_enabled")) \
-                and int(self.session.get("spill_partition_count")) > 1 \
-                and not T.is_string(build_stream.symbols[bkey_ch].type):
+                and int(self.session.get("spill_partition_count")) > 1:
             build_page, build_iter = \
                 self._collect_build_resilient(build_stream)
         else:
@@ -1841,23 +1840,21 @@ class LocalExecutionPlanner:
             return self._exec_full_join(node)
         probe_stream = self.execute(node.left)
         build_stream = self.execute(node.right)
-        build_lay0, _ = _layout(build_stream.symbols)
-        build_keys0 = [build_lay0[c.right.name] for c in node.criteria]
         # adaptive build collection (HashBuilderOperator's revoke-during-
-        # build, re-thought): an INNER spillable build with non-string
-        # keys collects with INCREMENTAL reservation — memory pressure
-        # mid-collect switches to the streaming partitioned hybrid join
-        # (build pages partition to host one at a time, never
-        # materialized whole), so an underestimated build is a strategy
-        # switch, not an OOM cliff. String keys keep the classic collect:
-        # co-partition hashing compares dictionary CODES, which only
-        # align after the full build pool is known.
+        # build, re-thought): an INNER spillable build collects with
+        # INCREMENTAL reservation — memory pressure mid-collect switches
+        # to the streaming partitioned hybrid join (build pages partition
+        # to host one at a time, never materialized whole), so an
+        # underestimated build is a strategy switch, not an OOM cliff.
+        # String keys ride the same handoff: the overflow path stages the
+        # build host-side and rebases every page onto ONE union pool
+        # before co-partitioning (_restage_string_build) — co-partition
+        # hashing compares dictionary CODES, which only align under a
+        # shared pool.
         build_iter = None
         if node.kind == JoinKind.INNER \
                 and bool(self.session.get("spill_enabled")) \
-                and int(self.session.get("spill_partition_count")) > 1 \
-                and not any(T.is_string(build_stream.symbols[bk].type)
-                            for bk in build_keys0):
+                and int(self.session.get("spill_partition_count")) > 1:
             build_page, build_iter = \
                 self._collect_build_resilient(build_stream)
         else:
@@ -1957,12 +1954,42 @@ class LocalExecutionPlanner:
                 # the build overflowed its reservation mid-collect: the
                 # streaming partitioned hybrid consumes the remaining
                 # pages without ever materializing the whole side
+                node_id = ("join",
+                           tuple(c.left.name for c in node.criteria),
+                           tuple(c.right.name for c in node.criteria))
+                if any(T.is_string(build_symbols[bk].type)
+                       for bk in build_keys):
+                    # string keys: stage the build host-side and rebase
+                    # every page onto ONE union pool first — the
+                    # co-partition hash and the per-partition kernels
+                    # compare dictionary CODES, so both sides must share
+                    # a pool before any partitioning happens. The probe
+                    # then re-encodes onto that union pool exactly like
+                    # the collected path's dictionary alignment (INNER
+                    # only, which the overflow gates guarantee).
+                    stage, pools = self._restage_string_build(
+                        build_iter, build_keys)
+                    if stage is None:
+                        return      # empty build, INNER: no output rows
+                    try:
+                        aligned = self._align_probe_to_pools(
+                            probe_stream,
+                            {pk: pools[bk]
+                             for pk, bk in zip(probe_keys, build_keys)
+                             if bk in pools})
+                        replay = stage.drain_partition_chunks(
+                            0, stage.chunk_rows_for(0, self._spill_budget(
+                                int(self.session.get(
+                                    "join_spill_threshold_bytes")))))
+                        yield from self._run_partitioned_inner(
+                            aligned, replay, probe_keys, build_keys,
+                            join_op, node_id=node_id)
+                    finally:
+                        stage.close()
+                    return
                 yield from self._run_partitioned_inner(
                     probe_stream, build_iter, probe_keys, build_keys,
-                    join_op,
-                    node_id=("join",
-                             tuple(c.left.name for c in node.criteria),
-                             tuple(c.right.name for c in node.criteria)))
+                    join_op, node_id=node_id)
                 return
             collected = build_page   # only the _collect'ed page was reserved
             bp = build_page
@@ -2597,13 +2624,101 @@ class LocalExecutionPlanner:
                                  build_keys) -> PageStream:
         """String join keys across DISTINCT dictionaries: remap probe key
         codes onto the build side's pool (DictionaryBlock re-encode; the
-        kernels compare codes, so both sides must share one pool). Probe
-        values absent from the build pool map to unique sentinels past the
-        pool end — they can never match. Lazy: tables build on the first
-        page per (probe-dict, channel) pair."""
-        pairs = [(pk, bk) for pk, bk in zip(probe_keys, build_keys)
-                 if build_page.columns[bk].dictionary is not None]
-        if not pairs:
+        kernels compare codes, so both sides must share one pool)."""
+        return self._align_probe_to_pools(
+            probe_stream,
+            {pk: build_page.columns[bk].dictionary
+             for pk, bk in zip(probe_keys, build_keys)
+             if build_page.columns[bk].dictionary is not None})
+
+    def _restage_string_build(self, build_source, build_keys):
+        """Overflow handoff for STRING-keyed builds (closes the gap the
+        streaming partitioned join carried since it landed): pages of a
+        streaming build may encode the same key column against DISTINCT
+        pools (per-source dictionaries under a union, re-created memory
+        tables), and co-partition hashing compares CODES — so the whole
+        build stages host-side FIRST (single-partition store: one device
+        compaction per page, the side is never resident whole), then
+        every dictionary column whose pieces span more than one pool is
+        rebased onto the union pool with a host-side int32 code remap
+        (DictionaryBlock 'compact to shared pool', applied at rest).
+
+        Returns (stage, {build_channel: dictionary}) — the caller drains
+        partition 0 as the replay build source, aligns the probe to the
+        returned pools BEFORE co-partitioning, and owns stage.close().
+        (None, {}) = empty build."""
+        from trino_tpu.exec.spill import partition_by_hash
+        from trino_tpu.page import union_dictionaries
+        bkeys_t = tuple(build_keys)
+        compact = cached_kernel(
+            ("join-spill-part", bkeys_t, 1, 0),
+            lambda: partition_by_hash(bkeys_t, 1, salt=0))
+        stage = self._new_spill_store(1)
+        try:
+            piece_dicts: List[list] = []
+            for page in build_source:
+                self._checkpoint()
+                self._fault_site("spill", "join-string-stage")
+                sorted_pg, counts = compact(page)
+                before = len(stage.pieces[0])
+                stage.spill_partitioned(sorted_pg,
+                                        jax.device_get(counts))
+                if len(stage.pieces[0]) > before:
+                    # dictionaries per APPENDED piece (all-pad pages
+                    # append nothing) — stage.meta only remembers the
+                    # first page's pools
+                    piece_dicts.append(
+                        [c.dictionary for c in page.columns])
+            self._record_spill(stage.bytes)
+            if stage.meta is None:
+                stage.close()
+                return None, {}
+            for ci in range(len(stage.meta)):
+                dicts = [pd[ci] for pd in piece_dicts]
+                if dicts[0] is None:
+                    continue
+                uniq: List = []
+                for d in dicts:
+                    if not any(d is u or d.fingerprint == u.fingerprint
+                               for u in uniq):
+                        uniq.append(d)
+                final = uniq[0]
+                if len(uniq) > 1:
+                    self._adaptive_span("join-string-pool-union",
+                                        channel=ci, pools=len(uniq))
+                    union, remaps = union_dictionaries(uniq)
+                    by_fp = {u.fingerprint: np.asarray(r)
+                             for u, r in zip(uniq, remaps)}
+                    for piece, d in zip(stage.pieces[0], dicts):
+                        tbl = by_fp[d.fingerprint]
+                        vals = piece[ci][0]
+                        # padding/null codes (< 0) pass through; live
+                        # codes remap. int32 -> int32: the store's byte
+                        # accounting is unchanged by the rewrite.
+                        piece[ci] = (np.where(
+                            vals >= 0,
+                            tbl[np.clip(vals, 0, len(tbl) - 1)],
+                            vals).astype(vals.dtype), piece[ci][1])
+                    final = union
+                typ, _ = stage.meta[ci]
+                stage.meta[ci] = (typ, final)
+            pools = {bk: stage.meta[bk][1] for bk in bkeys_t
+                     if stage.meta[bk][1] is not None}
+            return stage, pools
+        except BaseException:
+            stage.close()
+            raise
+
+    def _align_probe_to_pools(self, probe_stream: PageStream, pools
+                              ) -> PageStream:
+        """Re-encode probe key channels onto given build-side pools
+        (`pools`: {probe_channel: build Dictionary}). Probe values absent
+        from the build pool map to unique sentinels past the pool end —
+        they can never match (INNER-only discipline; LEFT keeps the
+        fail-loud kernels). Lazy: tables build on the first page per
+        (probe-dict, channel) pair."""
+        pools = {pk: bd for pk, bd in pools.items() if bd is not None}
+        if not pools:
             return probe_stream
         maps: Dict[tuple, jnp.ndarray] = {}
 
@@ -2611,12 +2726,11 @@ class LocalExecutionPlanner:
             for page in probe_stream.iter_pages():
                 cols = list(page.columns)
                 changed = False
-                for pk, bk in pairs:
+                for pk, bd in pools.items():
                     pc = cols[pk]
-                    bd = build_page.columns[bk].dictionary
                     if pc.dictionary is None or pc.dictionary is bd:
                         continue
-                    key = (id(pc.dictionary), bk)
+                    key = (id(pc.dictionary), pk)
                     tbl = maps.get(key)
                     if tbl is None:
                         pvals = pc.dictionary.values

@@ -309,7 +309,15 @@ class LocalQueryRunner:
                 self._slices = SliceScheduler.from_session(self.session)
                 self._ckpts = CheckpointStore(info.query_id) \
                     if policy == "TASK" else None
-                self._write_token = info.query_id
+                # idempotent-write identity: defaults to the query id
+                # (each execution is its own write), but a client that
+                # RETRIES a failed INSERT/CTAS — e.g. after a fleet
+                # ENGINE_UNAVAILABLE answer — sends the same
+                # `write_token` on both attempts, and the sink's
+                # committed-token ledger makes the replay exactly-once
+                self._write_token = \
+                    str(self.session.get("write_token") or "") \
+                    or info.query_id
                 self._created_tables = set()
                 # fresh per query, shared across its retry attempts:
                 # the degrade re-run must START where the failed
@@ -557,6 +565,13 @@ class LocalQueryRunner:
             if self._faults is not None:
                 self._faults.begin_task((label, attempt))
             try:
+                if self._faults is not None:
+                    # the process-level site: inside a fleet engine
+                    # child this kills the engine mid-dispatch
+                    # (exec/faults.py), proving the supervisor + worker
+                    # degraded-mode story; elsewhere it is an ordinary
+                    # retryable InjectedFault
+                    self._faults.site("engine", "dispatch")
                 if spill_forced:
                     with degrade_to_spill(self.session):
                         return fn()

@@ -219,3 +219,191 @@ def _restart_pass(fleet, host: str, port: int, duration_s: float,
     result["all_workers_replaced"] = not set(before) & set(after)
     result["zero_dropped"] = rung["errors"] == 0
     return result
+
+
+# ---------------------------------------------------------------- chaos
+
+
+def _chaos_query(host: str, port: int, sql: str,
+                 headers: Optional[Dict[str, str]] = None
+                 ) -> Dict[str, Any]:
+    """One statement through the fleet port on a FRESH connection,
+    nextUri followed to the terminal payload. Returns
+    {ok, error_name, worker_served, wall_s}; never raises — transport
+    failures are what the chaos phases are here to count."""
+    import http.client
+    hdrs = {"X-Trino-User": "chaos"}
+    hdrs.update(headers or {})
+    t0 = time.monotonic()
+    conn = http.client.HTTPConnection(host, port, timeout=20)
+    try:
+        conn.request("POST", "/v1/statement", body=sql, headers=hdrs)
+        payload = json.loads(conn.getresponse().read())
+        while "nextUri" in payload:
+            conn.request("GET",
+                         payload["nextUri"].split(f":{port}", 1)[1])
+            payload = json.loads(conn.getresponse().read())
+    except (OSError, ValueError):
+        return {"ok": False, "error_name": "TRANSPORT",
+                "worker_served": False,
+                "wall_s": time.monotonic() - t0}
+    finally:
+        conn.close()
+    err = payload.get("error") or {}
+    return {"ok": payload.get("stats", {}).get("state") == "FINISHED"
+            and not err,
+            "error_name": err.get("errorName"),
+            "worker_served": "_fleet_" in str(payload.get("id", "")),
+            "wall_s": time.monotonic() - t0}
+
+
+def run_chaos_fleet(workers: int = 2,
+                    planned_duration_s: float = 14.0,
+                    outage_budget_s: float = 90.0) -> Dict[str, Any]:
+    """`bench.py --chaos-fleet` drives this: the process-level fault
+    matrix against a LIVE fleet, one phase per process class.
+
+    - ENGINE CRASH: kill -9 the engine generation mid-serving; a
+      closed loop of shared-tier HITS must stay fully available
+      (`hit_availability_during_outage`), misses must surface only the
+      classified retryable ENGINE_UNAVAILABLE taxonomy (never a raw
+      transport error), and the supervisor must restore an active
+      rehydrated generation within `recovery_s`.
+    - WORKER CRASH: kill -9 a worker; siblings keep the shared port
+      serving (SO_REUSEPORT) and the supervisor respawns the headcount.
+    - PLANNED RESTART: `engine_restart()` under a subprocess closed
+      loop of cache MISSES — the SCM_RIGHTS listener handoff plus the
+      workers' drain-retry must land `errors == 0` (zero-drop proof).
+    """
+    import signal as _signal
+    from trino_tpu.fleet.registry import read_engine_record
+    from trino_tpu.fleet.server import FleetServer
+    from trino_tpu.fleet.supervisor import read_supervisor_record
+    host = "127.0.0.1"
+    fleet = FleetServer(workers=workers, host=host,
+                        warmup_manifest=WARMUP_MANIFEST,
+                        probe_interval_s=0.2, probe_timeout_s=1.0,
+                        breaker_reset_s=0.5,
+                        forward_backoff_s=0.02).start()
+    report: Dict[str, Any] = {"workers": workers,
+                              "probe": PROBE_NAME}
+    try:
+        port = fleet.port
+        _prime(host, port)
+        hit_sql = f"EXECUTE {PROBE_NAME} USING 7"
+        miss_hdr = {"X-Trino-Session": "result_cache_enabled=false"}
+
+        # ---- phase 1: engine crash under load -----------------------
+        epoch_before = fleet.engine_epoch
+        os.kill(fleet.engine_proc.pid, _signal.SIGKILL)
+        t_kill = time.monotonic()
+        hit_ok = hit_fail = hit_from_worker = 0
+        miss_classified = miss_raw = miss_ok = 0
+        recovery_s = None
+        while time.monotonic() - t_kill < outage_budget_s:
+            res = _chaos_query(host, port, hit_sql)
+            if res["ok"]:
+                hit_ok += 1
+                hit_from_worker += res["worker_served"]
+            else:
+                hit_fail += 1
+            mres = _chaos_query(host, port, hit_sql, headers=miss_hdr)
+            if mres["ok"]:
+                miss_ok += 1
+            elif mres["error_name"] == "ENGINE_UNAVAILABLE":
+                miss_classified += 1
+            else:
+                miss_raw += 1
+            rec = read_engine_record(fleet.fleet_dir) or {}
+            if (rec.get("epoch", 0) >= epoch_before + 1
+                    and rec.get("state") == "active"):
+                recovery_s = round(time.monotonic() - t_kill, 2)
+                break
+            time.sleep(0.05)
+        report["engine_crash"] = {
+            "hit_ok": hit_ok, "hit_fail": hit_fail,
+            "hit_served_by_worker_shm": hit_from_worker,
+            "hit_availability_during_outage": round(
+                hit_ok / max(hit_ok + hit_fail, 1), 4),
+            "miss_classified_unavailable": miss_classified,
+            "miss_raw_errors": miss_raw,
+            "miss_served_by_supervisor_race": miss_ok,
+            "recovery_s": recovery_s,
+            "recovered": recovery_s is not None,
+        }
+        # post-recovery: a miss resolves again (breaker reset by the
+        # engine_epoch bus notice); bounded retry while it propagates
+        deadline = time.monotonic() + 30
+        post = {"ok": False}
+        while time.monotonic() < deadline and not post["ok"]:
+            post = _chaos_query(host, port, hit_sql, headers=miss_hdr)
+            if not post["ok"]:
+                time.sleep(0.2)
+        report["engine_crash"]["miss_resolves_after_recovery"] = \
+            post["ok"]
+
+        # ---- phase 2: worker crash under load -----------------------
+        victims = sorted(r["pid"] for r in fleet.workers())
+        os.kill(victims[0], _signal.SIGKILL)
+        t_kill = time.monotonic()
+        w_ok = w_fail = 0
+        w_recovery = None
+        while time.monotonic() - t_kill < outage_budget_s:
+            res = _chaos_query(host, port, hit_sql)
+            if res["ok"]:
+                w_ok += 1
+            else:
+                w_fail += 1
+            pids = sorted(r["pid"] for r in fleet.workers())
+            if len(pids) >= workers and victims[0] not in pids:
+                w_recovery = round(time.monotonic() - t_kill, 2)
+                break
+            time.sleep(0.05)
+        report["worker_crash"] = {
+            "hit_ok": w_ok, "hit_fail": w_fail,
+            "recovery_s": w_recovery,
+            "recovered": w_recovery is not None,
+        }
+
+        # ---- phase 3: planned engine restart, zero-drop -------------
+        swap: Dict[str, Any] = {}
+
+        def _swap():
+            time.sleep(1.0)     # restart INSIDE the miss window
+            t0 = time.monotonic()
+            swap["epoch"] = fleet.engine_restart()
+            swap["wall_s"] = round(time.monotonic() - t0, 2)
+
+        epoch_before = fleet.engine_epoch
+        th = threading.Thread(target=_swap, daemon=True)
+        th.start()
+        rung = _run_clients(host, port, planned_duration_s, 0.0,
+                            procs=2, threads=2, mode="miss")
+        th.join(timeout=120)
+        report["planned_restart"] = {
+            "completed": rung["completed"], "errors": rung["errors"],
+            "p99_ms": rung["p99_ms"],
+            "swap_wall_s": swap.get("wall_s"),
+            "epoch_advanced":
+                swap.get("epoch", 0) == epoch_before + 1,
+            "zero_dropped": rung["errors"] == 0
+            and rung["completed"] > 0,
+        }
+
+        sup = read_supervisor_record(fleet.fleet_dir) or {}
+        report["supervisor"] = {
+            "engine_restarts": sup.get("engine_restarts"),
+            "worker_restarts": sup.get("worker_restarts"),
+            "outage_seconds": sup.get("outage_seconds"),
+        }
+        report["chaos_clean"] = bool(
+            report["engine_crash"]["hit_availability_during_outage"]
+            == 1.0
+            and report["engine_crash"]["miss_raw_errors"] == 0
+            and report["engine_crash"]["recovered"]
+            and report["engine_crash"]["miss_resolves_after_recovery"]
+            and report["worker_crash"]["recovered"]
+            and report["planned_restart"]["zero_dropped"])
+    finally:
+        fleet.stop()
+    return report

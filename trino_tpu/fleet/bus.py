@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 import os
 import socket
+import sys
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -45,6 +46,12 @@ class FleetBus:
         self._send = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
         self._send.setblocking(False)
         self._on_message = on_message
+        # dropped datagrams by message kind: the bus is best-effort by
+        # design, but SILENT loss hid real problems (a wedged receiver,
+        # oversize hit batches) — count every drop, log once per kind
+        self._drops: Dict[str, int] = {}
+        self._drop_logged: set = set()
+        self._drops_lock = threading.Lock()
         self._closed = threading.Event()
         self._thread: Optional[threading.Thread] = None
         if on_message is not None:
@@ -66,21 +73,25 @@ class FleetBus:
         """Send to every live member socket; returns the delivered
         count. Best-effort: full buffers and vanished members drop the
         datagram (the shm generation check keeps that safe)."""
+        kind = str(message.get("kind", "?"))
         data = json.dumps(message).encode()
         if len(data) > MAX_DGRAM:
+            self._record_drop(kind, "<oversize>")
             return 0
         delivered = 0
         for member in self.members():
             if exclude_self and member == self.name:
                 continue
-            if self._send_one(member, data):
+            if self._send_one(member, data, kind):
                 delivered += 1
         return delivered
 
     def send_to(self, member: str, message: Dict) -> bool:
-        return self._send_one(member, json.dumps(message).encode())
+        return self._send_one(member, json.dumps(message).encode(),
+                              str(message.get("kind", "?")))
 
-    def _send_one(self, member: str, data: bytes) -> bool:
+    def _send_one(self, member: str, data: bytes, kind: str = "?"
+                  ) -> bool:
         path = os.path.join(self.dir, f"{member}.sock")
         try:
             self._send.sendto(data, path)
@@ -88,9 +99,26 @@ class FleetBus:
         except (ConnectionRefusedError, FileNotFoundError):
             if member != self.name:
                 self._reap_stale(path)
+            self._record_drop(kind, member)
             return False
         except (BlockingIOError, OSError):
+            self._record_drop(kind, member)
             return False
+
+    def _record_drop(self, kind: str, member: str) -> None:
+        with self._drops_lock:
+            self._drops[kind] = self._drops.get(kind, 0) + 1
+            first = kind not in self._drop_logged
+            self._drop_logged.add(kind)
+        if first:
+            print(f"fleet-bus[{self.name}]: dropped {kind!r} datagram "
+                  f"to {member} (further {kind!r} drops counted in "
+                  f"trino_tpu_fleet_bus_drops_total, not logged)",
+                  file=sys.stderr)
+
+    def drops_snapshot(self) -> Dict[str, int]:
+        with self._drops_lock:
+            return dict(self._drops)
 
     @staticmethod
     def _reap_stale(path: str) -> None:

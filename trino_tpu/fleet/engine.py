@@ -1,0 +1,387 @@
+"""The fleet's engine process: the one device-owning TrinoServer.
+
+PR 13 made workers disposable; this module makes the ENGINE a
+replaceable subprocess too. `python -m trino_tpu.fleet.engine
+<fleet_dir>` builds the runner, wires the shared cache tier, and serves
+the fleet's dispatch port — and because everything warm it holds is
+REHYDRATABLE, a replacement converges to the dead generation's steady
+state without any client noticing more than a brief miss outage:
+
+- prepared statements reload from the on-disk fleet registry (every
+  PREPARE that ever landed on any worker persisted there), so a
+  headerless EXECUTE resolves against the replacement immediately;
+- the warmup manifest re-primes plan cache, jit cache (persistent-
+  cache-backed), and the device table cache BEFORE the listener serves;
+- the result-cache SHARED TIER is a file-backed mmap owned by the
+  parent — it survives the crash untouched, and its generation
+  discipline (fleet/shm.py seqlocks + table generations) already makes
+  a stale read impossible, so the replacement re-adopts the fleet's
+  warm results through the same MirroredResultSetCache fallback path
+  a cold local miss uses.
+
+Two ways to get the dispatch listener:
+
+- BIND (first start, crash respawn): bind the fleet-configured engine
+  port, with a short EADDRINUSE retry loop for a predecessor whose
+  socket is still being torn down.
+- HANDOFF (planned zero-drop restart, `--handoff PATH`): build the
+  runner FIRST (the expensive part), signal `ready-for-handoff`, then
+  receive the LIVE listening fd from the draining predecessor over
+  SCM_RIGHTS (fleet/handoff.py). Connections that arrive between the
+  old engine's last accept and ours wait in the kernel backlog — the
+  swap drops nothing.
+
+The bus name "engine" is joined LAST (after the server is serving):
+during a handoff it still belongs to the draining predecessor, which
+must keep receiving the workers' hit batches until it exits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from trino_tpu.fleet.bus import FleetBus
+from trino_tpu.fleet.registry import (PreparedRegistry, read_fleet_config,
+                                      write_engine_record)
+from trino_tpu.fleet.shm import SharedCacheTier
+
+ENGINE_READY_TIMEOUT_S = 240.0
+_BIND_RETRIES = 40
+_BIND_RETRY_SLEEP_S = 0.25
+
+
+def ingest_hits(engine_server, message: Dict) -> int:
+    """Fleet-aggregated accounting (shared by the subprocess engine and
+    FleetServer's in-process mode): group counters get EXACT counts
+    (started/finished/served_from_cache move by n, quota already
+    enforced worker-side so enforce=False), the query tracker gets the
+    SAMPLED per-hit records — system.runtime.queries shows fleet
+    traffic with bounded ingest cost. Returns the hits ingested."""
+    from trino_tpu.exec.query_tracker import TRACKER
+    ingested = 0
+    for group, n in (message.get("counts") or {}).items():
+        try:
+            engine_server.groups.record_cache_hit(group, n=int(n),
+                                                  enforce=False)
+            ingested += int(n)
+        except Exception:   # noqa: BLE001
+            continue
+    for group, n in (message.get("rejections") or {}).items():
+        try:
+            engine_server.groups.record_cache_hit_rejection(group,
+                                                            n=int(n))
+        except Exception:   # noqa: BLE001
+            continue
+    for rec in (message.get("records") or []):
+        try:
+            info = TRACKER.begin(rec.get("sql", ""),
+                                 user=rec.get("user", "user"),
+                                 query_id=rec.get("query_id"),
+                                 resource_group=rec.get("group"))
+            TRACKER.running(info)
+            info.cpu_time_ms = 0
+            info.output_bytes = int(rec.get("bytes", 0))
+            info.stats = {"result_cache_hits": 1,
+                          "served_by": rec.get("worker", "")}
+            TRACKER.finish(info, int(rec.get("rows", 0)))
+        except Exception:   # noqa: BLE001
+            continue
+    return ingested
+
+
+def register_prepared(runner, name: str, sql: str) -> None:
+    """Sticky routing leg 2 (shared with FleetServer in-process mode):
+    a statement PREPAREd through any worker lands in the engine's base
+    prepared map too, so an EXECUTE that reaches the engine without
+    headers resolves."""
+    from trino_tpu.sql import parse_statement
+    try:
+        runner._prepared[name] = parse_statement(sql)
+    except Exception:   # noqa: BLE001 — a bad statement stays a
+        pass            # per-request error, not a bus crash
+
+
+class EngineProcess:
+    """One engine generation: runner + TrinoServer + fleet wiring."""
+
+    def __init__(self, fleet_dir: str, epoch: int = 1,
+                 handoff_path: Optional[str] = None,
+                 port: Optional[int] = None):
+        self.fleet_dir = fleet_dir
+        self.config = read_fleet_config(fleet_dir)
+        self.epoch = int(epoch)
+        self.handoff_path = handoff_path
+        self.port = port
+        self.bus: Optional[FleetBus] = None
+        self.server = None
+        self.runner = None
+        self.shared: Optional[SharedCacheTier] = None
+        self.prepared: Optional[PreparedRegistry] = None
+        self.hits_ingested = 0
+        self._stopped = threading.Event()
+        self._stop_once = threading.Lock()
+        self._stop_started = False
+
+    # ------------------------------------------------------------ startup
+
+    def _record(self, state: str, **extra) -> None:
+        rec = {"pid": os.getpid(), "epoch": self.epoch, "state": state}
+        rec.update(extra)
+        write_engine_record(self.fleet_dir, rec)
+
+    def run(self) -> "EngineProcess":
+        self._record("starting")
+        config = self.config
+        from trino_tpu.exec import LocalQueryRunner
+        runner = LocalQueryRunner.tpch(config.get("schema", "tiny"))
+        # a RESPAWNED engine must replicate the dead generation's keying
+        # context exactly: current_date pins from the fleet config so a
+        # fleet that crossed midnight doesn't fork its statement keys
+        if config.get("start_date") is not None:
+            runner.session.start_date = int(config["start_date"])
+        # serving-tier session properties, set BEFORE warmup so the
+        # pre-server priming below plans against the same property bag
+        # TrinoServer will serve with (it re-sets them, idempotently)
+        for prop in ("result_cache_enabled", "scan_cache_enabled",
+                     "table_cache_enabled"):
+            runner.session.set(prop, True)
+        self.runner = runner
+        # the shared tier survives engine death (it's a file owned by
+        # the parent): attach, don't create — generation counters and
+        # live entries carry over, and the MirroredResultSetCache
+        # re-adopts warm fleet results on local misses
+        self.shared = SharedCacheTier(config["shm_path"])
+        from trino_tpu.fleet.server import (MirroredResultSetCache,
+                                            _QuotaGate)
+        mirrored = MirroredResultSetCache(self.shared)
+        runner._result_cache = mirrored
+        runner._plan_cache.add_invalidation_hook(mirrored.invalidate)
+        runner._plan_cache.add_invalidation_hook(self._publish_invalidate)
+        # rehydrate prepared statements: the on-disk registry holds every
+        # statement PREPAREd fleet-wide before this generation was born
+        self.prepared = PreparedRegistry(self.fleet_dir)
+        for name, sql in sorted(self.prepared.snapshot().items()):
+            register_prepared(runner, name, sql)
+        # warmup BEFORE the listener serves: plan cache, jit cache
+        # (persistent-cache-backed so recompiles are disk loads), table
+        # cache all prime now — the replacement's first real miss runs
+        # at steady-state speed
+        manifest = config.get("warmup_manifest")
+        if manifest:
+            from trino_tpu.serve.warmup import apply_warmup
+            try:
+                apply_warmup(runner, manifest)
+            except Exception:   # noqa: BLE001 — warmup stays best-effort
+                pass
+        listen_fd = self._acquire_listener()
+        engine_kwargs = dict(config.get("engine_kwargs") or {})
+        from trino_tpu.server import TrinoServer
+        bind_port = 0 if listen_fd is not None else \
+            int(self.port if self.port is not None
+                else config.get("engine_port") or 0)
+        last_err: Optional[BaseException] = None
+        for attempt in range(_BIND_RETRIES):
+            try:
+                self.server = TrinoServer(
+                    runner, host="127.0.0.1", port=bind_port,
+                    listen_fd=listen_fd,
+                    resource_groups_path=config.get(
+                        "resource_groups_path"),
+                    warmup_manifest=None, **engine_kwargs)
+                break
+            except OSError as e:
+                # a dying predecessor may still hold the port for a few
+                # scheduler ticks after its SIGKILL — retry, bounded
+                last_err = e
+                if listen_fd is not None or bind_port == 0 \
+                        or attempt == _BIND_RETRIES - 1:
+                    raise
+                time.sleep(_BIND_RETRY_SLEEP_S)
+        if self.server is None:   # pragma: no cover — loop always sets
+            raise OSError(f"engine could not bind: {last_err}")
+        self.server.fast_path_quota = _QuotaGate(
+            self.shared, config.get("resource_groups_path"))
+        self.server.start()
+        # bus LAST: "engine" names the SERVING generation (see module
+        # docstring); bind-time stale-path unlink reclaims a crashed
+        # predecessor's socket
+        self.bus = FleetBus(self.fleet_dir, "engine",
+                            on_message=self._on_bus)
+        self._register_gauges()
+        self._record("active", port=self.server.port,
+                     base=self.server.base_uri,
+                     start_date=runner.session.start_date,
+                     catalog=runner.session.catalog,
+                     schema=runner.session.schema,
+                     base_properties=self._base_properties(),
+                     default_group=str(
+                         runner.session.get("resource_group")))
+        return self
+
+    def _base_properties(self) -> Dict:
+        from trino_tpu.exec.plan_cache import PLAN_PROPERTIES
+        session = self.runner.session
+        return {p: session.properties[p] for p in PLAN_PROPERTIES
+                if p in session.properties}
+
+    def _acquire_listener(self) -> Optional[int]:
+        """HANDOFF mode: signal readiness, then block for the draining
+        predecessor's listening fd. The runner is already built and
+        warm by now, so the no-accept gap is just the predecessor's
+        drain plus one SCM_RIGHTS round trip."""
+        if not self.handoff_path:
+            return None
+        from trino_tpu.fleet.handoff import HandoffListener
+        listener = HandoffListener(self.handoff_path)
+        try:
+            self._record("ready-for-handoff")
+            timeout = float(self.config.get("drain_timeout_s", 10.0)) \
+                + float(self.config.get("drain_grace_s", 0.5)) + 60.0
+            fds, _meta = listener.accept_fds(timeout_s=timeout)
+        finally:
+            listener.close()
+        if not fds:
+            raise ConnectionError("handoff delivered no listener fd")
+        for fd in fds[1:]:
+            os.close(fd)
+        return fds[0]
+
+    # ------------------------------------------------------------- the bus
+
+    def _publish_invalidate(self, table) -> None:
+        """Plan-cache invalidation hook: tell every worker to drop its
+        hot local copies NOW. Advisory — the shm generation bump the
+        mirrored cache already performed is what makes staleness
+        impossible. Guarded: the hook is installed before the bus
+        exists (warmup may invalidate)."""
+        if self.bus is not None:
+            self.bus.publish({"kind": "invalidate", "table": list(table)},
+                             exclude_self=True)
+
+    def _on_bus(self, message: Dict) -> None:
+        kind = message.get("kind")
+        if kind == "hits":
+            self.hits_ingested += ingest_hits(self.server, message)
+        elif kind == "prepare":
+            register_prepared(self.runner, message["name"],
+                              message["sql"])
+        elif kind == "deallocate":
+            self.runner._prepared.pop(message.get("name"), None)
+        elif kind == "handoff":
+            # planned swap: drain fully, THEN pass the listener on its
+            # own thread (stop() joins threads; the bus receive thread
+            # must not join itself)
+            threading.Thread(target=self._handoff_out,
+                             args=(message.get("path"),),
+                             daemon=True, name="engine-handoff").start()
+        elif kind == "stop":
+            threading.Thread(target=self.shutdown, daemon=True,
+                             name="engine-stop").start()
+
+    def _handoff_out(self, path: Optional[str]) -> None:
+        """The draining side of the zero-drop swap: dup the listener fd
+        FIRST (TrinoServer.stop() closes the original at server_close,
+        but the dup keeps the socket listening — connections queue in
+        the kernel backlog), drain every in-flight query and stream,
+        then send the dup and exit. Strictly sequential, so a GET for
+        an in-flight old-generation query can never land on the
+        replacement."""
+        if not path:
+            return
+        with self._stop_once:
+            if self._stop_started:
+                return
+            self._stop_started = True
+        fd = os.dup(self.server._httpd.socket.fileno())
+        try:
+            self.server.stop()
+            from trino_tpu.fleet.handoff import offer_fds
+            offer_fds(path, [fd], {"port": self.server.port,
+                                   "epoch": self.epoch})
+        finally:
+            os.close(fd)
+            if self.bus is not None:
+                try:
+                    self.bus.close()
+                except RuntimeError:
+                    pass
+            self._stopped.set()
+
+    # ----------------------------------------------------------- lifecycle
+
+    def shutdown(self) -> None:
+        with self._stop_once:
+            if self._stop_started:
+                return
+            self._stop_started = True
+        try:
+            if self.server is not None:
+                self.server.stop()
+        finally:
+            if self.bus is not None:
+                try:
+                    self.bus.close()
+                except RuntimeError:
+                    pass
+            self._record("stopped")
+            self._stopped.set()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        return self._stopped.wait(timeout)
+
+    # ------------------------------------------------------------- gauges
+
+    def _register_gauges(self) -> None:
+        from trino_tpu.fleet.registry import list_worker_records
+        from trino_tpu.obs.metrics import REGISTRY
+        engine = self
+
+        def _engine_gauges():
+            yield ("trino_tpu_engine_epoch",
+                   "Generation number of the serving engine process.",
+                   engine.epoch, {})
+            yield ("trino_tpu_fleet_workers",
+                   "Live fleet worker processes.",
+                   len(list_worker_records(engine.fleet_dir)), {})
+            yield ("trino_tpu_fleet_shared_cache_entries",
+                   "Live entries in the cross-process result cache.",
+                   engine.shared.entry_count(), {})
+            yield ("trino_tpu_fleet_hits_ingested",
+                   "Worker cache hits ingested into fleet accounting.",
+                   engine.hits_ingested, {})
+
+        REGISTRY.register_gauges(_engine_gauges)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="trino_tpu.fleet.engine")
+    parser.add_argument("fleet_dir")
+    parser.add_argument("--epoch", type=int, default=1)
+    parser.add_argument("--handoff", default=None)
+    parser.add_argument("--port", type=int, default=None)
+    args = parser.parse_args(sys.argv[1:] if argv is None else argv)
+    engine = EngineProcess(args.fleet_dir, epoch=args.epoch,
+                           handoff_path=args.handoff, port=args.port)
+    try:
+        engine.run()
+    except BaseException as e:
+        engine._record("failed", error=repr(e))
+        raise
+
+    def _on_term(signum, frame):
+        threading.Thread(target=engine.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    engine.join()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,135 @@
+"""SCM_RIGHTS listener-socket handoff between engine generations.
+
+A PLANNED engine restart must not drop queries, and the dispatch port is
+the only resource two engine processes cannot share by re-binding: while
+the old engine still listens, a plain bind() fails, and closing first
+opens a refused-connection window. The fix is the classic one (nginx,
+HAProxy, Envoy hot restart): pass the LISTENING file descriptor itself
+to the replacement over a unix stream socket via SCM_RIGHTS ancillary
+data. The kernel accept queue rides along with the fd — connections
+that arrive while neither process is accepting simply wait in the
+backlog, so the swap is zero-drop by construction:
+
+    old engine                         new engine
+    ----------                         ----------
+    dup(listener fd)
+    TrinoServer.stop()    # full drain: in-flight queries + streams
+    connect(handoff.sock)
+    sendmsg(fd)  ------------------->  recvmsg(fd)
+    exit                               TrinoServer(listen_fd=fd).start()
+
+The protocol is deliberately sequential — the old engine finishes its
+drain BEFORE the fd moves, so a GET for an in-flight old-engine query
+can never land on the replacement (which would 404 it). POSTs that race
+the drain are answered SERVER_SHUTTING_DOWN, which the workers retry
+against the replacement (the engine rejected them before execution, so
+the retry is safe).
+"""
+
+from __future__ import annotations
+
+import array
+import json
+import os
+import socket
+import struct
+from typing import Dict, List, Optional, Tuple
+
+# one u32 length prefix for the JSON metadata that rides with the fds
+_LEN = struct.Struct("!I")
+MAX_META = 1 << 20
+
+
+def send_fds(sock: socket.socket, fds: List[int],
+             meta: Optional[Dict] = None) -> None:
+    """Send `fds` + a JSON metadata dict over a connected unix stream
+    socket in ONE sendmsg (ancillary data must accompany at least one
+    byte of real data; the length-prefixed meta is that byte)."""
+    payload = json.dumps(meta or {}).encode("utf-8")
+    if len(payload) > MAX_META:
+        raise ValueError("handoff metadata too large")
+    buf = _LEN.pack(len(payload)) + payload
+    anc = [(socket.SOL_SOCKET, socket.SCM_RIGHTS,
+            array.array("i", [int(fd) for fd in fds]).tobytes())]
+    sock.sendmsg([buf], anc)
+
+
+def recv_fds(sock: socket.socket, max_fds: int = 4
+             ) -> Tuple[List[int], Dict]:
+    """Receive (fds, metadata) sent by `send_fds`. Raises ConnectionError
+    if the peer closed without sending (a crashed offerer must not look
+    like an empty handoff)."""
+    space = socket.CMSG_SPACE(max_fds * array.array("i").itemsize)
+    data, ancdata, flags, _ = sock.recvmsg(_LEN.size, space)
+    if len(data) < _LEN.size:
+        raise ConnectionError("handoff peer closed before sending")
+    fds: List[int] = []
+    for level, ctype, cdata in ancdata:
+        if level == socket.SOL_SOCKET and ctype == socket.SCM_RIGHTS:
+            arr = array.array("i")
+            arr.frombytes(cdata[:len(cdata)
+                                - (len(cdata) % arr.itemsize)])
+            fds.extend(int(fd) for fd in arr)
+    (nbytes,) = _LEN.unpack(data)
+    if nbytes > MAX_META:
+        for fd in fds:
+            os.close(fd)
+        raise ConnectionError("handoff metadata too large")
+    payload = b""
+    while len(payload) < nbytes:
+        chunk = sock.recv(nbytes - len(payload))
+        if not chunk:
+            for fd in fds:
+                os.close(fd)
+            raise ConnectionError("handoff peer closed mid-metadata")
+        payload += chunk
+    meta = json.loads(payload.decode("utf-8")) if payload else {}
+    return fds, meta
+
+
+class HandoffListener:
+    """The RECEIVING half, owned by the replacement engine: bind a unix
+    stream socket at `path` (unlinking any stale one), then block in
+    `accept_fds` until the old engine connects and offers its listener."""
+
+    def __init__(self, path: str):
+        self.path = path
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(path)
+        self._sock.listen(1)
+
+    def accept_fds(self, timeout_s: float = 30.0,
+                   max_fds: int = 4) -> Tuple[List[int], Dict]:
+        self._sock.settimeout(timeout_s)
+        conn, _ = self._sock.accept()
+        try:
+            conn.settimeout(timeout_s)
+            return recv_fds(conn, max_fds)
+        finally:
+            conn.close()
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        finally:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+def offer_fds(path: str, fds: List[int], meta: Optional[Dict] = None,
+              timeout_s: float = 30.0) -> None:
+    """The SENDING half, called by the draining engine: connect to the
+    replacement's handoff socket and pass the listener fd(s)."""
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        sock.settimeout(timeout_s)
+        sock.connect(path)
+        send_fds(sock, fds, meta)
+    finally:
+        sock.close()

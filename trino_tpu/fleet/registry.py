@@ -162,6 +162,35 @@ def list_worker_records(fleet_dir: str) -> List[Dict]:
     return out
 
 
+# --------------------------------------------------------- engine record
+
+
+def engine_record_path(fleet_dir: str) -> str:
+    return os.path.join(fleet_dir, "engine.json")
+
+
+def write_engine_record(fleet_dir: str, record: Dict) -> str:
+    """Atomic engine-process record (pid, port, epoch, state): the
+    rendezvous between an engine generation and its supervisor. States:
+    `starting` -> `ready-for-handoff` (planned swap only) -> `active`
+    -> `stopped`."""
+    record = dict(record, updated=time.time())
+    path = engine_record_path(fleet_dir)
+    fd, tmp = tempfile.mkstemp(dir=fleet_dir, prefix=".tmp-")
+    with os.fdopen(fd, "w") as fh:
+        json.dump(record, fh)
+    os.replace(tmp, path)
+    return path
+
+
+def read_engine_record(fleet_dir: str) -> Optional[Dict]:
+    try:
+        with open(engine_record_path(fleet_dir)) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
 # --------------------------------------------------------- fleet config
 
 

@@ -1,0 +1,208 @@
+"""Fleet supervision: crash detection and respawn for engine + workers.
+
+PR 13's fleet had exactly one irreplaceable process: the engine. This
+thread makes it replaceable. The loop watches two things:
+
+- the ENGINE subprocess: waitpid-style `poll()` catches a crash the
+  instant the kernel reaps it; an HTTP liveness probe against the
+  engine's own metrics endpoint catches the subtler failure — a process
+  that is alive but wedged (deadlocked executor, hung device call).
+  `stall_probes` consecutive probe failures escalate to SIGKILL + the
+  same respawn path a crash takes, because a wedged engine holding the
+  dispatch port is strictly worse than a dead one.
+- the WORKER subprocesses: a worker that dies mid-flight (not draining)
+  is respawned with bounded exponential backoff. Workers are cheap and
+  stateless-by-design, so the policy is simple: replace, count, move on.
+
+What a respawned engine recovers WITHOUT the supervisor's help — and
+why the fleet keeps serving through the outage — is fleet/engine.py's
+story (registry rehydration, warmup re-priming, the crash-surviving
+shm tier) and fleet/worker.py's (degraded-mode hit serving + breaker).
+The supervisor's only jobs are detection, respawn, and truth-telling:
+`<fleet_dir>/supervisor.json` holds the restart counters and cumulative
+outage seconds that workers surface as `trino_tpu_engine_restarts_total`
+/ `trino_tpu_engine_outage_seconds` on every fleet metrics scrape.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import signal
+import tempfile
+import threading
+import time
+from typing import Dict, Optional
+
+
+def supervisor_record_path(fleet_dir: str) -> str:
+    return os.path.join(fleet_dir, "supervisor.json")
+
+
+def read_supervisor_record(fleet_dir: str) -> Optional[Dict]:
+    try:
+        with open(supervisor_record_path(fleet_dir)) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+class FleetSupervisor:
+    """Monitor thread over a FleetServer's subprocess tree."""
+
+    def __init__(self, fleet, probe_interval_s: float = 0.5,
+                 probe_timeout_s: float = 2.0, stall_probes: int = 6,
+                 worker_respawn_max: int = 3,
+                 respawn_backoff_s: float = 0.25):
+        self.fleet = fleet
+        self.probe_interval_s = probe_interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self.stall_probes = stall_probes
+        self.worker_respawn_max = worker_respawn_max
+        self.respawn_backoff_s = respawn_backoff_s
+        self.engine_restarts: Dict[str, int] = {"crash": 0, "stall": 0,
+                                                "planned": 0}
+        self.worker_restarts = 0
+        self.outage_seconds = 0.0
+        self._probe_failures = 0
+        self._worker_attempts: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "FleetSupervisor":
+        self.write_record()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="fleet-supervisor")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    def count_planned_restart(self) -> None:
+        with self._lock:
+            self.engine_restarts["planned"] += 1
+        self.write_record()
+
+    # ---------------------------------------------------------- the loop
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            try:
+                self._check_engine()
+                self._check_workers()
+            except Exception:   # noqa: BLE001 — supervision must outlive
+                continue        # any single probe's surprise
+
+    def _check_engine(self) -> None:
+        fleet = self.fleet
+        proc = fleet.engine_proc
+        if proc is None or fleet._engine_expected_down:
+            # in-process engine, or a planned restart is mid-swap: the
+            # restart path owns the process until the swap completes
+            self._probe_failures = 0
+            return
+        if proc.poll() is not None:
+            self._restart_engine("crash")
+            return
+        if self._probe_engine(fleet):
+            self._probe_failures = 0
+            return
+        self._probe_failures += 1
+        if self._probe_failures >= self.stall_probes:
+            # alive but wedged: holding the dispatch port while serving
+            # nothing is worse than dead — make it dead, then recover
+            self._probe_failures = 0
+            try:
+                os.kill(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=10.0)
+            except Exception:   # noqa: BLE001
+                pass
+            self._restart_engine("stall")
+
+    def _probe_engine(self, fleet) -> bool:
+        port = fleet.engine_port
+        if not port:
+            return True
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=self.probe_timeout_s)
+        try:
+            conn.request("GET", "/v1/metrics")
+            return conn.getresponse().status == 200
+        except OSError:
+            return False
+        finally:
+            conn.close()
+
+    def _restart_engine(self, kind: str) -> None:
+        t0 = time.monotonic()
+        with self._lock:
+            self.engine_restarts[kind] = \
+                self.engine_restarts.get(kind, 0) + 1
+        self.write_record()
+        backoff = self.respawn_backoff_s
+        while not self._stop.is_set():
+            try:
+                self.fleet._respawn_engine()
+                break
+            except Exception:   # noqa: BLE001 — a failed respawn (port
+                # still tearing down, transient exec error) retries;
+                # giving up would leave the fleet headless forever
+                if self._stop.wait(backoff):
+                    break
+                backoff = min(backoff * 2, 5.0)
+        with self._lock:
+            self.outage_seconds += time.monotonic() - t0
+        self.write_record()
+
+    def _check_workers(self) -> None:
+        fleet = self.fleet
+        for wid, proc in list(fleet.worker_procs.items()):
+            if proc.poll() is None or wid in fleet._draining:
+                continue
+            fleet.worker_procs.pop(wid, None)
+            attempts = self._worker_attempts.get(wid, 0) + 1
+            self._worker_attempts[wid] = attempts
+            if attempts > self.worker_respawn_max:
+                continue    # crash loop: stop feeding it; the workers
+                # gauge and the restart counter tell the story
+            if self._stop.wait(self.respawn_backoff_s
+                               * (2 ** (attempts - 1))):
+                return
+            try:
+                new_id = fleet.spawn_worker(wait=False)
+            except Exception:   # noqa: BLE001
+                continue
+            # the replacement inherits the dead worker's attempt count:
+            # a worker that crashes on arrival must not reset the bound
+            self._worker_attempts[new_id] = attempts
+            with self._lock:
+                self.worker_restarts += 1
+            self.write_record()
+
+    # ------------------------------------------------------------- record
+
+    def write_record(self) -> None:
+        with self._lock:
+            record = {"engine_restarts": dict(self.engine_restarts),
+                      "worker_restarts": self.worker_restarts,
+                      "outage_seconds": round(self.outage_seconds, 3),
+                      "engine_epoch": self.fleet.engine_epoch,
+                      "updated": time.time()}
+        fleet_dir = self.fleet.fleet_dir
+        try:
+            fd, tmp = tempfile.mkstemp(dir=fleet_dir, prefix=".tmp-")
+            with os.fdopen(fd, "w") as fh:
+                json.dump(record, fh)
+            os.replace(tmp, supervisor_record_path(fleet_dir))
+        except OSError:
+            pass

@@ -62,6 +62,77 @@ _HOP_HEADERS = {"connection", "keep-alive", "host", "content-length",
 _URI_FIELDS = ("infoUri", "nextUri", "partialCancelUri")
 
 
+class EngineUnavailableError(OSError):
+    """Dispatch to the engine failed in a way that means the engine
+    process is DOWN (crashed, being respawned) rather than the request
+    being bad — the worker answers the classified retryable
+    ENGINE_UNAVAILABLE error instead of a raw connection reset."""
+
+
+class CircuitBreaker:
+    """Per-worker breaker over the engine dispatch path. While the
+    engine is down every miss would otherwise pay the full
+    retry-with-backoff ladder before failing; after
+    `failure_threshold` consecutive failures the breaker OPENs and
+    misses fast-fail for `reset_s`, then a single HALF_OPEN trial
+    probes the (possibly respawned) engine — success closes, failure
+    re-opens. The states export as a gauge: 0=closed, 1=half-open,
+    2=open. The supervisor's engine-epoch bus notice resets the breaker
+    the instant a replacement engine is serving, so recovery does not
+    wait out `reset_s`."""
+
+    CLOSED, HALF_OPEN, OPEN = 0, 1, 2
+
+    def __init__(self, failure_threshold: int = 3, reset_s: float = 1.0):
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.reset_s = float(reset_s)
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._trial = False
+
+    @property
+    def state(self) -> int:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN:
+                if time.monotonic() - self._opened_at < self.reset_s:
+                    return False
+                self._state = self.HALF_OPEN
+                self._trial = True
+                return True
+            # HALF_OPEN: exactly one in-flight trial probes the engine;
+            # everyone else keeps fast-failing until it resolves
+            if self._trial:
+                return False
+            self._trial = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._state = self.CLOSED
+            self._failures = 0
+            self._trial = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._trial = False
+            self._failures += 1
+            if self._state == self.HALF_OPEN or \
+                    self._failures >= self.failure_threshold:
+                self._state = self.OPEN
+                self._opened_at = time.monotonic()
+
+    def reset(self) -> None:
+        self.record_success()
+
+
 class _SharedPortServer(ThreadingHTTPServer):
     def server_bind(self):
         if hasattr(socket, "SO_REUSEPORT"):
@@ -111,8 +182,23 @@ class WorkerServer:
         self._hot: Dict[bytes, tuple] = {}
         self._hot_lock = threading.Lock()
         self._tls = threading.local()
+        # degraded mode: bounded retry-with-backoff behind a circuit
+        # breaker — while the engine is down (crash window, respawn in
+        # progress) hits keep serving from shm and misses fail FAST with
+        # the classified retryable ENGINE_UNAVAILABLE answer
+        self.breaker = CircuitBreaker(
+            failure_threshold=int(
+                config.get("breaker_failure_threshold", 3)),
+            reset_s=float(config.get("breaker_reset_s", 1.0)))
+        self.forward_retries = max(1, int(config.get("forward_retries",
+                                                     3)))
+        self.forward_backoff_s = float(config.get("forward_backoff_s",
+                                                  0.05))
+        self._engine_gen = 0    # bumped by engine_epoch bus notices so
+        # per-thread upstream connections to a DEAD generation retire
         self.counters = {"hits": 0, "hit_rows": 0, "forwarded": 0,
-                         "quota_rejected": 0, "errors": 0}
+                         "quota_rejected": 0, "errors": 0,
+                         "deferred_misses": 0}
         self._counters_lock = threading.Lock()
         # cache-hit accounting batches -> engine (fleet-aggregated group
         # counters + sampled system.runtime.queries rows)
@@ -223,6 +309,12 @@ class WorkerServer:
             self.prepared.remove(message["name"], persist=False)
         elif kind == "drain":
             self.drain(message.get("timeout_s"))
+        elif kind == "engine_epoch":
+            # a replacement engine generation is serving: close the
+            # breaker NOW (no reset_s wait) and retire connections to
+            # the dead generation
+            self._engine_gen += 1
+            self.breaker.reset()
         elif kind == "reload":
             self._quotas.current(force=True)
             self.prepared.reload()
@@ -393,11 +485,25 @@ class WorkerServer:
 
     def _engine_conn(self):
         conn = getattr(self._tls, "conn", None)
-        if conn is None:
+        if conn is None or getattr(self._tls, "conn_gen", -1) != \
+                self._engine_gen:
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
             conn = http.client.HTTPConnection(
                 self.engine_host, self.engine_port, timeout=300)
             self._tls.conn = conn
+            self._tls.conn_gen = self._engine_gen
         return conn
+
+    def _drop_conn(self, conn) -> None:
+        self._tls.conn = None
+        try:
+            conn.close()
+        except OSError:
+            pass
 
     def _forward(self, method: str, path: str, body: Optional[bytes],
                  headers: Dict[str, str]
@@ -412,7 +518,14 @@ class WorkerServer:
                 fwd = {k: v for k, v in fwd.items()
                        if k.lower() != "x-trino-prepared-statement"}
                 fwd["X-Trino-Prepared-Statement"] = merged
-        for attempt in range(2):
+        if not self.breaker.allow():
+            raise EngineUnavailableError(
+                "engine circuit breaker open "
+                "(engine down or restarting)")
+        last: Optional[BaseException] = None
+        for attempt in range(self.forward_retries):
+            if attempt:
+                time.sleep(self.forward_backoff_s * (2 ** (attempt - 1)))
             conn = self._engine_conn()
             sent = False
             try:
@@ -420,25 +533,83 @@ class WorkerServer:
                 sent = True
                 resp = conn.getresponse()
                 data = resp.read()
-                return resp.status, dict(resp.getheaders()), data
             except (OSError, http.client.HTTPException) as e:
-                self._tls.conn = None
-                try:
-                    conn.close()
-                except OSError:
-                    pass
+                self._drop_conn(conn)
+                last = e
+                self.breaker.record_failure()
                 # retry discipline: a failure during SEND means the
                 # engine never saw a complete request — safe to retry
                 # anything. A failure AFTER the send (OSError or an
                 # HTTPException like IncompleteRead from an engine
                 # dying mid-response) may have executed server-side, so
                 # only idempotent methods retry; a non-idempotent POST
-                # (INSERT/DDL) must surface the error rather than risk
-                # double execution
-                if attempt or (sent and method == "POST"):
-                    raise OSError(f"engine dispatch failed: {e}") \
-                        from e
-        raise OSError("unreachable")
+                # (INSERT/DDL) must surface the classified retryable
+                # error — the CLIENT owns that replay, which the write
+                # tokens make exactly-once (exec/runner.py)
+                if sent and method == "POST":
+                    raise EngineUnavailableError(
+                        f"engine connection lost mid-dispatch: {e}"
+                    ) from e
+                continue
+            if method == "POST" and b'"SERVER_SHUTTING_DOWN"' in data:
+                # a PLANNED engine swap is draining the old generation:
+                # the request was REJECTED before execution, and the
+                # replacement inherits the very listener we are talking
+                # to — retry on its own deadline (a drain outlasts the
+                # normal backoff ladder) without charging the breaker
+                return self._retry_through_drain(method, path, body,
+                                                 fwd, resp, data)
+            self.breaker.record_success()
+            return resp.status, dict(resp.getheaders()), data
+        raise EngineUnavailableError(
+            f"engine dispatch failed after {self.forward_retries} "
+            f"attempts: {last}") from last
+
+    def _retry_through_drain(self, method: str, path: str,
+                             body: Optional[bytes],
+                             fwd: Dict[str, str], resp, data: bytes
+                             ) -> Tuple[int, Dict[str, str], bytes]:
+        """Ride out an engine drain window: keep re-POSTing (rejected-
+        before-execution, so the resend is safe) until the replacement
+        generation answers. Connections opened during the no-accept gap
+        wait in the kernel backlog of the handed-off listener — this
+        loop is what turns a planned engine swap into zero client
+        errors even for cache misses."""
+        deadline = time.monotonic() + self.drain_timeout_s \
+            + self.drain_grace_s + 10.0
+        status, resp_headers = resp.status, dict(resp.getheaders())
+        # the conn whose LAST completed exchange was the drain
+        # rejection: the old generation rejects every POST on it before
+        # execution, so a failure there — even after the send — means
+        # the statement did NOT run and the resend is unconditionally
+        # safe (the old engine exiting under us is the expected way
+        # this conn dies). A failure on a FRESH conn is different: it
+        # may have reached the REPLACEMENT and executed, so that one
+        # surfaces the classified error and the client's replay (write
+        # tokens make it exactly-once) takes over.
+        safe_conn = getattr(self._tls, "conn", None)
+        while time.monotonic() < deadline:
+            time.sleep(0.1)
+            conn = self._engine_conn()
+            sent = False
+            try:
+                conn.request(method, path, body=body, headers=fwd)
+                sent = True
+                resp = conn.getresponse()
+                data = resp.read()
+            except (OSError, http.client.HTTPException) as e:
+                self._drop_conn(conn)
+                if sent and conn is not safe_conn:
+                    raise EngineUnavailableError(
+                        f"engine connection lost mid-dispatch: {e}"
+                    ) from e
+                continue
+            if b'"SERVER_SHUTTING_DOWN"' not in data:
+                self.breaker.record_success()
+                return resp.status, dict(resp.getheaders()), data
+            safe_conn = conn
+            status, resp_headers = resp.status, dict(resp.getheaders())
+        return status, resp_headers, data
 
     def _merged_prepared_header(self, sql: str, headers) -> str:
         """Sticky prepared-statement routing: when the forwarded
@@ -514,7 +685,39 @@ class WorkerServer:
                                         timeout=1.0)
             if text:
                 texts.append(text)
+        # supervisor truth rides the shared-port scrape ONLY (never
+        # _local_metrics: peers merge-SUM each other's admin expositions,
+        # and a fleet-level counter emitted N times would read N× real)
+        sup = self._supervisor_metrics()
+        if sup:
+            texts.append(sup)
         return fleet_metrics.merge_prometheus(texts)
+
+    def _supervisor_metrics(self) -> str:
+        from trino_tpu.fleet.supervisor import read_supervisor_record
+        record = read_supervisor_record(self.fleet_dir)
+        if not record:
+            return ""
+        lines = [
+            "# HELP trino_tpu_engine_restarts_total Engine process "
+            "restarts by the fleet supervisor, by kind.",
+            "# TYPE trino_tpu_engine_restarts_total counter"]
+        for kind, n in sorted((record.get("engine_restarts")
+                               or {}).items()):
+            lines.append(
+                f'trino_tpu_engine_restarts_total{{kind="{kind}"}} {n}')
+        lines += [
+            "# HELP trino_tpu_engine_outage_seconds Cumulative seconds "
+            "the fleet ran without a serving engine.",
+            "# TYPE trino_tpu_engine_outage_seconds gauge",
+            f"trino_tpu_engine_outage_seconds "
+            f"{record.get('outage_seconds', 0)}",
+            "# HELP trino_tpu_fleet_worker_restarts_total Worker "
+            "processes respawned by the fleet supervisor.",
+            "# TYPE trino_tpu_fleet_worker_restarts_total counter",
+            f"trino_tpu_fleet_worker_restarts_total "
+            f"{record.get('worker_restarts', 0)}"]
+        return "\n".join(lines) + "\n"
 
     def _local_metrics(self) -> str:
         """The worker's OWN exposition: its fleet gauges ONLY — not the
@@ -543,12 +746,31 @@ class WorkerServer:
             ("trino_tpu_fleet_shared_cache_misses",
              "Shared-tier lookups that missed, per process.",
              self.shared.stats["misses"]),
+            ("trino_tpu_fleet_worker_deferred_misses",
+             "Misses answered ENGINE_UNAVAILABLE while the engine was "
+             "down.",
+             counters["deferred_misses"]),
+            ("trino_tpu_fleet_breaker_state",
+             "Engine-dispatch circuit breaker: 0=closed, 1=half-open, "
+             "2=open.",
+             self.breaker.state),
         )
         lines = []
         for name, help_text, value in gauges:
             lines.append(f"# HELP {name} {help_text}")
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name}{labels} {value}")
+        drops = self.bus.drops_snapshot()
+        if drops:
+            lines.append("# HELP trino_tpu_fleet_bus_drops_total Bus "
+                         "datagrams dropped (send failed or receiver "
+                         "overflowed), by message kind.")
+            lines.append("# TYPE trino_tpu_fleet_bus_drops_total "
+                         "counter")
+            for kind, n in sorted(drops.items()):
+                lines.append(
+                    f'trino_tpu_fleet_bus_drops_total'
+                    f'{{worker="{self.worker_id}",kind="{kind}"}} {n}')
         return "\n".join(lines) + "\n"
 
     def status(self) -> Dict[str, Any]:
@@ -605,6 +827,27 @@ class WorkerServer:
                 try:
                     status, resp_headers, data = worker._forward(
                         method, self.path, body, headers)
+                except EngineUnavailableError as e:
+                    # degraded mode's miss answer: a CLASSIFIED
+                    # retryable error (the client replays against the
+                    # respawned engine; write replays dedupe on their
+                    # idempotency token), never a raw connection reset.
+                    # The same taxonomy covers a nextUri GET whose
+                    # engine died mid-stream.
+                    from trino_tpu.errors import ENGINE_UNAVAILABLE
+                    with worker._counters_lock:
+                        worker.counters["errors"] += 1
+                        worker.counters["deferred_misses"] += 1
+                    self._send_json(protocol.query_results(
+                        "fleet_dispatch", worker.public_base,
+                        state="FAILED",
+                        error=protocol.error_json(
+                            f"engine unavailable (supervisor is "
+                            f"restoring it; retry): {e}",
+                            error_name=ENGINE_UNAVAILABLE.name,
+                            error_code=ENGINE_UNAVAILABLE.code,
+                            error_type=ENGINE_UNAVAILABLE.type)), 200)
+                    return
                 except OSError as e:
                     with worker._counters_lock:
                         worker.counters["errors"] += 1

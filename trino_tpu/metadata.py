@@ -105,11 +105,24 @@ SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     "retry_initial_delay_ms": 10,
     "retry_max_delay_ms": 1000,
     # chaos harness (exec/faults.py): rate > 0 arms a seeded injector per
-    # query; sites is a comma list drawn from fragment,exchange,scan,spill
-    # (empty = all). Same seed + same statements = same faults.
+    # query; sites is a comma list drawn from fragment,exchange,scan,
+    # spill,memory,slice,engine (empty = all). Same seed + same
+    # statements = same faults. Site `engine` is PROCESS-level: inside a
+    # fleet engine child it kills the engine process mid-dispatch
+    # (SIGKILL, or $TRINO_TPU_FAULT_ENGINE_SIGNAL), driving the
+    # supervisor crash-recovery path; elsewhere it raises an ordinary
+    # retryable InjectedFault.
     "fault_injection_rate": 0.0,
     "fault_injection_seed": 0,
     "fault_injection_sites": "",
+    # idempotent-write identity: empty means each execution is its own
+    # write (token = query id). A client that must RETRY a failed
+    # INSERT/CTAS — e.g. after the fleet's retryable ENGINE_UNAVAILABLE
+    # answer — sets the same token on both attempts and the sink's
+    # committed-token ledger makes the replay exactly-once: if the first
+    # attempt's commit landed before the engine died, the replay
+    # becomes a no-op instead of a duplicate append.
+    "write_token": "",
     # deadlines (QueryTracker.enforceTimeLimits analogs): Trino Duration
     # strings ('30s', '2m', '500ms') or bare seconds; empty = unlimited.
     # run time counts from queueing, execution time from planning start.
@@ -296,6 +309,53 @@ SERVER_PROPERTY_DOCS: Dict[str, str] = {
     "in_process":
         "FleetServer: run workers as in-process threads instead of "
         "subprocesses (tests/debugging only — shares the GIL).",
+    "engine_in_process":
+        "FleetServer: run the engine inside the parent process (PR-13 "
+        "topology; implied by passing a runner). Default False: the "
+        "engine is a supervised subprocess that crash-recovers by "
+        "rehydrating prepared statements, warmup priming, and the "
+        "crash-surviving shm tier.",
+    "probe_interval_s":
+        "FleetServer supervisor: seconds between engine/worker "
+        "liveness checks (default 0.5). Engine death is also caught "
+        "immediately via waitpid.",
+    "probe_timeout_s":
+        "FleetServer supervisor: HTTP liveness-probe timeout against "
+        "the engine's metrics endpoint (default 2.0).",
+    "engine_stall_probes":
+        "FleetServer supervisor: consecutive failed liveness probes "
+        "before a live-but-wedged engine is SIGKILLed and respawned "
+        "(default 6).",
+    "worker_respawn_max":
+        "FleetServer: bounded respawn attempts for a worker that dies "
+        "at startup or mid-flight before the fleet gives up on that "
+        "logical worker (default 3).",
+    "respawn_backoff_s":
+        "FleetServer: base of the exponential respawn backoff for "
+        "crashed workers (default 0.25; doubles per attempt).",
+    "breaker_failure_threshold":
+        "Fleet worker: consecutive engine-dispatch failures before the "
+        "circuit breaker opens and misses fast-fail with the "
+        "retryable ENGINE_UNAVAILABLE answer (default 3). Hits keep "
+        "serving from the shm tier regardless.",
+    "breaker_reset_s":
+        "Fleet worker: seconds an open breaker waits before a single "
+        "half-open trial probes the engine (default 1.0); the "
+        "supervisor's engine-epoch bus notice closes it immediately "
+        "on respawn.",
+    "forward_retries":
+        "Fleet worker: dispatch attempts (with exponential backoff) "
+        "against the engine before a miss is answered "
+        "ENGINE_UNAVAILABLE (default 3).",
+    "forward_backoff_s":
+        "Fleet worker: base backoff between dispatch retries "
+        "(default 0.05; doubles per attempt).",
+    "handoff_enabled":
+        "FleetServer: engine_restart() passes the LIVE dispatch "
+        "listener to the replacement over SCM_RIGHTS (default True; "
+        "zero dropped queries — misses included). False swaps "
+        "stop-then-bind: a brief miss outage covered by the workers' "
+        "retry discipline.",
 }
 
 

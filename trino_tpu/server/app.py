@@ -67,6 +67,7 @@ from __future__ import annotations
 import itertools
 import json
 import re
+import socket
 import threading
 import time
 import uuid
@@ -182,7 +183,8 @@ class TrinoServer:
                  trace_dir: Optional[str] = None,
                  history_max_entries: Optional[int] = None,
                  drain_timeout_s: float = 10.0,
-                 drain_idle_grace_s: float = 1.0):
+                 drain_idle_grace_s: float = 1.0,
+                 listen_fd: Optional[int] = None):
         self.runner = runner
         # serving tier defaults: the server IS the production front door,
         # so result/scan caching default ON for server sessions (clones
@@ -316,7 +318,24 @@ class TrinoServer:
         # ThreadingHTTPServer's handler threads are daemonic, so
         # server_close() after the drain below never blocks on a parked
         # keep-alive connection
-        self._httpd = ThreadingHTTPServer((host, port), handler)
+        if listen_fd is None:
+            self._httpd = ThreadingHTTPServer((host, port), handler)
+        else:
+            # adopt an ALREADY-LISTENING socket received over SCM_RIGHTS
+            # (fleet/handoff.py): the kernel accept queue — including
+            # connections that arrived while no process was accepting —
+            # transfers with the fd, which is what makes a planned
+            # engine swap zero-drop. bind_and_activate=False skips
+            # bind/listen; the placeholder socket is swapped for the fd.
+            self._httpd = ThreadingHTTPServer((host, port), handler,
+                                              bind_and_activate=False)
+            placeholder = self._httpd.socket
+            self._httpd.socket = socket.socket(fileno=listen_fd)
+            placeholder.close()
+            self._httpd.server_address = \
+                self._httpd.socket.getsockname()[:2]
+            self._httpd.server_name, self._httpd.server_port = \
+                self._httpd.server_address
         self._thread: Optional[threading.Thread] = None
         self._executors: List[threading.Thread] = []
         _SERVERS.add(self)
