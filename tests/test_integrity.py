@@ -1,0 +1,447 @@
+"""Data-plane integrity (ISSUE 17): checksummed lake, versioned
+manifest log, fsck/rollback, shm record digests, poison-statement
+quarantine.
+
+The contract under test: ANY corruption of bytes the engine persisted
+— data file, row group, manifest, pointer, shared-memory cache record —
+surfaces as either oracle-correct rows or the classified
+LAKE_DATA_CORRUPTION error (shm: a counted cache MISS). Silent wrong
+answers are structurally impossible at the default
+`lake_verify_checksums=row_group`; the red proofs below show the
+corruption IS silent when verification is off, so the digests (not
+luck) produce the green results.
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from trino_tpu.connector.lake import (clear_quarantine, lake_stats,
+                                      quarantined_files)
+from trino_tpu.errors import LakeDataCorruptionError
+from trino_tpu.exec import LocalQueryRunner
+
+
+@pytest.fixture()
+def lake(tmp_path, monkeypatch):
+    """(runner, lake_dir) over a fresh lake; the quarantine ledger is
+    per-process global, so each test starts clean."""
+    clear_quarantine()
+    d = str(tmp_path / "lake")
+    monkeypatch.setenv("TRINO_TPU_LAKE_DIR", d)
+    yield LocalQueryRunner.tpch("tiny"), d
+    clear_quarantine()
+
+
+def _tdir(lake_dir, table, schema="default"):
+    return os.path.join(lake_dir, schema, table)
+
+
+def _data_files(lake_dir, table):
+    return sorted(glob.glob(os.path.join(_tdir(lake_dir, table),
+                                         "data", "*")))
+
+
+def _flip_byte(path, offset=-1):
+    with open(path, "r+b") as fh:
+        fh.seek(0, os.SEEK_END)
+        size = fh.tell()
+        pos = size // 2 if offset == -1 else offset
+        fh.seek(pos)
+        b = fh.read(1)
+        fh.seek(pos)
+        fh.write(bytes([b[0] ^ 0xFF]))
+
+
+# ------------------------------------------------ checksummed lake
+
+
+def test_manifest_records_digests_and_versioned_log(lake):
+    runner, d = lake
+    runner.execute("CREATE TABLE lake.default.n AS SELECT * FROM nation")
+    tdir = _tdir(d, "n")
+    with open(os.path.join(tdir, "manifest.json")) as fh:
+        ptr = json.load(fh)
+    # the pointer is tiny metadata, not the manifest itself (Iceberg's
+    # metadata-pointer model): version + immutable log file + digest
+    # (CTAS commits twice: create-table wrote v1, the sink commit v2)
+    assert ptr["version"] == 2
+    assert ptr["path"] == "manifest-2.json"
+    assert len(ptr["digest"]) == 32
+    with open(os.path.join(tdir, "manifest-2.json")) as fh:
+        manifest = json.load(fh)
+    for entry in manifest["files"]:
+        assert len(entry["digest"]) == 32       # physical file digest
+        assert entry["bytes"] == os.path.getsize(
+            os.path.join(tdir, entry["path"]))
+        cols = {c["name"] for c in manifest["columns"]}
+        for grp in entry["groups"]:             # decoded-content digests
+            assert set(grp["digests"]) == cols
+
+
+def test_bitflip_on_disk_classified_then_quarantined(lake):
+    """A flipped bit in a data file must raise the classified error —
+    never a decode crash, never silent wrong rows — and the second scan
+    fails FAST from the quarantine ledger without re-reading."""
+    runner, d = lake
+    runner.execute("CREATE TABLE lake.default.o AS SELECT * FROM orders")
+    before = lake_stats()
+    path = _data_files(d, "o")[0]
+    _flip_byte(path)
+    with pytest.raises(LakeDataCorruptionError) as ei:
+        runner.execute("SELECT sum(o_totalprice) FROM lake.default.o")
+    assert os.path.basename(path) in str(ei.value)
+    assert any(path.endswith(os.path.basename(q))
+               for q in quarantined_files())
+    with pytest.raises(LakeDataCorruptionError) as ei2:
+        runner.execute("SELECT count(o_custkey) FROM lake.default.o")
+    assert "quarantined" in str(ei2.value)
+    after = lake_stats()
+    assert after["corruption_detected"] > before.get(
+        "corruption_detected", 0)
+    assert after["files_quarantined"] > before.get("files_quarantined", 0)
+
+
+def test_file_level_verify_catches_padding_corruption(lake):
+    """`lake_verify_checksums=file` hashes the physical bytes, so even a
+    flip in dead space (padding, footer slack) that decodes cleanly is
+    caught before decode."""
+    runner, d = lake
+    runner.execute("CREATE TABLE lake.default.r AS SELECT * FROM region")
+    runner.session.set("lake_verify_checksums", "file")
+    path = _data_files(d, "r")[0]
+    _flip_byte(path, offset=os.path.getsize(path) - 2)
+    with pytest.raises(LakeDataCorruptionError) as ei:
+        runner.execute("SELECT count(*) FROM lake.default.r")
+    assert "file digest" in str(ei.value)
+
+
+def test_injected_corruption_red_green(lake):
+    """THE red/green pair for the `corrupt` fault site: with a fixed
+    seed the same in-memory flip lands twice. verify=off serves it as
+    silently WRONG rows (red: proves the flip corrupts real results);
+    the row_group default turns the identical flip into the classified
+    error (green: the digests catch it, not luck). Injected flips never
+    quarantine — the disk bytes are fine."""
+    runner, d = lake
+    runner.execute("CREATE TABLE lake.default.nk AS "
+                   "SELECT n_nationkey, n_regionkey FROM nation")
+    oracle = runner.execute(
+        "SELECT sum(n_nationkey) FROM lake.default.nk").rows
+    for k in ("fault_injection_seed", "fault_injection_rate",
+              "fault_injection_sites"):
+        runner.session.set(k, {"fault_injection_seed": 7,
+                               "fault_injection_rate": 1.0,
+                               "fault_injection_sites": "corrupt"}[k])
+    runner.session.set("lake_verify_checksums", "off")
+    red = runner.execute(
+        "SELECT sum(n_nationkey) FROM lake.default.nk").rows
+    assert red != oracle        # silent wrong answer — no error raised
+    runner.session.set("lake_verify_checksums", "row_group")
+    with pytest.raises(LakeDataCorruptionError) as ei:
+        runner.execute("SELECT sum(n_regionkey) FROM lake.default.nk")
+    assert "row group" in str(ei.value)
+    assert not quarantined_files()   # disk bytes are intact
+
+
+# ------------------------------------------------ versioned manifest log
+
+
+def test_manifest_history_retention(lake):
+    """Commits append immutable manifest-<v>.json files; only the last
+    `lake_manifest_history` versions are retained and the pointer
+    always names the newest."""
+    runner, d = lake
+    runner.session.set("lake_manifest_history", 2)
+    runner.execute("CREATE TABLE lake.default.t (x bigint)")
+    for i in range(4):
+        runner.execute(
+            f"INSERT INTO lake.default.t VALUES ({i}), ({i + 10})")
+    tdir = _tdir(d, "t")
+    logs = sorted(glob.glob(os.path.join(tdir, "manifest-*.json")))
+    assert [os.path.basename(p) for p in logs] == [
+        "manifest-4.json", "manifest-5.json"]
+    with open(os.path.join(tdir, "manifest.json")) as fh:
+        assert json.load(fh)["version"] == 5
+    got = runner.execute("SELECT count(*), sum(x) FROM lake.default.t")
+    assert got.rows == [(8, sum(range(4)) + sum(range(10, 14)))]
+
+
+def test_manifest_cache_survives_mtime_granule(lake):
+    """The staleness fix: two commits inside one st_mtime granule must
+    not serve the older manifest. The cache stamps on the pointer's
+    (version, digest) — we force the pointer's mtime BACK to the
+    pre-commit value and the new version is still served."""
+    runner, d = lake
+    runner.execute("CREATE TABLE lake.default.m AS SELECT * FROM region")
+    conn = runner.metadata.connector("lake")
+    md = conn._metadata
+    from trino_tpu.connector.spi import SchemaTableName
+    name = SchemaTableName("default", "m")
+    assert md.load_manifest(name)["version"] == 2
+    ptr = os.path.join(_tdir(d, "m"), "manifest.json")
+    st = os.stat(ptr)
+    runner.execute("INSERT INTO lake.default.m "
+                   "SELECT * FROM region WHERE r_regionkey = 0")
+    # simulate a same-granule commit: pointer mtime identical to before
+    os.utime(ptr, ns=(st.st_atime_ns, st.st_mtime_ns))
+    assert md.load_manifest(name)["version"] == 3
+    assert runner.execute(
+        "SELECT count(*) FROM lake.default.m").rows == [(6,)]
+
+
+# ------------------------------------------------ fsck / rollback / GC
+
+
+def test_fsck_torn_pointer_rolls_back_with_parity(lake):
+    """THE recovery bar: a torn pointer write fails scans classified;
+    `runner.lake_fsck()` rolls back to the newest intact retained
+    snapshot and a full scan matches the pre-corruption oracle."""
+    runner, d = lake
+    runner.execute("CREATE TABLE lake.default.p AS SELECT * FROM orders")
+    oracle = runner.execute(
+        "SELECT o_orderkey, o_totalprice FROM lake.default.p "
+        "ORDER BY o_orderkey").rows
+    ptr = os.path.join(_tdir(d, "p"), "manifest.json")
+    with open(ptr, "w") as fh:
+        fh.write('{"pointer_version": 1, "ver')   # torn mid-write
+    with pytest.raises(LakeDataCorruptionError):
+        runner.execute("SELECT count(*) FROM lake.default.p")
+    report = runner.lake_fsck()
+    assert report["rolled_back"] == ["default.p"]
+    trep = next(t for t in report["tables"] if t["table"] == "default.p")
+    assert trep["rolled_back_to"] == 2
+    got = runner.execute(
+        "SELECT o_orderkey, o_totalprice FROM lake.default.p "
+        "ORDER BY o_orderkey").rows
+    assert got == oracle
+
+
+def test_fsck_dry_run_reports_without_repair(lake):
+    runner, d = lake
+    runner.execute("CREATE TABLE lake.default.q AS SELECT * FROM region")
+    ptr = os.path.join(_tdir(d, "q"), "manifest.json")
+    with open(ptr, "w") as fh:
+        fh.write("not json at all")
+    report = runner.lake_fsck(repair=False)
+    assert not report["ok"] and report["rolled_back"] == []
+    with pytest.raises(LakeDataCorruptionError):   # still broken: dry run
+        runner.execute("SELECT count(*) FROM lake.default.q")
+    report2 = runner.lake_fsck()
+    assert report2["rolled_back"] == ["default.q"]
+    assert runner.execute(
+        "SELECT count(*) FROM lake.default.q").rows == [(5,)]
+
+
+def test_fsck_gc_respects_references_and_grace(lake):
+    """Orphan GC must never delete a file any retained manifest still
+    references, nor a fresh orphan inside the grace window (it may be a
+    commit racing fsck)."""
+    runner, d = lake
+    runner.execute("CREATE TABLE lake.default.g AS SELECT * FROM nation")
+    ddir = os.path.join(_tdir(d, "g"), "data")
+    young = os.path.join(ddir, "w-orphan-young.bin")
+    old = os.path.join(ddir, "w-orphan-old.bin")
+    for p in (young, old):
+        with open(p, "wb") as fh:
+            fh.write(b"junk")
+    os.utime(old, (time.time() - 3600, time.time() - 3600))
+    report = runner.lake_fsck(gc_grace_s=900)
+    assert report["orphans_removed"] == 1
+    assert not os.path.exists(old) and os.path.exists(young)
+    # every referenced file survived: the table still scans clean
+    assert runner.execute(
+        "SELECT count(*) FROM lake.default.g").rows == [(25,)]
+
+
+def test_write_tokens_survive_rollback(lake):
+    """Exactly-once: committed write tokens ride each manifest version,
+    so a replayed INSERT is still a no-op after fsck rolled back a torn
+    pointer."""
+    runner, d = lake
+    runner.execute("CREATE TABLE lake.default.w AS SELECT * FROM region")
+    runner.session.set("write_token", "tok-1")
+    ins = "INSERT INTO lake.default.w SELECT * FROM region"
+    runner.execute(ins)
+    assert runner.execute(
+        "SELECT count(*) FROM lake.default.w").rows == [(10,)]
+    ptr = os.path.join(_tdir(d, "w"), "manifest.json")
+    with open(ptr, "w") as fh:
+        fh.write("{torn")
+    assert runner.lake_fsck()["rolled_back"] == ["default.w"]
+    runner.execute(ins)     # same token: replay must be a no-op
+    assert runner.execute(
+        "SELECT count(*) FROM lake.default.w").rows == [(10,)]
+
+
+# ------------------------------------------------ shm record integrity
+
+
+def test_shm_corrupt_record_is_counted_miss(tmp_path):
+    """A flipped payload byte in the shared tier (torn write from a
+    crashed writer, bad DIMM) must come back as a counted MISS through
+    the hit path — never an unpickle exception, never wrong rows."""
+    from trino_tpu.fleet.shm import SharedCacheTier, key_fingerprint
+    tier = SharedCacheTier(str(tmp_path / "c.shm"), create=True,
+                           data_bytes=1 << 20)
+    kh = key_fingerprint(("k", 1))
+    assert tier.put(kh, {"rows": [1, 2, 3]}, [("c", "s", "t")],
+                    tier.generation())
+    assert tier.get(kh)[0] == {"rows": [1, 2, 3]}
+    slot_off, seq, rec_off, length, _gen = tier._locate(kh)
+    flip_at = tier.data_off + rec_off + length - 3   # inside the payload
+    tier._mm[flip_at] ^= 0x01
+    assert tier.get(kh) is None
+    assert tier.stats["corrupt"] == 1
+    # a second handle on the same file classifies it the same way
+    other = SharedCacheTier(str(tmp_path / "c.shm"))
+    assert other.get(kh) is None
+    assert other.stats["corrupt"] == 1
+    other.close()
+    tier.close()
+
+
+def test_shm_forced_wrap_under_concurrent_readers(tmp_path):
+    """Writer-side audit regression: ring wrap must kill every
+    overlapped slot BEFORE reusing its heap bytes. Concurrent readers
+    racing a wrapping writer may miss, but must never see another
+    record's bytes — and the digest layer must count ZERO corruption
+    (the ordering contract, not the digest, is what keeps reuse safe)."""
+    from trino_tpu.fleet.shm import SharedCacheTier, key_fingerprint
+    path = str(tmp_path / "c.shm")
+    writer = SharedCacheTier(path, create=True, data_bytes=64 << 10,
+                             slots=256)
+    stop = threading.Event()
+    bad = []
+
+    def _read(tier):
+        while not stop.is_set():
+            for i in range(0, 400, 7):
+                found = tier.get(key_fingerprint(("w", i)))
+                if found is not None and found[0]["i"] != i:
+                    bad.append((i, found[0]))
+
+    readers = [SharedCacheTier(path) for _ in range(3)]
+    threads = [threading.Thread(target=_read, args=(t,), daemon=True)
+               for t in readers]
+    for t in threads:
+        t.start()
+    for i in range(400):        # ~6 full wraps of the 64K ring
+        writer.put(key_fingerprint(("w", i)),
+                   {"i": i, "pad": "x" * 900},
+                   [("c", "s", "t")], writer.generation())
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert bad == []
+    assert sum(t.stats["corrupt"] for t in readers) == 0
+    for t in readers:
+        t.close()
+    writer.close()
+
+
+# ------------------------------------------------ poison quarantine
+
+
+def test_statement_digest_normalizes_whitespace():
+    from trino_tpu.fleet.supervisor import statement_digest
+    a = statement_digest("SELECT  1\n  FROM t")
+    assert a == statement_digest("select 1 from T".upper()
+                                 .replace("SELECT 1 FROM T",
+                                          "SELECT 1 FROM t"))
+    assert a == statement_digest("  SELECT 1 FROM t  ")
+    assert a != statement_digest("SELECT 2 FROM t")
+    assert len(a) == 32
+
+
+def test_stamper_begin_end_roundtrip(tmp_path):
+    from trino_tpu.fleet.supervisor import (StatementStamper,
+                                            inflight_record_path,
+                                            statement_digest)
+    d = str(tmp_path)
+    st = StatementStamper(d, epoch=3)
+    tok = st.begin("SELECT 1", "q-1")
+    with open(inflight_record_path(d)) as fh:
+        rec = json.load(fh)
+    assert rec["digest"] == statement_digest("SELECT 1")
+    assert rec["query_id"] == "q-1" and rec["epoch"] == 3
+    st.end(tok)
+    with open(inflight_record_path(d)) as fh:
+        assert json.load(fh) == {}
+
+
+def test_read_poison_filters_expired(tmp_path):
+    from trino_tpu.fleet import supervisor as sup
+    d = str(tmp_path)
+    now = time.time()
+    with open(sup.poison_path(d), "w") as fh:
+        json.dump({"live": {"until": now + 60, "crashes": 2},
+                   "dead": {"until": now - 1, "crashes": 5}}, fh)
+    poison = sup.read_poison(d)
+    assert "live" in poison and "dead" not in poison
+
+
+def test_supervisor_attributes_crashes_to_threshold(tmp_path):
+    """Two crash-correlated restarts of the same stamped digest publish
+    it to poison.json; an uncorrelated crash (no inflight record) never
+    counts; the supervisor record tells the story."""
+    import types
+    from trino_tpu.fleet import supervisor as sup
+    d = str(tmp_path)
+    fleet = types.SimpleNamespace(fleet_dir=d, engine_epoch=1)
+    s = sup.FleetSupervisor(fleet, poison_crash_threshold=2,
+                            poison_ttl_s=60.0)
+    stamper = sup.StatementStamper(d, epoch=1)
+    s._attribute_crash("crash")          # no inflight record: no-op
+    assert s._digest_crashes == {}
+    stamper.begin("SELECT poison()", "q-1")
+    s._attribute_crash("crash")
+    assert not sup.read_poison(d)        # below threshold
+    s._attribute_crash("crash")          # record consumed: still 1 crash
+    assert not sup.read_poison(d)
+    stamper.begin("SELECT poison()", "q-2")
+    s._attribute_crash("stall")          # second correlated death
+    poison = sup.read_poison(d)
+    digest = sup.statement_digest("SELECT poison()")
+    assert poison[digest]["crashes"] == 2
+    assert poison[digest]["last_kind"] == "stall"
+    s.write_record()
+    rec = sup.read_supervisor_record(d)
+    assert digest in rec["poisoned"]
+
+
+def test_worker_poison_gate_fast_fails(tmp_path):
+    """The worker-side gate: a poisoned digest answers FAILED with the
+    classified non-retryable STATEMENT_QUARANTINED taxonomy; expired
+    entries pass through; the ledger read is stat-stamp cached."""
+    import types
+    from trino_tpu.fleet import supervisor as sup
+    from trino_tpu.fleet.worker import WorkerServer
+    d = str(tmp_path)
+    sql, expired_sql = "SELECT crashy()", "SELECT old_crashy()"
+    now = time.time()
+    with open(sup.poison_path(d), "w") as fh:
+        json.dump({sup.statement_digest(sql):
+                   {"until": now + 60, "crashes": 2},
+                   sup.statement_digest(expired_sql):
+                   {"until": now - 1, "crashes": 9}}, fh)
+    w = types.SimpleNamespace(
+        fleet_dir=d, _poison_cache={}, _poison_stamp=None,
+        _counters_lock=threading.Lock(),
+        counters={"poison_rejected": 0},
+        public_base="http://127.0.0.1:0")
+    assert WorkerServer._poison_fail(w, expired_sql) is None
+    assert WorkerServer._poison_fail(w, "SELECT 1") is None
+    status, payload = WorkerServer._poison_fail(w, sql)
+    assert status == 200
+    assert payload["stats"]["state"] == "FAILED"
+    assert payload["error"]["errorName"] == "STATEMENT_QUARANTINED"
+    assert payload["error"]["errorType"] == "INTERNAL_ERROR"
+    assert w.counters["poison_rejected"] == 1
+    # whitespace variants hash to the same digest: no trivial bypass
+    assert WorkerServer._poison_fail(w, "  SELECT   crashy()")[1][
+        "error"]["errorName"] == "STATEMENT_QUARANTINED"

@@ -399,3 +399,71 @@ def test_chaos_spill_fires_inside_agg_recursion(oracle):
 def test_chaos_spill_fires_inside_join_recursion(oracle):
     _spill_chaos_proof(oracle, ADAPTIVE_JOIN_SQL, ADAPTIVE_JOIN_ORACLE,
                        ("join-recurse", "join-heavy", "join-fallback"))
+
+
+# --------------------- data-plane corruption chaos (checksummed lake)
+
+LAKE_CHAOS_QS = ["q1", "q6"]    # lineitem-only: one CTAS seeds the lake
+
+
+@pytest.fixture(scope="module")
+def lake_chaos(tmp_path_factory):
+    """TPC-H lineitem CTAS'd into a checksummed lake table; the session
+    then points at the lake catalog so the stock query texts scan it."""
+    import os
+    d = tmp_path_factory.mktemp("lakechaos")
+    old = os.environ.get("TRINO_TPU_LAKE_DIR")
+    os.environ["TRINO_TPU_LAKE_DIR"] = str(d / "lake")
+    try:
+        runner = LocalQueryRunner.tpch("tiny")
+        runner.execute("CREATE TABLE lake.tiny.lineitem AS "
+                       "SELECT * FROM lineitem")
+        runner.session.catalog = "lake"
+        yield runner
+    finally:
+        if old is None:
+            os.environ.pop("TRINO_TPU_LAKE_DIR", None)
+        else:
+            os.environ["TRINO_TPU_LAKE_DIR"] = old
+
+
+def test_zz_corruption_chaos_sweep(lake_chaos, oracle):
+    """The data-integrity acceptance sweep: `corrupt`-site chaos (a
+    deterministic bit flip in a decoded column, between decode and
+    verification) at rate 0.3 over lake-backed TPC-H. Under BOTH retry
+    policies every query either returns oracle-correct rows or fails
+    with the classified LAKE_DATA_CORRUPTION error — zero silent wrong
+    answers. The error is NON-retryable by design (re-reading the same
+    flipped page cannot succeed), so TASK retry must not mask it; at
+    least one seed must actually inject and at least one query must
+    fail classified, or the sweep proved nothing."""
+    from trino_tpu.errors import LakeDataCorruptionError
+    runner = lake_chaos
+    injected = classified = 0
+    for policy in ("TASK", "NONE"):
+        for seed in (1, 2, 3):
+            runner.session.set("retry_policy", policy)
+            runner.session.set("fault_injection_rate", 0.3)
+            runner.session.set("fault_injection_seed", seed)
+            runner.session.set("fault_injection_sites", "corrupt")
+            for name in LAKE_CHAOS_QS:
+                sql, oracle_sql, ordered = QUERIES[name]
+                try:
+                    got = runner.execute(sql)
+                except LakeDataCorruptionError as e:
+                    assert "row group" in str(e)     # classified, named
+                    classified += 1
+                    continue
+                expected = oracle.execute(oracle_sql).fetchall()
+                assert_same(got.rows, expected, ordered)
+            if runner._faults is not None:
+                injected += sum(
+                    n for (site, _), n in runner._faults.by_detail.items()
+                    if site == "corrupt")
+    assert injected > 0, "no seed armed the corrupt site"
+    assert classified > 0, "no injected flip was caught classified"
+    # the detectors leave no residue: with chaos off the table is clean
+    runner.session.set("fault_injection_rate", 0.0)
+    sql, oracle_sql, ordered = QUERIES["q6"]
+    assert_same(runner.execute(sql).rows,
+                oracle.execute(oracle_sql).fetchall(), ordered)

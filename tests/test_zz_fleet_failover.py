@@ -388,3 +388,52 @@ def test_failover_metrics_surface(fo):
     sup = read_supervisor_record(fo.fleet_dir)
     assert sup["engine_restarts"]["planned"] >= 1
     assert sup["engine_restarts"]["crash"] >= 2
+
+
+def test_zz_poison_statement_stops_crash_loop(fo):
+    """Poison-statement quarantine end to end: a digest stamped in
+    flight across two crash-correlated engine restarts is published to
+    poison.json, the supervisor record tells the story, and the workers
+    then fast-fail the statement with the non-retryable
+    STATEMENT_QUARANTINED taxonomy instead of crash-looping the
+    replacement engine. Innocent statements keep executing."""
+    from trino_tpu.fleet import supervisor as sup
+    sql = "SELECT 41999 + 1"
+    digest = sup.statement_digest(sql)
+    for qid in ("q-poison-1", "q-poison-2"):
+        # the record going active races the supervisor swapping in the
+        # new Popen handle — wait for a LIVE engine process to murder
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline \
+                and fo.engine_proc.poll() is not None:
+            time.sleep(0.05)
+        assert fo.engine_proc.poll() is None
+        epoch = fo.engine_epoch
+        # stamp the statement in flight exactly as the engine-side
+        # observer does, then die before clearing it
+        sup.StatementStamper(fo.fleet_dir, epoch=epoch).begin(sql, qid)
+        os.kill(fo.engine_proc.pid, signal.SIGKILL)
+        _wait_engine_state(fo, epoch=epoch + 1)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline \
+            and digest not in sup.read_poison(fo.fleet_dir):
+        time.sleep(0.1)
+    rec = sup.read_poison(fo.fleet_dir)[digest]
+    assert rec["crashes"] >= 2 and rec["sql"] == sql
+    assert rec["query_id"] == "q-poison-2"
+    sup_rec = sup.read_supervisor_record(fo.fleet_dir)
+    assert digest in sup_rec["poisoned"]
+    # every worker fast-fails it now — the engine never sees it
+    for _ in range(3):
+        payload, _rows = _http(fo.base_uri, sql)
+        assert payload["stats"]["state"] == "FAILED"
+        assert payload["error"]["errorName"] == "STATEMENT_QUARANTINED"
+        assert payload["error"]["errorType"] == "INTERNAL_ERROR"
+    # an innocent statement still executes through the same fleet
+    payload2, rows2 = _http(fo.base_uri, "SELECT 2 + 2")
+    assert payload2["stats"]["state"] == "FINISHED"
+    assert rows2 == [[4]]
+    # the gauge surfaces on the fleet scrape
+    text = urllib.request.urlopen(
+        f"{fo.base_uri}/v1/metrics", timeout=10).read().decode()
+    assert "trino_tpu_fleet_poisoned_statements" in text

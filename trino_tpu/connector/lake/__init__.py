@@ -8,7 +8,11 @@ optional — the .npz native format is the dependency-free fallback).
 
 from trino_tpu.connector.lake.connector import (  # noqa: F401
     LakeConnector, LakeMetadata, LakePageSink, LakePageSource,
-    LakeSplitManager, create_connector, eligible_files, eligible_groups,
-    lake_stats, take_scan_stats)
+    LakeSplitManager, clear_quarantine, clear_verified, create_connector,
+    eligible_files, eligible_groups, lake_stats, quarantine_file,
+    quarantined_files, quarantined_reason, set_scan_options,
+    take_scan_stats)
 from trino_tpu.connector.lake.format import (  # noqa: F401
     HAVE_PYARROW, default_format)
+from trino_tpu.connector.lake.integrity import (  # noqa: F401
+    DEFAULT_GC_GRACE_S, lake_fsck)

@@ -30,13 +30,30 @@ Split model: splits index the PRUNED file list (recomputed
 deterministically from (manifest, constraint) on both the split-manager
 and page-source sides — stateless like every other connector here);
 split p of n reads files p, p+n, p+2n, ...
+
+Data-plane integrity (PR 17): every commit records blake2b content
+digests — per data file (physical bytes) and per (row group, column)
+(canonical decoded content, format.py) — and reads verify them under
+`lake_verify_checksums` (off / `row_group` default / `file`). A
+mismatch, torn write, or undecodable file raises the classified
+LAKE_DATA_CORRUPTION error (never a decode crash, never silent wrong
+rows) and quarantines the file in a per-process ledger so repeated
+scans fail fast with the path in the error. The manifest itself is a
+VERSIONED LOG (the Iceberg metadata-pointer model): each commit writes
+an immutable `manifest-<v>.json` plus an atomically-swapped pointer
+(`manifest.json`) carrying the version and the manifest's own digest;
+the last `lake_manifest_history` versions are retained for
+integrity.py's fsck rollback. Split contexts keep pinning the exact
+in-memory snapshot, so retention never tears a running query.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import math
 import os
+import re
 import shutil
 import threading
 import uuid
@@ -46,6 +63,7 @@ import numpy as np
 
 from trino_tpu import types as T
 from trino_tpu.connector.lake import format as F
+from trino_tpu.errors import LakeDataCorruptionError
 from trino_tpu.connector.spi import (
     ColumnHandle, ColumnMetadata, ColumnStatistics, Connector,
     ConnectorMetadata, ConnectorPageSink, ConnectorPageSource,
@@ -54,17 +72,105 @@ from trino_tpu.connector.spi import (
 from trino_tpu.page import Column, Dictionary, Page
 from trino_tpu.predicate import TupleDomain
 
-MANIFEST = "manifest.json"
+MANIFEST = "manifest.json"           # the atomically-swapped POINTER
 DATA_DIR = "data"
 _MAX_MANIFEST_TOKENS = 512
+# retained manifest versions (fsck rollback depth); session property
+# `lake_manifest_history` overrides per commit via set_commit_options
+DEFAULT_MANIFEST_HISTORY = 8
+# read-side verification level when the executor set none (the session
+# default is the same): "off" | "row_group" | "file"
+DEFAULT_VERIFY = "row_group"
+VERIFY_LEVELS = ("off", "row_group", "file")
+_MANIFEST_V = re.compile(r"manifest-(\d+)\.json$")
 
 # process-lifetime counters (obs/metrics.py gauges sample these)
 LAKE_STATS = {
     "files_written": 0, "files_scanned": 0, "files_pruned": 0,
     "row_groups_scanned": 0, "row_groups_pruned": 0,
     "manifest_commits": 0, "replayed_commits": 0, "aborted_writes": 0,
+    "corruption_detected": 0, "files_quarantined": 0,
 }
 _STATS_LOCK = threading.Lock()
+
+# per-process corruption quarantine: a file that failed verification
+# fails FAST on every later scan (path in the error) until fsck clears
+# it — repeated scans must not re-pay the read+hash of provably bad
+# bytes, and must never race one lucky page out of a flaky device
+_QUARANTINE: Dict[str, str] = {}
+_QUARANTINE_LOCK = threading.Lock()
+
+
+def quarantine_file(path: str, reason: str) -> None:
+    path = os.path.abspath(path)
+    with _QUARANTINE_LOCK:
+        fresh = path not in _QUARANTINE
+        _QUARANTINE[path] = reason
+    if fresh:
+        _count("files_quarantined")
+
+
+def quarantined_reason(path: str) -> Optional[str]:
+    with _QUARANTINE_LOCK:
+        return _QUARANTINE.get(os.path.abspath(path))
+
+
+def clear_quarantine(path: Optional[str] = None) -> None:
+    """Drop one path (fsck repaired/GC'd it) or the whole ledger."""
+    with _QUARANTINE_LOCK:
+        if path is None:
+            _QUARANTINE.clear()
+        else:
+            _QUARANTINE.pop(os.path.abspath(path), None)
+
+
+def quarantined_files() -> Dict[str, str]:
+    with _QUARANTINE_LOCK:
+        return dict(_QUARANTINE)
+
+
+# verified-content ledger: digests are checked ONCE per physical file
+# content — keyed on (path, st_mtime_ns, st_size), holding the
+# ("file",) marker and (group, column) pairs already proven clean. Data
+# files are immutable (commits write new files, never rewrite), so the
+# stamp only changes when the bytes change, and a warm scan re-pays
+# decode but not the hash. Deep re-verification is fsck's job
+# (`--scrub` / lake_fsck walk every digest regardless of this ledger);
+# an armed `corrupt` fault site also bypasses it — injected corruption
+# models a flip at THIS read, which the digests must catch every time.
+_VERIFIED: Dict[Tuple[str, int, int], set] = {}
+_VERIFIED_CAP = 8192     # files; wholesale reset beyond (re-verify)
+
+
+def _verified_stamp(path: str) -> Optional[Tuple[str, int, int]]:
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (path, st.st_mtime_ns, st.st_size)
+
+
+def _verified_seen(stamp) -> frozenset:
+    with _QUARANTINE_LOCK:
+        return frozenset(_VERIFIED.get(stamp) or ())
+
+
+def _verified_mark(stamp, marks) -> None:
+    if stamp is None or not marks:
+        return
+    with _QUARANTINE_LOCK:
+        if len(_VERIFIED) >= _VERIFIED_CAP and stamp not in _VERIFIED:
+            _VERIFIED.clear()
+        _VERIFIED.setdefault(stamp, set()).update(marks)
+
+
+def clear_verified(path: Optional[str] = None) -> None:
+    with _QUARANTINE_LOCK:
+        if path is None:
+            _VERIFIED.clear()
+        else:
+            for k in [k for k in _VERIFIED if k[0] == path]:
+                _VERIFIED.pop(k, None)
 
 # per-scan counters the executing query's thread accumulates across
 # get_splits + pages() and the executor drains into its collector
@@ -98,6 +204,143 @@ def take_scan_stats() -> Dict[str, int]:
 def lake_stats() -> Dict[str, int]:
     with _STATS_LOCK:
         return dict(LAKE_STATS)
+
+
+def set_scan_options(verify: Optional[str] = None,
+                     faults=None) -> None:
+    """Executor-thread scan options (same thread-local discipline as the
+    scan stats): the session's `lake_verify_checksums` level and the
+    query's FaultInjector (fault site `corrupt`). Unset/unknown level
+    falls back to DEFAULT_VERIFY, so a bare connector read — tests,
+    dictionary builds, paths that never saw a session — still verifies
+    at the default level."""
+    _TLS.verify = verify
+    _TLS.faults = faults
+
+
+def _scan_verify() -> str:
+    v = getattr(_TLS, "verify", None)
+    return v if v in VERIFY_LEVELS else DEFAULT_VERIFY
+
+
+def _scan_faults():
+    return getattr(_TLS, "faults", None)
+
+
+def _verified_read(tdir: str, entry: dict, fmt: str,
+                   all_names: Sequence[str], names: Sequence[str],
+                   groups: Sequence[int], group_rows: int
+                   ) -> Dict[str, Tuple[np.ndarray,
+                                        Optional[np.ndarray]]]:
+    """One data-file read under the integrity contract: quarantine
+    fast-fail, optional physical-digest check (`file` level), decode
+    with every exception classified (never a raw decode crash), the
+    `corrupt` fault site's deterministic in-memory bit flip, then
+    per-(row group, column) content verification (`row_group`+ levels,
+    once per file content via the verified ledger). Any mismatch
+    quarantines the file and raises the classified
+    LAKE_DATA_CORRUPTION error carrying the path."""
+    path = os.path.join(tdir, entry["path"])
+    reason = quarantined_reason(path)
+    if reason is not None:
+        raise LakeDataCorruptionError(
+            f"lake file quarantined after earlier corruption: {path} "
+            f"({reason}); run lake_fsck to repair")
+    verify = _scan_verify()
+    faults = _scan_faults()
+    injected = faults is not None and faults.consume("corrupt",
+                                                     entry["path"])
+    # the verified-content ledger never applies under an armed injector:
+    # the site models corruption at THIS read, past the storage stack
+    stamp = None if injected else _verified_stamp(path)
+    seen = _verified_seen(stamp) if stamp is not None else frozenset()
+    new_marks: List = []
+    if verify == "file" and entry.get("digest") and "file" not in seen:
+        try:
+            got_digest, got_bytes = F.file_digest(path)
+        except OSError as e:
+            quarantine_file(path, f"unreadable: {e}")
+            _count("corruption_detected")
+            raise LakeDataCorruptionError(
+                f"lake data file unreadable: {path} ({e})") from e
+        want_bytes = int(entry.get("bytes") or got_bytes)
+        if got_digest != entry["digest"] or got_bytes != want_bytes:
+            quarantine_file(path, "file digest mismatch")
+            _count("corruption_detected")
+            raise LakeDataCorruptionError(
+                f"lake data corruption: {path} file digest mismatch "
+                f"(recorded {entry['digest']}, read {got_digest})")
+        new_marks.append("file")
+    try:
+        got = F.read_groups(path, fmt, all_names, names, groups,
+                            group_rows=group_rows)
+    except Exception as e:   # noqa: BLE001 — NEVER a decode crash: a
+        # flipped bit in a compressed stream throws deep inside the
+        # codec; the contract is one classified error, path included
+        quarantine_file(path, f"undecodable: {e}")
+        _count("corruption_detected")
+        raise LakeDataCorruptionError(
+            f"lake data corruption: {path} is undecodable "
+            f"({type(e).__name__}: {e})") from e
+    if injected:
+        _flip_decoded(got, faults)
+    if verify in ("row_group", "file"):
+        meta = entry.get("groups") or []
+        off = 0
+        for g in groups:
+            rows = int(meta[g]["rows"]) if g < len(meta) else 0
+            digests = (meta[g].get("digests") or {}) \
+                if g < len(meta) else {}
+            for n in names:
+                want = digests.get(n)
+                if want is None or (g, n) in seen:
+                    continue    # pre-digest entry / already proven
+                arr, valid = got[n]
+                have = F.column_chunk_digest(
+                    arr[off:off + rows],
+                    None if valid is None else valid[off:off + rows])
+                if have != want:
+                    _count("corruption_detected")
+                    if not injected:
+                        # an injected flip corrupted MEMORY, not the
+                        # file — quarantining would poison good bytes
+                        quarantine_file(
+                            path, f"group {g} column {n!r} digest "
+                                  f"mismatch")
+                    raise LakeDataCorruptionError(
+                        f"lake data corruption: {path} row group {g} "
+                        f"column {n!r} digest mismatch (recorded "
+                        f"{want}, read {have})"
+                        + (" [injected]" if injected else ""))
+                new_marks.append((g, n))
+            off += rows
+    _verified_mark(stamp, new_marks)
+    return got
+
+
+def _flip_decoded(got, faults) -> None:
+    """Fault site `corrupt`: deterministically flip one BIT of one
+    decoded value (seeded — same seed, same statement sequence, same
+    flip), modeling corruption that slipped past the storage stack.
+    With verification on, the digest check above MUST catch it; with
+    `lake_verify_checksums = off` it flows into pages — the silent
+    wrong answer the chaos suite proves the default level prevents.
+    Targets the first fixed-width (non-string) column: a flipped string
+    would fault the shared-dictionary encode path instead of producing
+    the silently-wrong rows this site exists to model."""
+    for name in sorted(got):
+        arr, valid = got[name]
+        if len(arr) == 0 or arr.dtype.kind in ("U", "S", "O"):
+            continue
+        arr = arr.copy()
+        i = faults.draw_index(len(arr))
+        # high bit of the top byte: a LARGE perturbation (exponent bit
+        # for floats, ~2^62 for int64), so an unverified read is
+        # unmistakably wrong, not lost in float tolerance
+        view = arr.view(np.uint8).reshape(len(arr), arr.dtype.itemsize)
+        view[i, -1] ^= 0x40
+        got[name] = (arr, valid)
+        return
 
 
 # ------------------------------------------------------------ zone pruning
@@ -174,19 +417,26 @@ def _file_zones(groups: List[dict], names: Sequence[str]) -> dict:
 
 
 class LakeMetadata(ConnectorMetadata):
-    """Manifest-backed metadata. The manifest cache is keyed on the file
-    mtime+size so an external writer (another process sharing the
-    directory) is picked up without explicit invalidation."""
+    """Manifest-backed metadata over the versioned manifest log: commits
+    write an immutable `manifest-<v>.json` and atomically swap the
+    pointer (`manifest.json` — kept name, so table discovery is
+    unchanged) carrying the version plus the manifest's own digest. The
+    manifest cache is keyed on the pointer's (version, digest), so two
+    commits landing within one mtime granule can never serve stale
+    metadata; legacy single-file manifests fall back to the
+    (st_mtime_ns, size) stamp."""
 
     # the engine consults zone maps / constraint pruning for this
     # connector (gates the dynamic-filter handle augmentation too)
     supports_zone_maps = True
 
-    def __init__(self, base_dir: str, fmt: Optional[str] = None):
+    def __init__(self, base_dir: str, fmt: Optional[str] = None,
+                 manifest_history: int = DEFAULT_MANIFEST_HISTORY):
         self.base_dir = os.path.abspath(base_dir)
         os.makedirs(self.base_dir, exist_ok=True)
         self.default_format = F.validate_format(fmt) if fmt \
             else F.default_format()
+        self.manifest_history = max(1, int(manifest_history))
         self._lock = threading.RLock()
         self._cache: Dict[SchemaTableName, Tuple[tuple, dict]] = {}
         # per-(table, manifest version, column) string pools: every page
@@ -203,19 +453,66 @@ class LakeMetadata(ConnectorMetadata):
     def _manifest_path(self, name: SchemaTableName) -> str:
         return os.path.join(self.table_dir(name), MANIFEST)
 
+    def _version_path(self, name: SchemaTableName, version: int) -> str:
+        return os.path.join(self.table_dir(name),
+                            f"manifest-{int(version)}.json")
+
     def load_manifest(self, name: SchemaTableName) -> Optional[dict]:
         path = self._manifest_path(name)
         try:
             st = os.stat(path)
         except OSError:
             return None
-        stamp = (st.st_mtime_ns, st.st_size)
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+            pointer = json.loads(raw)
+        except (OSError, ValueError) as e:
+            raise LakeDataCorruptionError(
+                f"torn lake manifest pointer: {path} "
+                f"({type(e).__name__}: {e}); run lake_fsck to roll "
+                f"back") from e
+        if "columns" in pointer:
+            # legacy single-file manifest (pre-log layout): the pointer
+            # IS the manifest; (st_mtime_ns, size) stays its cache key
+            stamp = (st.st_mtime_ns, st.st_size)
+            with self._lock:
+                hit = self._cache.get(name)
+                if hit is not None and hit[0] == stamp:
+                    return hit[1]
+                self._cache[name] = (stamp, pointer)
+            return pointer
+        # stamp on the pointer's manifest VERSION (+ digest): mtime
+        # granularity can no longer alias two commits to one cache key
+        stamp = (int(pointer.get("version", 0)),
+                 str(pointer.get("digest") or ""))
         with self._lock:
             hit = self._cache.get(name)
             if hit is not None and hit[0] == stamp:
                 return hit[1]
-        with open(path) as f:
-            manifest = json.load(f)
+        vpath = os.path.join(self.table_dir(name),
+                             os.path.basename(str(pointer.get("path")
+                                                  or "")))
+        try:
+            with open(vpath, "rb") as f:
+                vraw = f.read()
+        except OSError as e:
+            raise LakeDataCorruptionError(
+                f"lake manifest missing: {vpath} (pointer names "
+                f"version {pointer.get('version')}); run lake_fsck to "
+                f"roll back") from e
+        digest = hashlib.blake2b(vraw, digest_size=16).hexdigest()
+        if pointer.get("digest") and digest != pointer["digest"]:
+            raise LakeDataCorruptionError(
+                f"lake manifest digest mismatch: {vpath} (pointer "
+                f"recorded {pointer['digest']}, read {digest}); run "
+                f"lake_fsck to roll back")
+        try:
+            manifest = json.loads(vraw)
+        except ValueError as e:
+            raise LakeDataCorruptionError(
+                f"lake manifest undecodable: {vpath} ({e}); run "
+                f"lake_fsck to roll back") from e
         with self._lock:
             self._cache[name] = (stamp, manifest)
         return manifest
@@ -226,16 +523,44 @@ class LakeMetadata(ConnectorMetadata):
             raise KeyError(f"lake table not found: {name}")
         return manifest
 
-    def _swap_manifest(self, name: SchemaTableName, manifest: dict) -> None:
-        """COMMIT: write tmp + os.replace — the atomic rename is the
-        whole transaction (readers see old or new, never torn)."""
+    def _swap_manifest(self, name: SchemaTableName, manifest: dict,
+                       history: Optional[int] = None) -> None:
+        """COMMIT: write the immutable `manifest-<v>.json`, then swap
+        the pointer with tmp + os.replace — the pointer rename is the
+        whole transaction (readers see old or new, never torn). The
+        last `history` versions are retained for fsck rollback; older
+        log files are pruned (running queries pin the in-memory
+        manifest SNAPSHOT via their split context, so pruning a file
+        never tears a scan)."""
+        version = int(manifest.get("version", 0))
+        vpath = self._version_path(name, version)
+        raw = json.dumps(manifest).encode()
+        tmp = f"{vpath}.tmp.{uuid.uuid4().hex[:8]}"
+        with open(tmp, "wb") as f:
+            f.write(raw)
+        os.replace(tmp, vpath)
+        pointer = {"pointer_version": 1, "version": version,
+                   "path": os.path.basename(vpath),
+                   "digest": hashlib.blake2b(raw,
+                                             digest_size=16).hexdigest()}
         path = self._manifest_path(name)
         tmp = f"{path}.tmp.{uuid.uuid4().hex[:8]}"
         with open(tmp, "w") as f:
-            json.dump(manifest, f)
+            json.dump(pointer, f)
         os.replace(tmp, path)
         with self._lock:
             self._cache.pop(name, None)
+        keep = max(1, int(history if history is not None
+                          else self.manifest_history))
+        floor = version - keep
+        if floor >= 0:
+            try:
+                for entry in os.scandir(self.table_dir(name)):
+                    m = _MANIFEST_V.match(entry.name)
+                    if m and int(m.group(1)) <= floor:
+                        os.remove(entry.path)
+            except OSError:
+                pass
 
     # ----------------------------------------------------------- listing
 
@@ -401,9 +726,9 @@ class LakeMetadata(ConnectorMetadata):
             ngroups = len(entry.get("groups") or [])
             if ngroups == 0:
                 continue
-            got = F.read_groups(os.path.join(tdir, entry["path"]), fmt,
-                                all_names, [column], list(range(ngroups)),
-                                group_rows=group_rows)
+            got = _verified_read(tdir, entry, fmt, all_names, [column],
+                                 list(range(ngroups)),
+                                 group_rows=group_rows)
             arr, valid = got[column]
             arr = np.asarray(arr, dtype=object)
             if valid is not None:
@@ -474,9 +799,9 @@ class LakePageSource(ConnectorPageSource):
                 continue
             _count("files_scanned")
             _count("row_groups_scanned", len(groups))
-            got = F.read_groups(os.path.join(tdir, entry["path"]), fmt,
-                                all_names, [c.name for c in columns],
-                                groups, group_rows=group_rows)
+            got = _verified_read(tdir, entry, fmt, all_names,
+                                 [c.name for c in columns], groups,
+                                 group_rows=group_rows)
             arrays = [got[c.name] for c in columns]
             rows = len(arrays[0][0]) if arrays else 0
             off = 0
@@ -547,6 +872,13 @@ class LakePageSink(ConnectorPageSink):
                                             F.DEFAULT_ROW_GROUP_ROWS))
         self._staged: List[List] = [[] for _ in self._types]
         self._written: List[str] = []
+        self._history: Optional[int] = None
+
+    def set_commit_options(self, history: Optional[int] = None) -> None:
+        """Executor hook: session `lake_manifest_history` for THIS commit
+        (retained manifest-log depth). getattr-gated at the call site so
+        the SPI sink surface is unchanged."""
+        self._history = None if history is None else max(1, int(history))
 
     def append_page(self, page: Page):
         n = int(page.num_rows)
@@ -620,8 +952,17 @@ class LakePageSink(ConnectorPageSink):
                 self._written.append(path)
                 groups = F.build_zones(self._names, parrs, pvals,
                                        group_rows=self._group_rows)
+                # content digests recorded AT COMMIT: file digest over
+                # the physical bytes just written, group digests over the
+                # canonical decoded content (codec-independent)
+                fdigest, fbytes = F.file_digest(path)
+                for grp, dg in zip(groups, F.build_digests(
+                        self._names, parrs, pvals,
+                        group_rows=self._group_rows)):
+                    grp["digests"] = dg
                 entries.append({
                     "path": fname, "rows": nrows,
+                    "digest": fdigest, "bytes": fbytes,
                     "partition": pv,
                     "file_zones": _file_zones(groups, self._names),
                     "groups": groups,
@@ -647,7 +988,8 @@ class LakePageSink(ConnectorPageSink):
                 manifest["committed_tokens"] = \
                     tokens[-_MAX_MANIFEST_TOKENS:]
             manifest["version"] = int(manifest.get("version", 0)) + 1
-            md._swap_manifest(self._name, manifest)
+            md._swap_manifest(self._name, manifest,
+                              history=self._history)
         self._written = []
         _count("manifest_commits")
         _count("files_written", len(entries))
@@ -687,6 +1029,20 @@ class LakeConnector(Connector):
     @staticmethod
     def take_scan_stats() -> Dict[str, int]:
         return take_scan_stats()
+
+    # executor hook: session verify level + the query's fault injector
+    # ride a thread-local down to the read path (the SPI scan signature
+    # carries no session)
+    @staticmethod
+    def set_scan_options(verify: Optional[str] = None,
+                         faults=None) -> None:
+        set_scan_options(verify=verify, faults=faults)
+
+    def fsck(self, **kwargs) -> dict:
+        """pointer → manifest → files → row-groups integrity walk with
+        rollback + orphan GC (connector/lake/integrity.py)."""
+        from trino_tpu.connector.lake.integrity import lake_fsck
+        return lake_fsck(self._metadata, **kwargs)
 
 
 def create_connector(base_dir: Optional[str] = None,

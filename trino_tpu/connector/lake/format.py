@@ -27,6 +27,7 @@ re-derives semantics from the file dtype.
 
 from __future__ import annotations
 
+import hashlib
 import math
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -125,6 +126,96 @@ def build_zones(names: Sequence[str], arrays: Sequence[np.ndarray],
     return groups
 
 
+# ---------------------------------------------------------------- digests
+#
+# Two digest kinds, both blake2b (16 bytes, hex), recorded in the
+# manifest at sink-commit time:
+#
+#   file digest   over the PHYSICAL file bytes as written — catches any
+#                 on-disk flip, including in columns/groups a pruned
+#                 read never touches (`lake_verify_checksums = file`).
+#   group digest  per (row group, column) over the CANONICAL decoded
+#                 content — verified against the arrays the reader just
+#                 decoded, so it is end-to-end (disk flip, torn write,
+#                 codec bug alike) and works under column + row-group
+#                 pruning. Canonical means codec-independent: parquet
+#                 and npz round-trip the same values through different
+#                 physical dtypes (object vs fixed-width unicode,
+#                 per-group null masks that collapse to None), so the
+#                 encoding below normalizes before hashing.
+
+
+def file_digest(path: str) -> Tuple[str, int]:
+    """(hex digest, byte size) of the physical file contents."""
+    h = hashlib.blake2b(digest_size=16)
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            size += len(chunk)
+            h.update(chunk)
+    return h.hexdigest(), size
+
+
+def column_chunk_digest(arr: np.ndarray,
+                        valid: Optional[np.ndarray]) -> str:
+    """Canonical content digest of one (row group, column) chunk."""
+    h = hashlib.blake2b(digest_size=16)
+    mask = None
+    if valid is not None:
+        mask = np.asarray(valid, dtype=bool)
+        if mask.all():
+            mask = None    # an all-valid mask reads back as None
+    if arr.dtype.kind in ("U", "S", "O"):
+        # null slots are stored filled with "" by both codecs, but only
+        # positions the mask marks live feed the hash — the fill value
+        # must not leak representation differences into the digest
+        for i, v in enumerate(arr):
+            if mask is not None and not mask[i]:
+                h.update(b"\x00n")
+                continue
+            b = str(v).encode("utf-8", "surrogatepass")
+            h.update(len(b).to_bytes(4, "little"))
+            h.update(b)
+    else:
+        kind = arr.dtype.kind
+        if kind == "b":
+            vals = np.asarray(arr, dtype=np.uint8)
+        elif kind in ("i", "u"):
+            vals = np.asarray(arr, dtype=np.int64)
+        else:
+            vals = np.asarray(arr, dtype=np.float64)
+        if mask is not None:
+            vals = np.where(mask, vals, vals.dtype.type(0))
+        h.update(kind.encode())
+        # zero-copy: hash the array buffer directly — the verify path
+        # runs this on every row group of every warm scan
+        if not vals.flags.c_contiguous:
+            vals = np.ascontiguousarray(vals)
+        h.update(vals.data)
+    if mask is not None:
+        h.update(b"m")
+        h.update(np.packbits(mask).tobytes())
+    return h.hexdigest()
+
+
+def build_digests(names: Sequence[str], arrays: Sequence[np.ndarray],
+                  valids: Sequence[Optional[np.ndarray]],
+                  group_rows: int = DEFAULT_ROW_GROUP_ROWS
+                  ) -> List[Dict[str, str]]:
+    """Per-row-group {column: digest} maps, aligned with build_zones."""
+    rows = len(arrays[0]) if arrays else 0
+    out = []
+    for lo, hi in group_ranges(rows, group_rows):
+        out.append({
+            name: column_chunk_digest(
+                arr[lo:hi], None if valid is None else valid[lo:hi])
+            for name, arr, valid in zip(names, arrays, valids)})
+    return out
+
+
 # ------------------------------------------------------------------ write
 
 
@@ -200,7 +291,10 @@ def read_groups(path: str, fmt: str, all_names: Sequence[str],
     """Read the requested columns of the ELIGIBLE row groups of one data
     file, concatenated in group order: {name: (values, valid|None)}.
     Parquet reads only the named groups from disk; npz reads the file
-    once and slices the group ranges."""
+    once and slices the group ranges. Content verification happens in
+    the CONNECTOR (connector.py `_verified_read`) against the decoded
+    arrays this returns — one detection path for on-disk flips and
+    injected in-memory corruption alike."""
     if not names:
         return {}
     if fmt == "parquet":

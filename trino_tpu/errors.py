@@ -61,6 +61,20 @@ COMPILER_ERROR = ErrorCode("COMPILER_ERROR", 65543, INTERNAL_ERROR)
 # the supervisor respawns it, so a client retry lands on the replacement
 ENGINE_UNAVAILABLE = ErrorCode("ENGINE_UNAVAILABLE", 65544, INTERNAL_ERROR,
                                retryable=True)
+# poison-statement quarantine (fleet/supervisor.py): this statement's
+# digest was in flight across K crash-correlated engine restarts, so
+# workers fast-fail it for the quarantine TTL. NOT retryable — a replay
+# is exactly what would crash-loop the engine again.
+STATEMENT_QUARANTINED = ErrorCode("STATEMENT_QUARANTINED", 65546,
+                                  INTERNAL_ERROR)
+
+# ------------------------------------------------------- EXTERNAL (0x1000000)
+# a lake read failed content verification (checksum mismatch, torn
+# manifest/pointer, undecodable file): the bytes on storage are wrong,
+# which no re-run fixes — NOT retryable. Detection is the contract:
+# corruption classifies here instead of surfacing as a decode crash or,
+# worse, silently wrong rows.
+LAKE_DATA_CORRUPTION = ErrorCode("LAKE_DATA_CORRUPTION", 16777216, EXTERNAL)
 
 # --------------------------------------------- INSUFFICIENT_RESOURCES (0x20000)
 GENERIC_INSUFFICIENT_RESOURCES = ErrorCode(
@@ -142,6 +156,21 @@ class ExchangeTransportError(TrinoError):
 
 class QueryQueueFullError(TrinoError):
     CODE = QUERY_QUEUE_FULL
+
+
+class LakeDataCorruptionError(TrinoError):
+    """A lake read (data file, row group, manifest, or pointer) failed
+    content verification. The message carries the file path so an
+    operator can go straight from the error to `lake_fsck`."""
+
+    CODE = LAKE_DATA_CORRUPTION
+
+
+class StatementQuarantinedError(TrinoError):
+    """Fast-fail for a statement digest the fleet supervisor attributed
+    K crash-correlated engine restarts to (bounded quarantine TTL)."""
+
+    CODE = STATEMENT_QUARANTINED
 
 
 class InvalidSessionPropertyError(TrinoError, KeyError):

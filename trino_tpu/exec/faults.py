@@ -32,7 +32,7 @@ from typing import Dict, Optional, Tuple
 from trino_tpu.errors import CLUSTER_OUT_OF_MEMORY, InjectedFault
 
 SITES = ("fragment", "exchange", "scan", "spill", "memory", "slice",
-         "engine")
+         "engine", "corrupt")
 
 
 class InjectedMemoryPressure(InjectedFault):
@@ -114,6 +114,28 @@ class FaultInjector:
                 self._rng.randrange(len(self._site_skips))]
             self._armed = name
             self._skip = skip
+
+    def consume(self, site: str, detail: str = "") -> bool:
+        """Non-raising variant of `site`: same armed/skip/count logic,
+        but returns True instead of raising — for sites whose failure
+        mode is DATA (site `corrupt` flips a decoded bit in the lake
+        read path) rather than a thrown fault."""
+        if self._armed != site:
+            return False
+        if self._skip > 0:
+            self._skip -= 1
+            return False
+        self._armed = None
+        self.injected += 1
+        self.by_site[site] = self.by_site.get(site, 0) + 1
+        self.by_detail[(site, detail)] = \
+            self.by_detail.get((site, detail), 0) + 1
+        return True
+
+    def draw_index(self, n: int) -> int:
+        """Deterministic index draw for an armed site's payload (which
+        element of a decoded column the `corrupt` flip lands on)."""
+        return self._rng.randrange(max(1, int(n)))
 
     def site(self, site: str, detail: str = "") -> None:
         """Execution passes a named fault site; raises iff armed for it

@@ -551,6 +551,15 @@ class LocalExecutionPlanner:
                     and tcache.should_promote(tkey, col_names)
             staging = [] if (key is not None and not dyn_applied) \
                 or promote else None
+            # session verify level + the query's fault injector ride a
+            # connector thread-local down to the read path (the SPI scan
+            # signature carries no session); reset in the finally so a
+            # later bare read on this thread falls back to the default
+            setopt = getattr(conn, "set_scan_options", None)
+            if setopt is not None:
+                setopt(verify=str(self.session.get(
+                           "lake_verify_checksums")),
+                       faults=self.faults)
             try:
                 for split in splits:
                     self._fault_site("scan", str(node.table))
@@ -564,6 +573,8 @@ class LocalExecutionPlanner:
                         yield page
             finally:
                 self._drain_scan_stats(conn)
+                if setopt is not None:
+                    setopt()
             if staging is not None and key is not None and not dyn_applied:
                 # gen_seen guards the race with a concurrent INSERT: a
                 # scan that started pre-change must not publish post-
@@ -3382,6 +3393,10 @@ class LocalExecutionPlanner:
         order = [lay[s.name] for s in node.column_symbols]
         conn = self.metadata.connector(node.catalog)
         sink = conn.page_sink(node.table, write_token=self.write_token)
+        if hasattr(sink, "set_commit_options"):
+            # session manifest-log retention depth rides to the commit
+            sink.set_commit_options(history=int(self.session.get(
+                "lake_manifest_history")))
 
         def gen():
             # idempotent-write protocol (connector/spi.py): pages STAGE

@@ -158,6 +158,13 @@ class LocalQueryRunner:
         # the per-query QueryStatsCollector (obs/stats.py): phases,
         # output rows/bytes, jit hit/miss, spill bytes, operator stats
         self._collector = None
+        # statement observer (fleet/supervisor.StatementStamper in the
+        # fleet's engine child): begin(sql, query_id) before execution,
+        # end(token) after — the crash-attribution stamp the poison
+        # quarantine rides on. Intentionally SHARED with for_query()
+        # clones (copy.copy keeps the reference): the server's per-query
+        # clones must stamp through the engine-wide observer
+        self._statement_observer = None
         # Chrome-trace export directory (TrinoServer(trace_dir=...) /
         # $TRINO_TPU_TRACE_DIR); None defers to the session's
         # trace_export property with a tempdir default
@@ -269,6 +276,16 @@ class LocalQueryRunner:
         # hits/misses (each runs on its own executor thread)
         self._collector = QueryStatsCollector(info.query_id)
         jit_cache.set_observer(self._collector)
+        # stamp the statement in flight BEFORE any work that could kill
+        # the process; cleared in the finally. Observer failures must
+        # never fail the query — the stamp is advisory telemetry
+        obs = self._statement_observer
+        obs_token = None
+        if obs is not None:
+            try:
+                obs_token = obs.begin(sql, info.query_id)
+            except Exception:   # noqa: BLE001
+                obs_token = None
         TRACKER.running(info)
         try:
             # fault-tolerance setup INSIDE the try: a malformed session
@@ -407,6 +424,11 @@ class LocalQueryRunner:
             self._deadline = None
             self._sink = None
             jit_cache.set_observer(None)
+            if obs is not None:
+                try:
+                    obs.end(obs_token)
+                except Exception:   # noqa: BLE001
+                    pass
         self._finish_query_stats(info)
         self._close_memory(info, failed=False)
         TRACKER.finish(info, result.reported_rows)
@@ -433,6 +455,27 @@ class LocalQueryRunner:
                 f"{ {k: v for k, v in ctx.by_tag.items() if v} })")
             NODE_POOL.record_leak(leaked)
         self._memory = None
+
+    def lake_fsck(self, catalog: str = "lake", **kwargs) -> dict:
+        """Run the lake integrity walk (connector/lake/integrity.py):
+        verify pointer -> manifest -> files -> row groups, roll back a
+        torn/corrupt pointer to the newest intact retained snapshot,
+        GC orphan files past the grace age. Returns the report dict.
+        kwargs: repair, deep, gc, gc_grace_s."""
+        conn = self.metadata.connector(catalog)
+        fsck = getattr(conn, "fsck", None)
+        if fsck is None:
+            raise ValueError(
+                f"catalog {catalog!r} does not support fsck")
+        report = fsck(**kwargs)
+        # repaired tables may have rolled the manifest back: every cache
+        # keyed on table state (plans, results, scan pages, device
+        # columns) must drop through the standard invalidation fan-out
+        for trep in report.get("tables", ()):
+            if trep.get("rolled_back_to") is not None:
+                schema, table = trep["table"].split(".", 1)
+                self._plan_cache.invalidate((catalog, schema, table))
+        return report
 
     def cancel_current(self) -> None:
         """Cancel the in-flight query (no-op when idle): sets the cancel

@@ -152,6 +152,14 @@ class EngineProcess:
                      "table_cache_enabled"):
             runner.session.set(prop, True)
         self.runner = runner
+        # poison-quarantine stamp: every statement this engine begins
+        # executing writes its digest into the fleet dir's scratch
+        # record (cleared at statement end), so a crash mid-statement
+        # is attributable — the supervisor counts crash-correlated
+        # restarts per digest and quarantines repeat offenders
+        from trino_tpu.fleet.supervisor import StatementStamper
+        runner._statement_observer = StatementStamper(self.fleet_dir,
+                                                      epoch=self.epoch)
         # the shared tier survives engine death (it's a file owned by
         # the parent): attach, don't create — generation counters and
         # live entries carry over, and the MirroredResultSetCache

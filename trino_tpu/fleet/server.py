@@ -146,6 +146,8 @@ class FleetServer:
                  forward_retries: int = 3,
                  forward_backoff_s: float = 0.05,
                  handoff_enabled: bool = True,
+                 poison_crash_threshold: int = 2,
+                 poison_ttl_s: float = 300.0,
                  **engine_kwargs):
         # a caller-supplied runner can only live in THIS process, so it
         # implies the in-process engine; otherwise the engine defaults
@@ -172,6 +174,8 @@ class FleetServer:
         self.forward_retries = int(forward_retries)
         self.forward_backoff_s = float(forward_backoff_s)
         self.handoff_enabled = bool(handoff_enabled)
+        self.poison_crash_threshold = int(poison_crash_threshold)
+        self.poison_ttl_s = float(poison_ttl_s)
         self.warmup_manifest = warmup_manifest
         self.engine_kwargs = engine_kwargs
         self._owns_dir = fleet_dir is None
@@ -298,7 +302,9 @@ class FleetServer:
             probe_timeout_s=self.probe_timeout_s,
             stall_probes=self.engine_stall_probes,
             worker_respawn_max=self.worker_respawn_max,
-            respawn_backoff_s=self.respawn_backoff_s).start()
+            respawn_backoff_s=self.respawn_backoff_s,
+            poison_crash_threshold=self.poison_crash_threshold,
+            poison_ttl_s=self.poison_ttl_s).start()
         return self
 
     def _keying_context_local(self) -> Dict:

@@ -23,7 +23,12 @@ sequence number that goes odd while the slot (or the data it points at)
 is being rewritten, so a reader that raced a writer re-reads the
 sequence after copying the payload and retries/misses on a mismatch.
 Torn data is additionally caught by the key hash embedded at the front
-of every data record.
+of every data record, and (v2) by a blake2b content digest embedded per
+record: an entry whose payload bytes do not hash to the recorded digest
+— a torn write that beat the seqlock, a flipped bit in the backing file,
+a record half-overwritten by a crashed writer — is a COUNTED cache miss
+(stats["corrupt"], exported as trino_tpu_fleet_shm_corrupt_total), never
+an unpickle exception through a worker's hit path.
 
 Invalidation reuses the `_GenerationGuard` discipline from
 exec/plan_cache.py, lifted across process boundaries: `generation()`
@@ -51,7 +56,12 @@ import time
 from typing import Any, Iterable, Optional, Tuple
 
 MAGIC = b"TPUFLEET"
-VERSION = 1
+# v2: data records carry a blake2b-16 payload digest between the length
+# and the pickled payload (record = key_hash16 + len u32 + digest16 +
+# payload). Version-checked at map time, so a v1 file from an older
+# fleet process is rejected, not misread.
+VERSION = 2
+_REC_OVERHEAD = 36      # key_hash(16) + len(4) + digest(16)
 
 HEADER_FMT = "<8sIIIIQQQQQQQ"           # magic, ver, slots, tslots, qslots,
 HEADER_SIZE = 128                       # data_off, data_size, head, gen,
@@ -116,7 +126,8 @@ class SharedCacheTier:
         self.quota_off = self.slot_off + self.slots * SLOT_REC
         # process-local traffic counters (obs gauges; fleet status)
         self.stats = {"hits": 0, "misses": 0, "puts": 0, "put_rejects": 0,
-                      "invalidations": 0, "quota_rejections": 0}
+                      "invalidations": 0, "quota_rejections": 0,
+                      "corrupt": 0}
 
     @staticmethod
     def _create(path, slots, table_slots, quota_slots, data_bytes):
@@ -237,7 +248,9 @@ class SharedCacheTier:
         table since then rejects the publish (stale-publish guard)."""
         tables = tuple(sorted(tuple(tk) for tk in tables))
         payload = pickle.dumps((tables, entry), protocol=4)
-        record = key_hash + struct.pack("<I", len(payload)) + payload
+        record = (key_hash + struct.pack("<I", len(payload))
+                  + hashlib.blake2b(payload, digest_size=16).digest()
+                  + payload)
         if len(record) > self.data_size // 2:
             return False    # one oversized result must not wipe the ring
         with self._locked(self):
@@ -260,7 +273,16 @@ class SharedCacheTier:
         """Ring-allocate `n` contiguous bytes in the data region; any
         live slot whose record the allocation (or a wrap skip) would
         overwrite is killed first, so a concurrent reader can only ever
-        observe a bumped sequence, never silently-swapped bytes."""
+        observe a bumped sequence, never silently-swapped bytes.
+
+        ORDERING CONTRACT (writer-side integrity): _kill_overlaps_locked
+        runs — bumping each overlapped slot's seq and zeroing its length
+        — strictly BEFORE the caller writes the new record's bytes into
+        the heap range this returns. A reader racing the wrap therefore
+        either sees the old seq with the old intact bytes, or the bumped
+        seq (retry/miss); it can never validate old slot metadata
+        against new heap bytes. test_integrity.py forces a ring wrap
+        under concurrent readers to pin this ordering."""
         head = self._u64(self._OFF_HEAD)
         start = head % self.data_size
         ranges = []
@@ -345,12 +367,25 @@ class SharedCacheTier:
             if raw[:16] != key_hash:
                 continue
             (paylen,) = struct.unpack_from("<I", raw, 16)
-            if paylen != length - 20:
+            if paylen != length - _REC_OVERHEAD:
                 continue
+            payload = raw[_REC_OVERHEAD:]
+            # content digest: the seq re-check above proved the bytes
+            # were STABLE during the copy, so a mismatch here is real
+            # corruption (torn write from a crashed writer, flipped bit
+            # in the backing file) — a counted miss, never an unpickle
+            # crash through the hit path, and no point retrying
+            if hashlib.blake2b(payload, digest_size=16).digest() \
+                    != raw[20:36]:
+                self.stats["corrupt"] += 1
+                self.stats["misses"] += 1
+                return None
             try:
-                tables, entry = pickle.loads(raw[20:])
-            except Exception:   # torn record that beat the seq check
-                continue
+                tables, entry = pickle.loads(payload)
+            except Exception:   # digest-clean yet undecodable (pickle
+                self.stats["corrupt"] += 1      # written by a buggy or
+                self.stats["misses"] += 1       # incompatible writer)
+                return None
             if not self._entry_valid(put_gen, tables):
                 self.stats["misses"] += 1
                 return None

@@ -26,14 +26,18 @@ outage seconds that workers surface as `trino_tpu_engine_restarts_total`
 
 from __future__ import annotations
 
+import hashlib
 import http.client
 import json
+import logging
 import os
 import signal
 import tempfile
 import threading
 import time
 from typing import Dict, Optional
+
+_LOG = logging.getLogger("trino_tpu.fleet.supervisor")
 
 
 def supervisor_record_path(fleet_dir: str) -> str:
@@ -48,25 +52,121 @@ def read_supervisor_record(fleet_dir: str) -> Optional[Dict]:
         return None
 
 
+# --------------------------------------------- poison-statement quarantine
+#
+# The failure mode: one statement deterministically crashes the engine
+# (a compiler bug, a pathological plan OOM-killing the device runtime).
+# Crash recovery alone turns that into a crash LOOP — the client retries
+# (ENGINE_UNAVAILABLE is retryable), the replacement engine re-executes
+# the same statement, dies again. The quarantine breaks the loop: the
+# engine stamps the digest of every statement it begins into an
+# epoch-scoped scratch record; the supervisor attributes crash/stall
+# restarts to whatever digest was in flight; after
+# `poison_crash_threshold` correlated restarts the digest lands in
+# `<fleet_dir>/poison.json` and workers fast-fail it with the
+# non-retryable STATEMENT_QUARANTINED error until the TTL expires.
+
+_INFLIGHT = "engine_inflight.json"
+_POISON = "poison.json"
+DEFAULT_POISON_CRASH_THRESHOLD = 2
+DEFAULT_POISON_TTL_S = 300.0
+
+
+def statement_digest(sql: str) -> str:
+    """Whitespace-normalized statement digest (retries and re-submits of
+    the same text correlate even across formatting differences)."""
+    canon = " ".join(str(sql).split())
+    return hashlib.blake2b(canon.encode(), digest_size=16).hexdigest()
+
+
+def inflight_record_path(fleet_dir: str) -> str:
+    return os.path.join(fleet_dir, _INFLIGHT)
+
+
+def poison_path(fleet_dir: str) -> str:
+    return os.path.join(fleet_dir, _POISON)
+
+
+def _atomic_write_json(path: str, record: dict) -> None:
+    try:
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                   prefix=".tmp-")
+        with os.fdopen(fd, "w") as fh:
+            json.dump(record, fh)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def read_poison(fleet_dir: str, now: Optional[float] = None
+                ) -> Dict[str, dict]:
+    """Live (non-expired) poison ledger: {digest: {until, crashes,
+    sql, ...}}."""
+    now = time.time() if now is None else now
+    try:
+        with open(poison_path(fleet_dir)) as fh:
+            raw = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    return {d: rec for d, rec in raw.items()
+            if isinstance(rec, dict) and float(rec.get("until", 0)) > now}
+
+
+class StatementStamper:
+    """Engine-side statement observer (attached as the runner's
+    `_statement_observer`): stamps each statement's digest into the
+    fleet dir BEFORE execution and clears it after — so when the engine
+    dies mid-statement, the scratch record names the statement that
+    killed it. Epoch-scoped: a record written by a previous engine
+    incarnation is ignored by attribution (the supervisor consumed and
+    cleared it during that incarnation's restart)."""
+
+    def __init__(self, fleet_dir: str, epoch: int = 0):
+        self.fleet_dir = fleet_dir
+        self.epoch = int(epoch)
+
+    def begin(self, sql: str, query_id: str = ""):
+        _atomic_write_json(inflight_record_path(self.fleet_dir), {
+            "digest": statement_digest(sql),
+            "sql": str(sql)[:500],
+            "query_id": str(query_id),
+            "epoch": self.epoch,
+            "started": time.time(),
+        })
+        return sql
+
+    def end(self, token) -> None:
+        _atomic_write_json(inflight_record_path(self.fleet_dir), {})
+
+
 class FleetSupervisor:
     """Monitor thread over a FleetServer's subprocess tree."""
 
     def __init__(self, fleet, probe_interval_s: float = 0.5,
                  probe_timeout_s: float = 2.0, stall_probes: int = 6,
                  worker_respawn_max: int = 3,
-                 respawn_backoff_s: float = 0.25):
+                 respawn_backoff_s: float = 0.25,
+                 poison_crash_threshold: int =
+                 DEFAULT_POISON_CRASH_THRESHOLD,
+                 poison_ttl_s: float = DEFAULT_POISON_TTL_S):
         self.fleet = fleet
         self.probe_interval_s = probe_interval_s
         self.probe_timeout_s = probe_timeout_s
         self.stall_probes = stall_probes
         self.worker_respawn_max = worker_respawn_max
         self.respawn_backoff_s = respawn_backoff_s
+        self.poison_crash_threshold = max(1, int(poison_crash_threshold))
+        self.poison_ttl_s = float(poison_ttl_s)
         self.engine_restarts: Dict[str, int] = {"crash": 0, "stall": 0,
                                                 "planned": 0}
         self.worker_restarts = 0
         self.outage_seconds = 0.0
         self._probe_failures = 0
         self._worker_attempts: Dict[str, int] = {}
+        # per-statement-digest crash attribution + the poison ledger
+        # this supervisor has published (digest -> record)
+        self._digest_crashes: Dict[str, int] = {}
+        self.poisoned: Dict[str, dict] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
@@ -148,6 +248,8 @@ class FleetSupervisor:
         with self._lock:
             self.engine_restarts[kind] = \
                 self.engine_restarts.get(kind, 0) + 1
+        if kind in ("crash", "stall"):
+            self._attribute_crash(kind)
         self.write_record()
         backoff = self.respawn_backoff_s
         while not self._stop.is_set():
@@ -163,6 +265,44 @@ class FleetSupervisor:
         with self._lock:
             self.outage_seconds += time.monotonic() - t0
         self.write_record()
+
+    def _attribute_crash(self, kind: str) -> None:
+        """Crash/stall attribution: whatever statement digest the dead
+        engine stamped in flight takes the blame. The record is consumed
+        (cleared) so one death never counts twice; after
+        `poison_crash_threshold` correlated deaths the digest is
+        published to poison.json for workers to fast-fail."""
+        fleet_dir = self.fleet.fleet_dir
+        try:
+            with open(inflight_record_path(fleet_dir)) as fh:
+                rec = json.load(fh)
+        except (OSError, ValueError):
+            return
+        _atomic_write_json(inflight_record_path(fleet_dir), {})
+        digest = rec.get("digest") if isinstance(rec, dict) else None
+        if not digest:
+            return
+        with self._lock:
+            n = self._digest_crashes.get(digest, 0) + 1
+            self._digest_crashes[digest] = n
+            already = digest in self.poisoned
+            if n >= self.poison_crash_threshold and not already:
+                self.poisoned[digest] = {
+                    "until": time.time() + self.poison_ttl_s,
+                    "crashes": n,
+                    "last_kind": kind,
+                    "sql": rec.get("sql", ""),
+                    "query_id": rec.get("query_id", ""),
+                }
+            publish = dict(self.poisoned)
+        if n >= self.poison_crash_threshold and not already:
+            _atomic_write_json(poison_path(fleet_dir), publish)
+            # log-once: publication is the single announcement — later
+            # fast-fails are per-query errors, not log spam
+            _LOG.warning(
+                "poison-statement quarantine: digest %s after %d "
+                "crash-correlated engine restarts (ttl %.0fs): %.120s",
+                digest, n, self.poison_ttl_s, rec.get("sql", ""))
 
     def _check_workers(self) -> None:
         fleet = self.fleet
@@ -197,6 +337,8 @@ class FleetSupervisor:
                       "worker_restarts": self.worker_restarts,
                       "outage_seconds": round(self.outage_seconds, 3),
                       "engine_epoch": self.fleet.engine_epoch,
+                      "poisoned": {d: dict(rec) for d, rec
+                                   in self.poisoned.items()},
                       "updated": time.time()}
         fleet_dir = self.fleet.fleet_dir
         try:

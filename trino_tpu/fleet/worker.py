@@ -198,8 +198,12 @@ class WorkerServer:
         # per-thread upstream connections to a DEAD generation retire
         self.counters = {"hits": 0, "hit_rows": 0, "forwarded": 0,
                          "quota_rejected": 0, "errors": 0,
-                         "deferred_misses": 0}
+                         "deferred_misses": 0, "poison_rejected": 0}
         self._counters_lock = threading.Lock()
+        # supervisor-published poison ledger, (mtime_ns, size)-cached so
+        # the per-statement check is one os.stat on the steady state
+        self._poison_cache: Dict[str, dict] = {}
+        self._poison_stamp: Optional[tuple] = None
         # cache-hit accounting batches -> engine (fleet-aggregated group
         # counters + sampled system.runtime.queries rows)
         self._pending_counts: Dict[str, int] = {}
@@ -454,6 +458,39 @@ class WorkerServer:
             qid, self.public_base, columns=cols, data=data,
             state="FINISHED", rows=entry.row_count, cpu_time_ms=0,
             processed_bytes=entry.output_bytes)
+
+    def _poison_fail(self, sql: str) -> Optional[tuple]:
+        """Poison-statement quarantine gate: a digest the supervisor
+        attributed K crash-correlated engine restarts to fast-fails
+        here with the NON-retryable STATEMENT_QUARANTINED answer —
+        letting it through would crash-loop the replacement engine.
+        Returns (status, payload) or None (statement is clean)."""
+        from trino_tpu.fleet import supervisor as sup
+        path = sup.poison_path(self.fleet_dir)
+        try:
+            st = os.stat(path)
+            stamp = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            self._poison_cache, self._poison_stamp = {}, None
+            return None
+        if stamp != self._poison_stamp:
+            self._poison_cache = sup.read_poison(self.fleet_dir)
+            self._poison_stamp = stamp
+        rec = self._poison_cache.get(sup.statement_digest(sql))
+        if rec is None or float(rec.get("until", 0)) <= time.time():
+            return None    # expired entries pass (bounded TTL)
+        with self._counters_lock:
+            self.counters["poison_rejected"] += 1
+        qid = f"{time.strftime('%Y%m%d')}_fleet_{uuid.uuid4().hex[:10]}"
+        return 200, protocol.query_results(
+            qid, self.public_base, state="FAILED",
+            error=protocol.error_json(
+                f"statement quarantined: this statement was in flight "
+                f"across {rec.get('crashes', 0)} crash-correlated "
+                f"engine restarts; retry after the quarantine TTL "
+                f"expires",
+                error_name="STATEMENT_QUARANTINED", error_code=65546,
+                error_type="INTERNAL_ERROR"))
 
     def _lookup(self, digest: bytes):
         """Hot local copy fast path with authoritative revalidation:
@@ -716,7 +753,13 @@ class WorkerServer:
             "processes respawned by the fleet supervisor.",
             "# TYPE trino_tpu_fleet_worker_restarts_total counter",
             f"trino_tpu_fleet_worker_restarts_total "
-            f"{record.get('worker_restarts', 0)}"]
+            f"{record.get('worker_restarts', 0)}",
+            "# HELP trino_tpu_fleet_poisoned_statements Statement "
+            "digests currently quarantined by the poison-statement "
+            "supervisor ledger.",
+            "# TYPE trino_tpu_fleet_poisoned_statements gauge",
+            f"trino_tpu_fleet_poisoned_statements "
+            f"{len(record.get('poisoned') or {})}"]
         return "\n".join(lines) + "\n"
 
     def _local_metrics(self) -> str:
@@ -760,6 +803,18 @@ class WorkerServer:
             lines.append(f"# HELP {name} {help_text}")
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name}{labels} {value}")
+        lines += [
+            "# HELP trino_tpu_fleet_shm_corrupt_total Shared-tier "
+            "records failing content-digest verification (each one a "
+            "counted miss, never an unpickle crash).",
+            "# TYPE trino_tpu_fleet_shm_corrupt_total counter",
+            f"trino_tpu_fleet_shm_corrupt_total{labels} "
+            f"{self.shared.stats.get('corrupt', 0)}",
+            "# HELP trino_tpu_fleet_poison_rejected_total Statements "
+            "fast-failed by the poison-statement quarantine.",
+            "# TYPE trino_tpu_fleet_poison_rejected_total counter",
+            f"trino_tpu_fleet_poison_rejected_total{labels} "
+            f"{counters.get('poison_rejected', 0)}"]
         drops = self.bus.drops_snapshot()
         if drops:
             lines.append("# HELP trino_tpu_fleet_bus_drops_total Bus "
@@ -876,6 +931,11 @@ class WorkerServer:
                         sql = self.rfile.read(length).decode()
                         lowered = {k.lower(): v
                                    for k, v in self.headers.items()}
+                        poisoned = worker._poison_fail(sql)
+                        if poisoned is not None:
+                            status, payload = poisoned
+                            self._send_json(payload, status)
+                            return
                         hit = worker._try_hit(sql, lowered)
                         if hit is not None:
                             status, payload = hit

@@ -202,6 +202,23 @@ SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     # query as files_pruned / row_groups_pruned. Set false to force
     # full-table reads (debugging / pruning-correctness comparisons).
     "lake_zone_maps_enabled": True,
+    # lake read-side content verification (connector/lake/): every data
+    # file carries a blake2b physical digest and every (row group,
+    # column) a canonical content digest, recorded at commit.
+    # "row_group" (default) re-hashes exactly the decoded chunks the
+    # scan touches; "file" additionally verifies the physical file bytes
+    # before decode; "off" trusts the bytes (the chaos suite proves
+    # "off" is how silent wrong answers happen). A mismatch raises
+    # classified LAKE_DATA_CORRUPTION and quarantines the file. Each
+    # (file content, chunk) is verified ONCE per process — a ledger
+    # keyed on (path, mtime_ns, size) skips re-hashing on warm scans;
+    # lake_fsck / bench --scrub re-verify every digest regardless.
+    "lake_verify_checksums": "row_group",
+    # retained manifest-log depth (the Iceberg metadata-pointer model):
+    # each commit writes an immutable manifest-<v>.json and swaps the
+    # pointer; the last N versions stay on disk as lake_fsck's rollback
+    # targets. Min 1 (the current version itself).
+    "lake_manifest_history": 8,
     # observability (obs/stats.py + obs/profiler.py): per-operator stats
     # collection for EVERY query on the session (EXPLAIN ANALYZE forces
     # it regardless). Since round 13 this does NOT split fused kernel
@@ -356,6 +373,21 @@ SERVER_PROPERTY_DOCS: Dict[str, str] = {
         "zero dropped queries — misses included). False swaps "
         "stop-then-bind: a brief miss outage covered by the workers' "
         "retry discipline.",
+    "lake_fsck gc_grace_s":
+        "lake_fsck(gc_grace_s=...): orphan data files (referenced by "
+        "NO retained manifest version) younger than this are never "
+        "collected (default 900s) — an open sink's staged files are "
+        "unreferenced until its commit.",
+    "poison_crash_threshold":
+        "FleetSupervisor: crash-correlated engine restarts attributed "
+        "to the same statement digest before that digest is "
+        "quarantined (default 2). Workers then fast-fail it with "
+        "non-retryable STATEMENT_QUARANTINED instead of letting one "
+        "query crash-loop the engine.",
+    "poison_ttl_s":
+        "FleetSupervisor: how long a poisoned statement digest stays "
+        "quarantined (default 300s); after the TTL workers let it "
+        "through again.",
 }
 
 

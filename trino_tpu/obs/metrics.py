@@ -601,6 +601,15 @@ def _engine_gauges():
                lk + "write-token replays detected (retried INSERT/CTAS "
                "attempts that no-op'd — the exactly-once proof).",
                ls["replayed_commits"], {})
+        yield ("trino_tpu_lake_corruption_detected",
+               lk + "read-side content-verification failures (file or "
+               "row-group digest mismatch, undecodable file) — each "
+               "classified LAKE_DATA_CORRUPTION, never silent wrong "
+               "rows.", ls["corruption_detected"], {})
+        yield ("trino_tpu_lake_files_quarantined",
+               lk + "data files in the per-process corruption "
+               "quarantine (fail-fast until lake_fsck clears them).",
+               ls["files_quarantined"], {})
     except Exception:   # lake import must never fail the scrape
         pass
 
