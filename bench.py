@@ -680,6 +680,164 @@ def run_lake(out_path=None) -> None:
             f.write(line + "\n")
 
 
+def run_mv(out_path=None) -> None:
+    """`bench.py --mv [OUT.json]`: the update-on-write cache-tier
+    report. Two instruments over one lake table and one incremental
+    materialized view:
+
+      refresh ratio   after a 1% append, DELTA refresh (merge only the
+                      manifest diff into stored partial states) vs a
+                      forced FULL recompute — acceptance: delta wall
+                      <= 10% of full wall
+      serving trickle a closed loop of 8 MV-rewritable aggregate
+                      queries under a 1-write-per-cycle INSERT trickle:
+                      update-on-write (refresh republishes the cached
+                      results) vs the invalidate-on-write baseline
+                      (every write floods the result cache, every
+                      query recomputes) — acceptance: >= 5x QPS with
+                      ZERO stale answers (every served row set equals
+                      the post-write oracle)
+
+    Always emits its final JSON line."""
+    platform = _ensure_backend()
+    payload = {"metric": "mv_update_on_write", "backend": platform}
+    try:
+        import trino_tpu
+        trino_tpu.enable_persistent_cache()
+        from trino_tpu.exec import LocalQueryRunner
+
+        runner = LocalQueryRunner.tpch("tiny")
+        # ~240k rows: doubling INSERTs over a 15k-row CTAS seed
+        runner.execute(
+            "CREATE TABLE lake.default.big AS SELECT o_orderstatus AS k,"
+            " o_totalprice AS v, o_orderkey AS n FROM orders")
+        for _ in range(4):
+            runner.execute("INSERT INTO lake.default.big "
+                           "SELECT k, v, n FROM lake.default.big")
+        base_rows = runner.execute(
+            "SELECT count(*) FROM lake.default.big").only_value()
+        payload["base_rows"] = int(base_rows)
+        delta_rows = max(1, base_rows // 100)
+        payload["delta_rows"] = int(delta_rows)
+
+        def delta_insert_sql(rows):
+            return ("INSERT INTO lake.default.big "
+                    "SELECT k, v, n FROM lake.default.big "
+                    f"LIMIT {rows}")
+
+        delta_insert = delta_insert_sql(delta_rows)
+
+        runner.execute(
+            "CREATE MATERIALIZED VIEW lake.default.mv_big AS "
+            "SELECT k, sum(v) AS s, count(*) AS c, min(v) AS lo, "
+            "max(v) AS hi, avg(v) AS a "
+            "FROM lake.default.big GROUP BY k")
+        refresh = "REFRESH MATERIALIZED VIEW lake.default.mv_big"
+        stats = runner._mv.stats[("lake", "default", "mv_big")]
+
+        def timed_refresh(mode, rows=delta_rows):
+            runner.execute(delta_insert_sql(rows))
+            runner.session.set("mv_refresh_mode", mode)
+            t0 = time.perf_counter()
+            runner.execute(refresh)
+            return time.perf_counter() - t0
+
+        timed_refresh("AUTO")           # warm the delta-merge kernels
+        delta_wall = timed_refresh("AUTO")
+        delta10_wall = timed_refresh("AUTO", rows=base_rows // 10)
+        timed_refresh("FULL")           # warm the full-recompute path
+        full_wall = timed_refresh("FULL")
+        assert stats["refreshes_delta"] >= 3, stats
+        payload["delta_refresh_wall_s"] = round(delta_wall, 4)
+        payload["delta10_refresh_wall_s"] = round(delta10_wall, 4)
+        payload["full_refresh_wall_s"] = round(full_wall, 4)
+        payload["refresh_ratio"] = round(delta_wall / full_wall, 4)
+        payload["refresh_ratio_10pct"] = round(
+            delta10_wall / full_wall, 4)
+        payload["refresh_ratio_ok"] = bool(
+            delta_wall <= 0.10 * full_wall)
+
+        # ---- serving under a write trickle --------------------------
+        queries = [
+            "SELECT k, sum(v) AS s FROM lake.default.big GROUP BY k "
+            "ORDER BY k",
+            "SELECT k, count(*) AS c FROM lake.default.big GROUP BY k "
+            "ORDER BY k",
+            "SELECT k, min(v) AS lo FROM lake.default.big GROUP BY k "
+            "ORDER BY k",
+            "SELECT k, max(v) AS hi FROM lake.default.big GROUP BY k "
+            "ORDER BY k",
+            "SELECT k, avg(v) AS a FROM lake.default.big GROUP BY k "
+            "ORDER BY k",
+            "SELECT k, sum(v) AS s, count(*) AS c FROM lake.default.big "
+            "GROUP BY k ORDER BY k",
+            "SELECT k, min(v) AS lo, max(v) AS hi FROM lake.default.big "
+            "GROUP BY k ORDER BY k",
+            "SELECT k, sum(v) AS s, avg(v) AS a FROM lake.default.big "
+            "GROUP BY k ORDER BY s DESC",
+        ]
+
+        def oracle_answers():
+            runner.session.set("mv_rewrite_enabled", False)
+            runner.session.set("result_cache_enabled", False)
+            out = [runner.execute(q).rows for q in queries]
+            runner.session.set("result_cache_enabled", True)
+            return out
+
+        def trickle(update_on_write, cycles=3, window_s=1.0):
+            runner.session.set("result_cache_enabled", True)
+            runner.session.set("mv_rewrite_enabled", update_on_write)
+            for q in queries:            # seed the cache tier
+                runner.execute(q)
+            served = 0
+            stale = 0
+            wall = 0.0
+            for _ in range(cycles):
+                t0 = time.perf_counter()
+                runner.execute(delta_insert)
+                if update_on_write:
+                    runner.session.set("mv_refresh_mode", "AUTO")
+                    runner.session.set("mv_rewrite_enabled", True)
+                    runner.execute(refresh)
+                answers = {}
+                i = 0
+                while time.perf_counter() - t0 < window_s:
+                    q = queries[i % len(queries)]
+                    answers.setdefault(q, []).append(
+                        runner.execute(q).rows)
+                    served += 1
+                    i += 1
+                wall += time.perf_counter() - t0
+                expected = oracle_answers()
+                runner.session.set(
+                    "mv_rewrite_enabled", update_on_write)
+                for q, exp in zip(queries, expected):
+                    for got in answers.get(q, ()):
+                        if got != exp:
+                            stale += 1
+            return served / wall, stale
+
+        baseline_qps, baseline_stale = trickle(update_on_write=False)
+        uow_qps, uow_stale = trickle(update_on_write=True)
+        payload["baseline_qps"] = round(baseline_qps, 2)
+        payload["update_on_write_qps"] = round(uow_qps, 2)
+        payload["qps_speedup"] = round(uow_qps / baseline_qps, 2)
+        payload["qps_speedup_ok"] = bool(uow_qps >= 5 * baseline_qps)
+        payload["stale_answers"] = int(uow_stale)
+        payload["baseline_stale_answers"] = int(baseline_stale)
+        payload["zero_stale"] = bool(uow_stale == 0)
+        payload["mv_stats"] = dict(stats)
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the line must print
+        payload["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+    line = json.dumps(payload)
+    print(line, flush=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+
+
 def run_scrub(out_path=None) -> None:
     """`bench.py --scrub [OUT.json]`: the data-integrity report.
 
@@ -1513,6 +1671,8 @@ if __name__ == "__main__":
         run_lake(sys.argv[2] if len(sys.argv) >= 3 else None)
     elif len(sys.argv) >= 2 and sys.argv[1] == "--scrub":
         run_scrub(sys.argv[2] if len(sys.argv) >= 3 else None)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--mv":
+        run_mv(sys.argv[2] if len(sys.argv) >= 3 else None)
     elif len(sys.argv) >= 2 and sys.argv[1] == "--qps":
         _qps_args = sys.argv[2:]
         _qps_workers = None

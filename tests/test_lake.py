@@ -81,6 +81,58 @@ def test_zone_map_row_group_pruning(runner):
     assert st["row_groups_pruned"] > 0, st
 
 
+def test_zone_map_or_predicate_pruning(runner):
+    """OR of single-column ranges extracts a multi-range TupleDomain:
+    a low-key OR high-key predicate prunes every middle row group."""
+    runner.execute(
+        "CREATE TABLE lake.default.li_or WITH (row_group_rows = 4096) AS "
+        "SELECT l_orderkey, l_extendedprice FROM lineitem")
+    got = runner.execute(
+        "SELECT count(*) FROM lake.default.li_or "
+        "WHERE l_orderkey < 100 OR l_orderkey > 59000")
+    st = dict(runner.last_query_stats)
+    exp = runner.execute(
+        "SELECT count(*) FROM lineitem "
+        "WHERE l_orderkey < 100 OR l_orderkey > 59000").only_value()
+    assert got.only_value() == exp
+    assert st["row_groups_pruned"] > 0, st
+
+
+def test_zone_map_in_list_pruning(runner):
+    """IN-list predicates extract a discrete-value TupleDomain and
+    prune row groups whose [min, max] misses every listed value."""
+    runner.execute(
+        "CREATE TABLE lake.default.li_in WITH (row_group_rows = 4096) AS "
+        "SELECT l_orderkey, l_extendedprice FROM lineitem")
+    got = runner.execute(
+        "SELECT count(*) FROM lake.default.li_in "
+        "WHERE l_orderkey IN (1, 2, 3)")
+    st = dict(runner.last_query_stats)
+    exp = runner.execute(
+        "SELECT count(*) FROM lineitem WHERE l_orderkey IN (1, 2, 3)"
+    ).only_value()
+    assert got.only_value() == exp
+    assert st["row_groups_pruned"] > 0, st
+
+
+def test_zone_map_or_equalities_prune_files(runner):
+    """OR of partition-key equalities prunes whole files: reading two
+    of three o_orderstatus partitions skips the third."""
+    runner.execute(
+        "CREATE TABLE lake.default.orders_or "
+        "WITH (partitioned_by = 'o_orderstatus') AS "
+        "SELECT * FROM orders")
+    got = runner.execute(
+        "SELECT count(*) FROM lake.default.orders_or "
+        "WHERE o_orderstatus = 'F' OR o_orderstatus = 'O'")
+    st = dict(runner.last_query_stats)
+    exp = runner.execute(
+        "SELECT count(*) FROM orders "
+        "WHERE o_orderstatus = 'F' OR o_orderstatus = 'O'").only_value()
+    assert got.only_value() == exp
+    assert st["files_pruned"] == 1, st
+
+
 def test_zone_maps_disabled_session_prop(runner):
     runner.execute(
         "CREATE TABLE lake.default.li_off WITH (row_group_rows = 4096) "

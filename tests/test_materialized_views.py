@@ -1,0 +1,293 @@
+"""Incremental materialized views (trino_tpu/mv/): the update-on-write
+cache tier.
+
+Acceptance shape: CREATE MATERIALIZED VIEW persists mergeable partial
+aggregate state plus the base-table manifest versions it folded;
+REFRESH after an append plans a DELTA merge over only the files added
+since those versions and commits atomically under the exactly-once
+write-token protocol (chaos-retried REFRESH lands once); eligible
+queries rewrite onto the storage table and answer oracle-identically;
+a refresh UPDATES the result-cache entries it backs (republish) instead
+of flushing them, and a view past the staleness budget is never served.
+"""
+
+import pytest
+
+from trino_tpu.connector.lake import lake_stats
+from trino_tpu.exec import LocalQueryRunner
+
+MV_DDL = ("CREATE MATERIALIZED VIEW lake.default.mv_o AS "
+          "SELECT k, sum(v) AS s, count(*) AS c, min(v) AS lo, "
+          "max(v) AS hi, avg(v) AS a "
+          "FROM lake.default.t GROUP BY k")
+
+ORACLE = ("SELECT k, sum(v) AS s, count(*) AS c, min(v) AS lo, "
+          "max(v) AS hi, avg(v) AS a FROM lake.default.t "
+          "GROUP BY k ORDER BY k")
+
+
+@pytest.fixture()
+def runner(tmp_path, monkeypatch):
+    # the MV registry unions every LIVE manager (weakset); collect the
+    # previous test's runner so its views don't bleed into this one
+    import gc
+    gc.collect()
+    monkeypatch.setenv("TRINO_TPU_LAKE_DIR", str(tmp_path / "lake"))
+    r = LocalQueryRunner.tpch("tiny")
+    r.execute("CREATE TABLE lake.default.t AS "
+              "SELECT o_orderstatus AS k, o_totalprice AS v, "
+              "o_orderkey AS n FROM orders")
+    return r
+
+
+def _stats(r, view="mv_o"):
+    return r._mv.stats[("lake", "default", view)]
+
+
+# ------------------------------------------------------------- create
+
+
+def test_create_persists_partial_state(runner):
+    runner.execute(MV_DDL)
+    exp = runner.execute(ORACLE).rows
+    got = runner.execute(
+        "SELECT k, s, c, lo, hi, a__s, a__c "
+        "FROM lake.default.__mv_mv_o ORDER BY k").rows
+    # sum/count/min/max states ARE the finals; avg stores sum+count
+    assert [r[:5] for r in got] == [r[:5] for r in exp]
+    assert [(r[5], r[6]) for r in got] == [(r[1], r[2]) for r in exp]
+    rows = runner.execute(
+        "SELECT incremental, staleness_s, base_versions FROM "
+        "system.runtime.materialized_views WHERE name = 'mv_o'").rows
+    assert rows == [(True, 0.0, '{"default.t": 2}')]
+
+
+def test_create_rejects_duplicates_and_unknown_drop(runner):
+    runner.execute(MV_DDL)
+    from trino_tpu.sql.analyzer import SemanticError
+    with pytest.raises(SemanticError):
+        runner.execute(MV_DDL)
+    assert runner.execute(MV_DDL.replace(
+        "CREATE MATERIALIZED VIEW",
+        "CREATE MATERIALIZED VIEW IF NOT EXISTS")).rows == [(True,)]
+    with pytest.raises(SemanticError):
+        runner.execute("DROP MATERIALIZED VIEW lake.default.nope")
+    assert runner.execute(
+        "DROP MATERIALIZED VIEW IF EXISTS lake.default.nope"
+    ).rows == [(True,)]
+
+
+# ------------------------------------------------------------ refresh
+
+
+def test_refresh_delta_after_append(runner):
+    runner.execute(MV_DDL)
+    runner.execute("INSERT INTO lake.default.t "
+                   "SELECT 'Z', 123.55, 900001")
+    runner.execute("INSERT INTO lake.default.t "
+                   "SELECT 'F', 10.00, 900002")
+    runner.execute("REFRESH MATERIALIZED VIEW lake.default.mv_o")
+    st = _stats(runner)
+    assert st["refreshes_delta"] == 1 and st["refreshes_full"] == 1
+    exp = runner.execute(ORACLE).rows
+    got = runner.execute(
+        "SELECT k, s, c, lo, hi, (a__s / a__c) "
+        "FROM lake.default.__mv_mv_o ORDER BY k").rows
+    assert got == exp
+
+
+def test_refresh_noop_when_bases_unchanged(runner):
+    runner.execute(MV_DDL)
+    assert runner.execute(
+        "REFRESH MATERIALIZED VIEW lake.default.mv_o").rows == [(0,)]
+    assert _stats(runner)["refreshes_noop"] == 1
+
+
+def test_refresh_full_mode_forced(runner):
+    runner.execute(MV_DDL)
+    runner.execute("INSERT INTO lake.default.t SELECT 'Z', 5.00, 900003")
+    runner.session.set("mv_refresh_mode", "FULL")
+    runner.execute("REFRESH MATERIALIZED VIEW lake.default.mv_o")
+    st = _stats(runner)
+    assert st["refreshes_full"] == 2 and st["refreshes_delta"] == 0
+    exp = runner.execute(ORACLE).rows
+    got = runner.execute(
+        "SELECT k, s, c, lo, hi, (a__s / a__c) "
+        "FROM lake.default.__mv_mv_o ORDER BY k").rows
+    assert got == exp
+
+
+def test_refresh_exactly_once_under_query_retry_chaos(runner):
+    """Chaos-armed REFRESH (fragment+scan+corrupt sites armed,
+    retry_policy=QUERY): the merge INSERT replays under its
+    deterministic write token and commits exactly once — partial
+    states equal the oracle, and the replayed attempt shows up in the
+    lake's replayed-commit counter or the refresh simply succeeded on
+    a later attempt with no double-fold."""
+    runner.execute(MV_DDL)
+    runner.execute("INSERT INTO lake.default.t "
+                   "SELECT 'Z', 77.25, 900004")
+    before = lake_stats()["replayed_commits"]
+    runner.session.set("fault_injection_rate", 0.5)
+    runner.session.set("fault_injection_seed", 1)
+    runner.session.set("fault_injection_sites", "fragment,scan,corrupt")
+    runner.session.set("retry_policy", "QUERY")
+    runner.session.set("retry_attempts", 8)
+    runner.execute("REFRESH MATERIALIZED VIEW lake.default.mv_o")
+    runner.session.set("fault_injection_rate", 0.0)
+    exp = runner.execute(ORACLE).rows
+    got = runner.execute(
+        "SELECT k, s, c, lo, hi, (a__s / a__c) "
+        "FROM lake.default.__mv_mv_o ORDER BY k").rows
+    assert got == exp, "chaos-retried refresh must not double-fold"
+    assert lake_stats()["replayed_commits"] >= before
+    # the recorded watermark advanced: next refresh is a no-op
+    assert runner.execute(
+        "REFRESH MATERIALIZED VIEW lake.default.mv_o").rows == [(0,)]
+
+
+def test_refresh_replays_as_noop_when_token_committed(runner):
+    """Direct replay: running the SAME refresh token twice (second via
+    a fresh statement after the first committed) must not double-apply.
+    The no-op path (recorded base versions == current) catches it
+    before planning."""
+    runner.execute(MV_DDL)
+    runner.execute("INSERT INTO lake.default.t SELECT 'Z', 1.00, 900005")
+    r1 = runner.execute("REFRESH MATERIALIZED VIEW lake.default.mv_o")
+    assert r1.rows[0][0] > 0
+    r2 = runner.execute("REFRESH MATERIALIZED VIEW lake.default.mv_o")
+    assert r2.rows == [(0,)]
+    got = runner.execute(
+        "SELECT c FROM lake.default.__mv_mv_o WHERE k = 'Z'").rows
+    assert got == [(1,)]
+
+
+# ------------------------------------------------------------ rewrite
+
+
+REWRITE_SWEEP = [
+    "SELECT k, sum(v) AS s FROM lake.default.t GROUP BY k ORDER BY k",
+    "SELECT k, count(*) AS c FROM lake.default.t GROUP BY k ORDER BY k",
+    "SELECT k, min(v) AS lo, max(v) AS hi FROM lake.default.t "
+    "GROUP BY k ORDER BY k",
+    "SELECT k, avg(v) AS a FROM lake.default.t GROUP BY k ORDER BY k",
+    "SELECT k, sum(v) AS s, avg(v) AS a, count(*) AS c "
+    "FROM lake.default.t GROUP BY k ORDER BY s DESC",
+    "SELECT k, sum(v) AS s FROM lake.default.t GROUP BY k "
+    "ORDER BY sum(v) DESC LIMIT 2",
+]
+
+NO_REWRITE = [
+    # WHERE not folded into the view definition
+    "SELECT k, sum(v) AS s FROM lake.default.t WHERE k = 'F' GROUP BY k",
+    # aggregate the view does not carry
+    "SELECT k, sum(n) AS s FROM lake.default.t GROUP BY k",
+    # finer grouping than the view's keys
+    "SELECT k, n, sum(v) AS s FROM lake.default.t GROUP BY k, n LIMIT 1",
+]
+
+
+def test_rewrite_oracle_parity_sweep(runner):
+    runner.execute(MV_DDL)
+    oracle = {}
+    runner.session.set("mv_rewrite_enabled", False)
+    for q in REWRITE_SWEEP + NO_REWRITE:
+        oracle[q] = runner.execute(q).rows
+    runner.session.set("mv_rewrite_enabled", True)
+    for q in REWRITE_SWEEP:
+        before = _stats(runner)["rewrite_hits"]
+        assert runner.execute(q).rows == oracle[q], q
+        assert _stats(runner)["rewrite_hits"] == before + 1, \
+            f"expected rewrite: {q}"
+    for q in NO_REWRITE:
+        before = _stats(runner)["rewrite_hits"]
+        assert runner.execute(q).rows == oracle[q], q
+        assert _stats(runner)["rewrite_hits"] == before, \
+            f"must not rewrite: {q}"
+
+
+def test_rewrite_blocked_past_staleness_budget(runner):
+    """An unfolded base commit older than mv_max_staleness_s makes the
+    view ineligible — the query falls back to the base table and stays
+    correct (zero stale answers)."""
+    runner.execute(MV_DDL)
+    runner.execute("INSERT INTO lake.default.t SELECT 'Z', 9.99, 900006")
+    runner.session.set("mv_max_staleness_s", 0.0)
+    q = "SELECT k, count(*) AS c FROM lake.default.t GROUP BY k ORDER BY k"
+    before = _stats(runner)["rewrite_hits"]
+    rows = runner.execute(q).rows
+    assert _stats(runner)["rewrite_hits"] == before
+    assert ("Z", 1) in [tuple(r) for r in rows]
+    # refresh folds the commit; the rewrite becomes eligible again
+    runner.execute("REFRESH MATERIALIZED VIEW lake.default.mv_o")
+    assert runner.execute(q).rows == rows
+    assert _stats(runner)["rewrite_hits"] == before + 1
+
+
+# ------------------------------------------------- update-on-write
+
+
+def test_refresh_republishes_cached_results(runner):
+    """The flipped cache tier: a cached MV-served result is UPDATED by
+    REFRESH (republish under the new generation), not invalidated — the
+    next hit serves the post-refresh answer from cache."""
+    runner.execute(MV_DDL)
+    runner.session.set("result_cache_enabled", True)
+    q = "SELECT k, sum(v) AS s FROM lake.default.t GROUP BY k ORDER BY k"
+    first = runner.execute(q).rows
+    assert _stats(runner)["rewrite_hits"] == 1
+    runner.execute("INSERT INTO lake.default.t "
+                   "SELECT 'Z', 50.00, 900007")
+    runner.execute("REFRESH MATERIALIZED VIEW lake.default.mv_o")
+    assert _stats(runner)["republished"] == 1
+    got = runner.execute(q).rows
+    assert got != first and ("Z", ) == tuple(
+        r[:1] for r in got if r[0] == "Z")[0]
+    exp = runner.execute(
+        "SELECT k, sum(v) AS s FROM lake.default.t "
+        "GROUP BY k ORDER BY k").rows
+    assert got == exp, "republished entry must be the fresh answer"
+
+
+# --------------------------------------------------------------- drop
+
+
+def test_drop_removes_storage_and_record(runner):
+    runner.execute(MV_DDL)
+    runner.execute("DROP MATERIALIZED VIEW lake.default.mv_o")
+    tables = {r[0] for r in runner.execute(
+        "SHOW TABLES FROM lake.default").rows}
+    assert "__mv_mv_o" not in tables
+    assert runner.execute(
+        "SELECT count(*) FROM system.runtime.materialized_views "
+        "WHERE name = 'mv_o'").only_value() == 0
+    # name is free again
+    runner.execute(MV_DDL)
+    runner.execute("DROP MATERIALIZED VIEW lake.default.mv_o")
+
+
+# ----------------------------------------------- non-incremental MV
+
+
+def test_non_incremental_definition_full_refresh(runner):
+    """A definition outside the mergeable subset (DISTINCT aggregate)
+    still materializes — storage holds finals, REFRESH is always a
+    full recompute, and no rewrite is offered."""
+    runner.execute(
+        "CREATE MATERIALIZED VIEW lake.default.mv_d AS "
+        "SELECT k, count(DISTINCT n) AS dn FROM lake.default.t "
+        "GROUP BY k")
+    rows = runner.execute(
+        "SELECT incremental FROM system.runtime.materialized_views "
+        "WHERE name = 'mv_d'").rows
+    assert rows == [(False,)]
+    runner.execute("INSERT INTO lake.default.t SELECT 'Z', 1.0, 900008")
+    runner.execute("REFRESH MATERIALIZED VIEW lake.default.mv_d")
+    st = _stats(runner, "mv_d")
+    assert st["refreshes_full"] == 2 and st["refreshes_delta"] == 0
+    exp = runner.execute(
+        "SELECT k, count(DISTINCT n) FROM lake.default.t "
+        "GROUP BY k ORDER BY k").rows
+    got = runner.execute(
+        "SELECT k, dn FROM lake.default.__mv_mv_d ORDER BY k").rows
+    assert got == exp

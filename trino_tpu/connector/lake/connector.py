@@ -49,6 +49,7 @@ in-memory snapshot, so retention never tears a running query.
 
 from __future__ import annotations
 
+import dataclasses
 import hashlib
 import json
 import math
@@ -56,6 +57,7 @@ import os
 import re
 import shutil
 import threading
+import time
 import uuid
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -523,6 +525,135 @@ class LakeMetadata(ConnectorMetadata):
             raise KeyError(f"lake table not found: {name}")
         return manifest
 
+    # ------------------------------------------------------- time travel
+
+    def retained_versions(self, name: SchemaTableName) -> List[int]:
+        """Manifest-log versions still on disk, newest first."""
+        out = []
+        try:
+            for entry in os.scandir(self.table_dir(name)):
+                m = _MANIFEST_V.match(entry.name)
+                if m:
+                    out.append(int(m.group(1)))
+        except OSError:
+            pass
+        return sorted(out, reverse=True)
+
+    def load_manifest_version(self, name: SchemaTableName,
+                              version: int) -> dict:
+        """Load a specific retained `manifest-<v>.json` snapshot.
+        Raises KeyError when the version was never committed or has been
+        pruned past `lake_manifest_history` (and is not MV-pinned)."""
+        version = int(version)
+        current = self._require(name)
+        if int(current.get("version", 0)) == version:
+            return current
+        vpath = self._version_path(name, version)
+        try:
+            with open(vpath, "rb") as f:
+                raw = f.read()
+        except OSError:
+            raise KeyError(
+                f"version {version} of lake table {name} is not "
+                f"retained (current is {current.get('version')}; older "
+                f"snapshots are pruned past lake_manifest_history)")
+        try:
+            return json.loads(raw)
+        except ValueError as e:
+            raise LakeDataCorruptionError(
+                f"lake manifest undecodable: {vpath} ({e}); run "
+                f"lake_fsck to roll back") from e
+
+    def resolve_version(self, name: SchemaTableName,
+                        version: Optional[int] = None,
+                        timestamp: Optional[float] = None) -> int:
+        """Resolve a time-travel pin to a committed manifest version.
+        `version` validates retention; `timestamp` (epoch seconds) picks
+        the newest retained version committed at or before it."""
+        if version is not None:
+            self.load_manifest_version(name, int(version))
+            return int(version)
+        assert timestamp is not None
+        best = None
+        for v in self.retained_versions(name):
+            m = self.load_manifest_version(name, v)
+            committed = float(m.get("committed_at") or 0.0)
+            if committed <= float(timestamp):
+                best = v if best is None else max(best, v)
+        if best is None:
+            raise KeyError(
+                f"no retained snapshot of lake table {name} committed "
+                f"at or before timestamp {timestamp}")
+        return best
+
+    def added_files(self, name: SchemaTableName, v_from: int,
+                    v_to: int) -> Optional[List[dict]]:
+        """Manifest delta: file entries added between `v_from` and
+        `v_to`. Append-only commits (INSERT) extend the file list, so
+        the diff is the suffix; returns None (`delta_unavailable`) when
+        either version is no longer retained or the diff is not a pure
+        append (rollback/rewrite commits)."""
+        v_from, v_to = int(v_from), int(v_to)
+        if v_from == v_to:
+            return []
+        if v_from > v_to:
+            return None
+        try:
+            m_from = self.load_manifest_version(name, v_from)
+            m_to = self.load_manifest_version(name, v_to)
+        except KeyError:
+            return None
+        from_paths = [e["path"] for e in m_from.get("files") or ()]
+        to_files = list(m_to.get("files") or ())
+        if [e["path"] for e in to_files[:len(from_paths)]] != from_paths:
+            return None
+        return to_files[len(from_paths):]
+
+    # ------------------------------------------------------------ MV pins
+
+    def mv_dir(self) -> str:
+        """Materialized-view records live beside the schemas as flat
+        JSON files (`_mv/<schema>.<view>.json`) — a directory of files,
+        so table discovery (which wants directories) skips it."""
+        return os.path.join(self.base_dir, "_mv")
+
+    def mv_pinned_versions(self, name: SchemaTableName) -> frozenset:
+        """Base-table manifest versions pinned as MV delta baselines.
+        Retention and fsck GC must keep these alive: a pruned baseline
+        forces the next REFRESH into a full recompute at best and a
+        torn delta at worst."""
+        pins = set()
+        key = f"{name.schema}.{name.table}"
+        try:
+            entries = list(os.scandir(self.mv_dir()))
+        except OSError:
+            return frozenset()
+        for entry in entries:
+            if not entry.name.endswith(".json"):
+                continue
+            try:
+                with open(entry.path, "rb") as f:
+                    rec = json.loads(f.read())
+            except (OSError, ValueError):
+                continue
+            # the LIVE watermark rides the storage table's manifest
+            # (committed atomically with the refresh's data swap); the
+            # record file only points at the storage table
+            st = rec.get("storage") or {}
+            try:
+                sm = self.load_manifest(
+                    SchemaTableName(st["schema"], st["table"]))
+            except Exception:
+                sm = None
+            bv = ((sm or {}).get("mv") or {}).get("base_versions") or {}
+            v = bv.get(key)
+            if v is not None:
+                try:
+                    pins.add(int(v))
+                except (ValueError, TypeError):
+                    pass
+        return frozenset(pins)
+
     def _swap_manifest(self, name: SchemaTableName, manifest: dict,
                        history: Optional[int] = None) -> None:
         """COMMIT: write the immutable `manifest-<v>.json`, then swap
@@ -533,6 +664,9 @@ class LakeMetadata(ConnectorMetadata):
         manifest SNAPSHOT via their split context, so pruning a file
         never tears a scan)."""
         version = int(manifest.get("version", 0))
+        # commit timestamp (epoch seconds) — the `FOR TIMESTAMP AS OF`
+        # resolution key; legacy manifests without it sort as 0
+        manifest["committed_at"] = time.time()
         vpath = self._version_path(name, version)
         raw = json.dumps(manifest).encode()
         tmp = f"{vpath}.tmp.{uuid.uuid4().hex[:8]}"
@@ -554,10 +688,15 @@ class LakeMetadata(ConnectorMetadata):
                           else self.manifest_history))
         floor = version - keep
         if floor >= 0:
+            # MV delta baselines are live references: a pinned version
+            # stays in the log (and its files stay fsck-referenced)
+            # until the next REFRESH advances the pin
+            pinned = self.mv_pinned_versions(name)
             try:
                 for entry in os.scandir(self.table_dir(name)):
                     m = _MANIFEST_V.match(entry.name)
-                    if m and int(m.group(1)) <= floor:
+                    if m and int(m.group(1)) <= floor \
+                            and int(m.group(1)) not in pinned:
                         os.remove(entry.path)
             except OSError:
                 pass
@@ -568,7 +707,9 @@ class LakeMetadata(ConnectorMetadata):
         out = {"default"}
         try:
             for entry in os.scandir(self.base_dir):
-                if entry.is_dir():
+                # underscore-prefixed dirs are engine metadata (`_mv`
+                # view records), not schemas
+                if entry.is_dir() and not entry.name.startswith("_"):
                     out.add(entry.name)
         except OSError:
             pass
@@ -606,9 +747,19 @@ class LakeMetadata(ConnectorMetadata):
     def partition_columns(self, name: SchemaTableName) -> List[str]:
         return list(self._require(name).get("partition_by") or [])
 
+    def manifest_for_handle(self, handle: ConnectorTableHandle) -> dict:
+        """The manifest snapshot a handle reads: the pinned version for
+        time-travel handles, else the current pointer."""
+        if getattr(handle, "version", None) is not None:
+            return self.load_manifest_version(handle.name, handle.version)
+        return self._require(handle.name)
+
     def get_table_statistics(self, handle: ConnectorTableHandle
                              ) -> TableStatistics:
-        m = self.load_manifest(handle.name)
+        try:
+            m = self.manifest_for_handle(handle)
+        except KeyError:
+            m = None
         if m is None:
             return TableStatistics.unknown()
         rows = float(sum(int(e["rows"]) for e in m.get("files", ())))
@@ -642,13 +793,12 @@ class LakeMetadata(ConnectorMetadata):
         # accept the domain as the file/row-group pruning hint; the
         # engine still applies the predicate row-wise (SPI contract)
         merged = handle.constraint.intersect(constraint)
-        return (ConnectorTableHandle(handle.name, merged, handle.limit),
-                constraint)
+        return (dataclasses.replace(handle, constraint=merged), constraint)
 
     def apply_limit(self, handle: ConnectorTableHandle, limit: int):
         if handle.limit is not None and handle.limit <= limit:
             return None
-        return ConnectorTableHandle(handle.name, handle.constraint, limit)
+        return dataclasses.replace(handle, limit=limit)
 
     # -------------------------------------------------------------- DDL
 
@@ -711,11 +861,13 @@ class LakeMetadata(ConnectorMetadata):
         built from the union of every file's values on first use, so
         codes are stable across files and pages (shared-dictionary
         kernels see ONE pool per scan)."""
+        scope = manifest.get("dict_scope")
         key = (name, int(manifest.get("version", 0)), column)
-        with self._lock:
-            d = self._dicts.get(key)
-        if d is not None:
-            return d
+        if scope is None:
+            with self._lock:
+                d = self._dicts.get(key)
+            if d is not None:
+                return d
         fmt = manifest["format"]
         group_rows = int(manifest.get("row_group_rows",
                                       F.DEFAULT_ROW_GROUP_ROWS))
@@ -737,12 +889,23 @@ class LakeMetadata(ConnectorMetadata):
         pool = np.unique(np.concatenate(values)) if values \
             else np.empty(0, dtype=object)
         d = Dictionary(np.asarray(pool, dtype=object))
+        if scope is not None:
+            # delta-restricted pools are one-shot (a refresh's scan);
+            # equal-valued rebuilds are deterministic, so codes stay
+            # consistent without polluting the versioned cache
+            return d
         with self._lock:
-            # keep only the current version's pools (old versions died
-            # with their manifest)
+            # bound the cache to a manifest_history-deep window per
+            # table: time-travel/delta scans of recent versions keep
+            # their pools; building a new version no longer evicts a
+            # concurrently-pinned snapshot's pool (deeper pins rebuild
+            # per scan rather than growing the cache unboundedly)
+            vers = [k[1] for k in self._dicts if k[0] == name]
+            floor = max(vers + [key[1]]) - self.manifest_history
             self._dicts = {k: v for k, v in self._dicts.items()
-                           if k[0] != name or k[1] == key[1]}
-            self._dicts[key] = d
+                           if k[0] != name or k[1] > floor}
+            if key[1] > floor:
+                self._dicts[key] = d
         return d
 
 
@@ -756,7 +919,26 @@ class LakeSplitManager(ConnectorSplitManager):
     def get_splits(self, handle: ConnectorTableHandle,
                    target_splits: int = 1) -> List[Split]:
         _begin_scan_stats()
-        manifest = self._metadata._require(handle.name)
+        # time-travel handles pin a committed snapshot; current-version
+        # handles read the pointer — either way the chosen manifest
+        # rides the splits, so the scan is byte-identical to ONE
+        # committed version regardless of concurrent writes
+        manifest = self._metadata.manifest_for_handle(handle)
+        delta_from = getattr(handle, "delta_from", None)
+        if delta_from is not None:
+            v_to = int(manifest.get("version", 0))
+            added = self._metadata.added_files(handle.name, delta_from,
+                                               v_to)
+            if added is None:
+                raise KeyError(
+                    f"lake manifest delta unavailable for "
+                    f"{handle.name}: versions {delta_from}..{v_to} "
+                    f"are not a retained pure append")
+            manifest = dict(manifest)
+            manifest["files"] = added
+            # delta snapshots must not share (table, version) dictionary
+            # pools with the full snapshot they were cut from
+            manifest["dict_scope"] = f"delta-{delta_from}-{v_to}"
         kept, pruned = eligible_files(manifest, handle.constraint)
         _count("files_pruned", pruned)
         parts = max(1, min(max(target_splits, 1), max(len(kept), 1)))
@@ -873,12 +1055,23 @@ class LakePageSink(ConnectorPageSink):
         self._staged: List[List] = [[] for _ in self._types]
         self._written: List[str] = []
         self._history: Optional[int] = None
+        self._replace = False
+        self._mv_meta: Optional[dict] = None
 
-    def set_commit_options(self, history: Optional[int] = None) -> None:
+    def set_commit_options(self, history: Optional[int] = None,
+                           replace: bool = False,
+                           mv_meta: Optional[dict] = None) -> None:
         """Executor hook: session `lake_manifest_history` for THIS commit
         (retained manifest-log depth). getattr-gated at the call site so
-        the SPI sink surface is unchanged."""
+        the SPI sink surface is unchanged. `replace` commits this write's
+        files as the table's ENTIRE file set (the MV refresh swap — prior
+        files stay on disk, referenced by retained manifest versions);
+        `mv_meta` is stamped into the committed manifest under `"mv"`, so
+        an MV's refresh watermark (base versions + refreshed_at) lands in
+        the SAME atomic pointer swap as its data."""
         self._history = None if history is None else max(1, int(history))
+        self._replace = bool(replace)
+        self._mv_meta = mv_meta
 
     def append_page(self, page: Page):
         n = int(page.num_rows)
@@ -982,7 +1175,13 @@ class LakePageSink(ConnectorPageSink):
                 _count("replayed_commits")
                 return
             manifest = dict(manifest)
-            manifest["files"] = list(manifest.get("files") or []) + entries
+            if self._replace:
+                manifest["files"] = entries
+            else:
+                manifest["files"] = \
+                    list(manifest.get("files") or []) + entries
+            if self._mv_meta is not None:
+                manifest["mv"] = self._mv_meta
             if self._token is not None:
                 tokens.append(self._token)
                 manifest["committed_tokens"] = \

@@ -278,7 +278,9 @@ def lake_fsck(metadata, repair: bool = True, deep: bool = True,
         schemas = []
     for schema in schemas:
         sdir = os.path.join(base, schema)
-        if not os.path.isdir(sdir):
+        # `_mv` (and any future underscore sibling) is metadata, not a
+        # schema: its flat record files are never GC candidates
+        if not os.path.isdir(sdir) or schema.startswith("_"):
             continue
         for table in sorted(os.listdir(sdir)):
             tdir = os.path.join(sdir, table)

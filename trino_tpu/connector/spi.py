@@ -75,6 +75,16 @@ class ConnectorTableHandle:
     name: SchemaTableName
     constraint: TupleDomain = TupleDomain.all()
     limit: Optional[int] = None
+    # Time travel: pinned manifest/snapshot version (`FOR VERSION AS OF`).
+    # None = current. Only versioned connectors (the lake) honor it; the
+    # planner rejects pins on connectors whose metadata lacks
+    # resolve_version support.
+    version: Optional[int] = None
+    # Delta scan (incremental MV refresh): with `version` = v_to, scan
+    # ONLY files added between delta_from and v_to (the manifest-log
+    # diff). Never set by SQL — the MV refresher pins it through the
+    # planner's scan-pin channel.
+    delta_from: Optional[int] = None
 
 
 @dataclasses.dataclass(frozen=True)

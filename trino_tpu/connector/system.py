@@ -91,6 +91,17 @@ TABLES: Dict[str, tuple] = {
     # properties through SHOW SESSION
     "server_properties": (
         ("name", T.VarcharType()), ("description", T.VarcharType())),
+    # the MV registry (trino_tpu/mv/): one row per materialized view
+    # across live runners — definition freshness (seconds of unfolded
+    # base history), the recorded base versions of the last refresh,
+    # and the refresh/rewrite/republish counters behind trino_tpu_mv_*
+    "materialized_views": (
+        ("catalog", T.VarcharType()), ("schema", T.VarcharType()),
+        ("name", T.VarcharType()), ("storage_table", T.VarcharType()),
+        ("incremental", T.BOOLEAN), ("refreshed_at", T.DOUBLE),
+        ("staleness_s", T.DOUBLE), ("base_versions", T.VarcharType()),
+        ("refreshes_delta", T.BIGINT), ("refreshes_full", T.BIGINT),
+        ("rewrite_hits", T.BIGINT), ("republished", T.BIGINT)),
 }
 
 
@@ -191,6 +202,9 @@ def _rows_for(table: str) -> List[tuple]:
     if table == "server_properties":
         from trino_tpu.metadata import SERVER_PROPERTY_DOCS
         return sorted(SERVER_PROPERTY_DOCS.items())
+    if table == "materialized_views":
+        from trino_tpu.mv.manager import all_materialized_view_rows
+        return all_materialized_view_rows()
     raise KeyError(table)
 
 

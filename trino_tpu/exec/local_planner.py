@@ -3394,9 +3394,20 @@ class LocalExecutionPlanner:
         conn = self.metadata.connector(node.catalog)
         sink = conn.page_sink(node.table, write_token=self.write_token)
         if hasattr(sink, "set_commit_options"):
-            # session manifest-log retention depth rides to the commit
-            sink.set_commit_options(history=int(self.session.get(
-                "lake_manifest_history")))
+            # session manifest-log retention depth rides to the commit;
+            # the MV refresher arms a replace-commit channel on the
+            # session (internal, never SQL-settable): when THIS write's
+            # target matches, the sink swaps the table's whole file set
+            # and stamps the refresh watermark in the same commit
+            opts = {"history": int(self.session.get(
+                "lake_manifest_history"))}
+            mv_commit = getattr(self.session, "_mv_commit", None)
+            if mv_commit is not None and mv_commit.get("table") == (
+                    node.catalog, node.table.name.schema,
+                    node.table.name.table):
+                opts["replace"] = bool(mv_commit.get("replace", True))
+                opts["mv_meta"] = mv_commit.get("mv_meta")
+            sink.set_commit_options(**opts)
 
         def gen():
             # idempotent-write protocol (connector/spi.py): pages STAGE

@@ -116,6 +116,12 @@ class LocalQueryRunner:
         from trino_tpu.exec.table_cache import TableCache
         self._table_cache = TableCache()
         self._plan_cache.add_invalidation_hook(self._invalidate_table_cache)
+        # materialized views (trino_tpu/mv/): lifecycle + rewrite +
+        # update-on-write republish. Shared with for_query() clones like
+        # the caches — its served-entry registry must see every clone's
+        # rewrite publishes so a refresh can update them all
+        from trino_tpu.mv.manager import MaterializedViewManager
+        self._mv = MaterializedViewManager(self)
         # streaming result sink for the CURRENT query (serve/streaming
         # ResultStream, installed per execute() by the server): pages
         # leave through the ring as they are produced; None = buffered
@@ -691,6 +697,12 @@ class LocalQueryRunner:
             return self._insert(stmt)
         if isinstance(stmt, t.DropTable):
             return self._drop_table(stmt)
+        if isinstance(stmt, t.CreateMaterializedView):
+            return self._mv.create(self, stmt)
+        if isinstance(stmt, t.RefreshMaterializedView):
+            return self._mv.refresh(self, stmt)
+        if isinstance(stmt, t.DropMaterializedView):
+            return self._mv.drop(self, stmt)
         if isinstance(stmt, t.Prepare):
             self._prepared[stmt.name.value] = stmt.statement
             return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
@@ -784,6 +796,9 @@ class LocalQueryRunner:
         col = self._collector
         if col is not None and col.operator_level:
             return False    # operator rows need a real execution
+        if getattr(self.session, "_mv_scan_pins", None):
+            return False    # version-pinned internal refresh scans must
+                            # never publish as the unpinned statement
         return statement_is_cacheable(query)
 
     def _result_cache_key(self, query: t.Query):
@@ -801,10 +816,16 @@ class LocalQueryRunner:
         concurrent invalidation raced the execution)."""
         from trino_tpu.serve.caches import CachedResult
         if not self._result_cache_eligible(query):
-            return self._execute_query(query)
+            return self._execute_query_rewritten(query)
         key = self._result_cache_key(query)
         entry = self._result_cache.get(key)
         col = self._collector
+        if entry is not None and not self._mv.entry_fresh(
+                self, key, entry):
+            # update-on-write tier: an MV-backed answer past the
+            # session's staleness budget re-executes instead of serving
+            # (a refresh normally republishes it before it ever ages out)
+            entry = None
         if entry is not None:
             if col is not None:
                 col.result_cache_hit()
@@ -821,7 +842,7 @@ class LocalQueryRunner:
         gen = self._result_cache.generation()
         self._cache_collect = max_rows
         try:
-            result = self._execute_query(query)
+            result = self._execute_query_rewritten(query, cache_key=key)
         finally:
             self._cache_collect = None
         tables = self._last_plan_tables
@@ -963,7 +984,11 @@ class LocalQueryRunner:
         time properties (hoist_literals, capacities, spill) re-apply per
         execution, so they never fragment the key."""
         from trino_tpu.exec.plan_cache import plan_tables
-        if not bool(self.session.get("plan_cache_enabled")):
+        if not bool(self.session.get("plan_cache_enabled")) \
+                or getattr(self.session, "_mv_scan_pins", None):
+            # pinned internal MV scans plan outside the cache: a
+            # version-pinned plan under an unpinned statement's key
+            # would serve stale snapshots to ordinary queries
             return self._plan_for_execution(query)
         key = self._plan_cache_key(query)
         plan = self._plan_cache.get(key)
@@ -1000,6 +1025,22 @@ class LocalQueryRunner:
         (stale handles and statistics must not outlive the change)."""
         self._plan_cache.invalidate(
             (qname.catalog, qname.schema, qname.table))
+
+    def _execute_query_rewritten(self, query: t.Query,
+                                 cache_key=None) -> MaterializedResult:
+        """Execute through the MV rewrite hook: when the statement
+        matches a registered fresh view, run the REWRITTEN query instead
+        (it scans the view's storage table, so the published cache entry
+        references storage — base inserts no longer invalidate it, the
+        view's REFRESH updates it: the update-on-write flip)."""
+        rw = self._mv.try_rewrite(self, query)
+        if rw is None:
+            return self._execute_query(query)
+        view_key, rewritten = rw
+        result = self._execute_query(rewritten)
+        if cache_key is not None:
+            self._mv.note_served(cache_key, view_key, rewritten)
+        return result
 
     def _execute_query(self, query: t.Query) -> MaterializedResult:
         plan = self._plan_query(query)

@@ -281,6 +281,192 @@ SESSION_PROPERTY_DEFAULTS: Dict[str, Any] = {
     # row budget binds)
     "slice_target_rows": 1 << 20,
     "slice_target_ms": 250,
+    # materialized views (trino_tpu/mv/): rewrite eligible aggregate
+    # queries onto a fresh-enough MV's stored state instead of scanning
+    # the base table — the update-on-write serving path. A rewrite only
+    # fires when the MV's refresh lag (base table's current version
+    # committed_at minus the version the MV last folded in) is within
+    # mv_max_staleness_s; 0 demands the MV be exactly current.
+    "mv_rewrite_enabled": True,
+    "mv_max_staleness_s": 60.0,
+    # REFRESH strategy: AUTO tries the manifest-delta incremental path
+    # and falls back to full recompute when the delta is unavailable
+    # (pruned baseline / non-append commit) or the view shape is
+    # non-incrementalizable; FULL always recomputes; DELTA fails
+    # instead of falling back (tests/bench determinism).
+    "mv_refresh_mode": "AUTO",
+}
+
+# One doc line per SESSION property — system.runtime surfaces and the
+# property-docs lint (tests/test_property_docs.py) key off this dict:
+# registering a property without documenting it fails CI.
+SESSION_PROPERTY_DOCS: Dict[str, str] = {
+    "join_distribution_type":
+        "Join build-side placement: AUTOMATIC (cost-based), BROADCAST, "
+        "or PARTITIONED. Plan-affecting (plan cache keys on it).",
+    "join_reordering_strategy":
+        "Join-order search: AUTOMATIC, ELIMINATE_CROSS_JOINS, or NONE. "
+        "Plan-affecting.",
+    "query_max_memory":
+        "Per-query device-memory reservation ceiling in bytes.",
+    "page_capacity":
+        "Rows per device page for operator pipelines.",
+    "scan_page_capacity":
+        "Max rows per scan page (big fused scans).",
+    "join_broadcast_threshold_rows":
+        "Estimated build rows at or below which AUTOMATIC join "
+        "distribution broadcasts. Plan-affecting.",
+    "probe_coalesce_rows":
+        "Coalesce filtered probe pages into buffers of ~this many rows "
+        "before join probes (fewer, larger kernel launches).",
+    "distributed_sort":
+        "Sort via per-shard runs + merge instead of a global sort. "
+        "Plan-affecting.",
+    "enable_dynamic_filtering":
+        "Build-side join key domains prune probe-side scans "
+        "(files/row groups) at runtime.",
+    "spill_enabled":
+        "Over-threshold join builds keep only sorted keys on device "
+        "and pay host gathers (HBM is the scarce resource).",
+    "join_spill_threshold_bytes":
+        "Build-side byte size that triggers the join spill path.",
+    "agg_spill_threshold_bytes":
+        "Partial-aggregation state bytes that trigger INTERMEDIATE "
+        "compaction and host hash-partition spill.",
+    "spill_partition_count":
+        "Hash partitions for spilled aggregation/join state.",
+    "sort_spill_threshold_bytes":
+        "Buffered sort input bytes that flush as host runs finished "
+        "by range partitions of the leading key.",
+    "adaptive_partial_agg":
+        "Partial aggregation walks full -> shrunken -> bypass modes "
+        "from the observed reduction ratio ('Partial Partial "
+        "Aggregates'); false pins classic full partials.",
+    "spill_max_recursion":
+        "Over-budget spill partitions repartition with fresh hash "
+        "salts up to this depth, then fall back to bounded chunking.",
+    "spill_heavy_key_limit":
+        "Heavy keys split into dedicated bounded paths per spill "
+        "partition (re-hashing cannot separate one key); 0 disables.",
+    "spill_max_bytes":
+        "Host-RAM budget for a query's spill stores; exceeding fails "
+        "EXCEEDED_SPILL_LIMIT. 0 = half of physical host RAM.",
+    "mxu_join_enabled":
+        "Route eligible joins as density-partitioned indicator matmuls "
+        "on the matrix unit (ops/join_mxu.py). Plan-affecting.",
+    "mxu_join_density_threshold":
+        "Minimum observed build-key density to take the matmul path; "
+        "sparser builds keep gather probes. Plan-affecting.",
+    "mxu_join_max_slots":
+        "Max key-span slots for MXU indicator tables (bounds per-page "
+        "matmul cost and HBM footprint). Plan-affecting.",
+    "retry_policy":
+        "Fault-tolerant execution: NONE fails fast, TASK retries "
+        "fragments, QUERY re-runs the whole statement.",
+    "retry_attempts":
+        "Max retry attempts under TASK/QUERY retry policies.",
+    "retry_initial_delay_ms":
+        "Base of the exponential retry backoff.",
+    "retry_max_delay_ms":
+        "Cap of the exponential retry backoff.",
+    "fault_injection_rate":
+        "Chaos harness: probability a declared fault site fires "
+        "(seeded per query); 0 disables injection.",
+    "fault_injection_seed":
+        "Chaos determinism: same seed + same statements = same faults.",
+    "fault_injection_sites":
+        "Comma list of armed fault sites (fragment,exchange,scan,spill,"
+        "memory,slice,engine,corrupt); empty = all.",
+    "write_token":
+        "Idempotent-write identity: a client retrying a failed "
+        "INSERT/CTAS sets the same token on both attempts and the "
+        "sink's committed-token ledger makes the replay exactly-once. "
+        "Empty = each execution is its own write (token = query id).",
+    "query_max_run_time":
+        "Deadline from queueing ('30s', '2m', bare seconds); empty = "
+        "unlimited.",
+    "query_max_execution_time":
+        "Deadline from planning start; empty = unlimited.",
+    "resource_group":
+        "Resource-group path for admission + weighted-fair scheduling.",
+    "cluster_memory_wait_ms":
+        "How long a reservation blocks for a low-memory-killer victim "
+        "before failing retryable CLUSTER_OUT_OF_MEMORY.",
+    "hoist_literals":
+        "Hoist numeric/date/decimal literals into runtime parameter "
+        "slots so literal variants share one XLA executable.",
+    "plan_cache_enabled":
+        "Reuse optimized plans for repeated statement shapes "
+        "(exec/plan_cache.py).",
+    "plan_cache_max_entries":
+        "Plan-cache LRU capacity (resized only by the owning runner).",
+    "result_cache_enabled":
+        "Serve repeated statements over unchanged tables from the "
+        "materialized result tier (serve/caches.py).",
+    "result_cache_max_entries":
+        "Result-cache LRU capacity.",
+    "result_cache_max_rows":
+        "Results larger than this many rows are never cached.",
+    "scan_cache_enabled":
+        "Stage raw connector pages on device for reuse by any query "
+        "over the same columns (byte-budgeted LRU).",
+    "table_cache_enabled":
+        "Promote frequently-scanned table columns into HBM across "
+        "queries (exec/table_cache.py).",
+    "table_cache_max_bytes":
+        "Byte budget for HBM-resident table columns.",
+    "table_cache_min_scans":
+        "Scans of one (table, columns) working set before promotion.",
+    "lake_zone_maps_enabled":
+        "Prune lake files/row groups via partition values + min/max "
+        "zone maps against the scan's TupleDomain.",
+    "lake_verify_checksums":
+        "Lake read verification: row_group (default) re-hashes decoded "
+        "chunks, file also verifies physical bytes, off trusts them.",
+    "lake_manifest_history":
+        "Retained manifest-log depth per lake table (rollback targets; "
+        "MV-pinned versions are kept beyond it). Min 1.",
+    "collect_operator_stats":
+        "Per-operator stats for every query on the session (EXPLAIN "
+        "ANALYZE forces it); costs a per-chain dispatch fence.",
+    "trace_export":
+        "Serialize the query's span tree as a Perfetto-loadable "
+        "Chrome trace at query end.",
+    "history_max_entries":
+        "Completed-query history ring size (owning runner's session).",
+    "mesh_execution":
+        "Co-schedule eligible fragment chains as one jitted shard_map "
+        "program with in-program collective exchanges.",
+    "partitioned_agg_min_ndv":
+        "Estimated group NDV at/above which GROUP BY repartitions by "
+        "key instead of gathering tiny partials to one shard "
+        "('Global Hash Tables Strike Back'). Plan-affecting.",
+    "skewed_exchange_enabled":
+        "Spread globally-heavy probe keys round-robin and replicate "
+        "their build rows (skew-aware repartition).",
+    "skew_heavy_key_limit":
+        "Top-k candidate slots per shard for in-program heavy-hitter "
+        "detection.",
+    "sliced_execution":
+        "Run long operators as row-budgeted preemptible slices with "
+        "cooperative cancel/checkpoint boundaries.",
+    "slice_target_rows":
+        "Initial rows-per-slice budget.",
+    "slice_target_ms":
+        "Wall target the slice EWMA retunes the row budget toward "
+        "(0 = static row budget).",
+    "mv_rewrite_enabled":
+        "Rewrite eligible aggregate queries onto a fresh-enough "
+        "materialized view's stored state (trino_tpu/mv/) — the "
+        "update-on-write serving path.",
+    "mv_max_staleness_s":
+        "Max refresh lag (seconds between the base table's current "
+        "commit and the version the MV last folded in) an MV rewrite "
+        "tolerates; 0 demands the MV be exactly current.",
+    "mv_refresh_mode":
+        "REFRESH MATERIALIZED VIEW strategy: AUTO (manifest-delta "
+        "incremental, full-recompute fallback), FULL (always "
+        "recompute), DELTA (fail instead of falling back).",
 }
 
 # SERVER- and FLEET-level properties (round 14): deployment knobs that
@@ -388,6 +574,57 @@ SERVER_PROPERTY_DOCS: Dict[str, str] = {
         "FleetSupervisor: how long a poisoned statement digest stays "
         "quarantined (default 300s); after the TTL workers let it "
         "through again.",
+    "host":
+        "TrinoServer/FleetServer: bind address (default 127.0.0.1).",
+    "port":
+        "TrinoServer/FleetServer: bind port; 0 picks an ephemeral "
+        "port (read it back from server.port).",
+    "listen_fd":
+        "TrinoServer: adopt an already-bound listening socket by file "
+        "descriptor instead of binding host:port — the SCM_RIGHTS "
+        "zero-drop restart handoff path.",
+    "max_queued":
+        "TrinoServer: queued-statement bound before new submissions "
+        "answer QUERY_QUEUE_FULL (default 200).",
+    "max_running":
+        "TrinoServer: concurrent running-statement bound; the "
+        "scheduler holds the rest queued (default 4).",
+    "keep":
+        "TrinoServer: finished-query records retained for the "
+        "status/results endpoints (default 200).",
+    "query_timeout_s":
+        "TrinoServer: wall-clock ceiling per statement; over-limit "
+        "queries cancel with EXCEEDED_TIME_LIMIT (default None).",
+    "schema":
+        "FleetServer: TPC-H schema the engine subprocess loads "
+        "(default 'tiny').",
+    "streaming":
+        "TrinoServer: stream result pages through the spooled ring "
+        "instead of materializing full results (default True).",
+    "stream_ring_chunks":
+        "TrinoServer: page slots in each streaming result ring "
+        "(producer backpressure depth).",
+    "stream_stall_timeout_s":
+        "TrinoServer: producer-side stall bound when a streaming "
+        "consumer stops fetching; on expiry the stream cancels "
+        "instead of wedging a worker.",
+    "plan_cache_max_entries":
+        "TrinoServer: process plan-cache capacity override.",
+    "history_max_entries":
+        "TrinoServer: completed-query history ring capacity "
+        "(system.runtime.completed_queries depth).",
+    "metrics_wall_buckets":
+        "TrinoServer: histogram bucket edges (ms) for the query wall "
+        "latency metric.",
+    "otlp_export":
+        "TrinoServer: OTLP span-export target for query traces "
+        "(endpoint URL, or a file path sink).",
+    "trace_dir":
+        "TrinoServer: directory for per-query JSON trace files "
+        "(default off).",
+    "compilation_cache_dir":
+        "TrinoServer: persistent XLA compilation cache directory — "
+        "restarts skip recompilation of warmed query shapes.",
 }
 
 

@@ -1,0 +1,7 @@
+"""Incremental materialized views over the lake's versioned manifest
+log: mergeable partial-state storage, delta refresh as one SQL merge
+INSERT pinned to the manifest diff, query rewrite onto fresh views, and
+update-on-write result-cache republish (see manager.py)."""
+
+from trino_tpu.mv.manager import (MaterializedViewManager,      # noqa: F401
+                                  all_materialized_view_rows)

@@ -1,0 +1,344 @@
+"""Materialized-view definitions: SQL rendering + incremental analysis.
+
+A view definition is kept as TEXT (rendered back from the parsed AST, so
+the record is independent of AST pickling) plus a structural spec when
+the shape is *incrementalizable*:
+
+    SELECT k1, .., SUM(x) AS s, .. FROM <one lake table> [WHERE p]
+    [GROUP BY k1, ..]
+
+with aggregates drawn from SUM / COUNT / COUNT(*) / MIN / MAX / AVG —
+exactly the mergeable-state subset: each aggregate decomposes into
+partial state columns whose merge is itself one of SUM/MIN/MAX, so a
+REFRESH can fold a *delta* scan's partial states into the stored states
+with one GROUP BY (AVG rides as a sum+count pair and is reassembled at
+rewrite time). Anything outside the shape still materializes, but every
+refresh is a full recompute and only textually-identical queries
+rewrite onto it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from trino_tpu.sql.analyzer import SemanticError
+from trino_tpu.sql import tree as t
+
+
+class MVUnsupportedError(SemanticError):
+    """Definition uses syntax the MV subsystem cannot persist."""
+
+
+# ------------------------------------------------------------- rendering
+#
+# Expressions carry __str__ on the AST nodes; relations and query bodies
+# do not (nothing else needs them), so the subset renderer lives here.
+
+def render_query(q: t.Query) -> str:
+    if q.with_ is not None:
+        raise MVUnsupportedError(
+            "materialized view definitions with WITH are not supported")
+    parts = [_render_body(q.body)]
+    parts += _render_tail(q.order_by, q.offset, q.limit)
+    return " ".join(p for p in parts if p)
+
+
+def _render_tail(order_by, offset, limit) -> List[str]:
+    out = []
+    if order_by:
+        out.append("ORDER BY " + ", ".join(str(s) for s in order_by))
+    if offset is not None:
+        out.append(f"OFFSET {offset}")
+    if limit is not None:
+        out.append(f"LIMIT {limit}")
+    return out
+
+
+def _render_body(body: t.QueryBody) -> str:
+    if isinstance(body, t.QuerySpecification):
+        return _render_spec(body)
+    if isinstance(body, t.SetOperation):
+        op = body.op + ("" if body.distinct else " ALL")
+        return (f"{_render_body(body.left)} {op} "
+                f"{_render_body(body.right)}")
+    if isinstance(body, t.Values):
+        return "VALUES " + ", ".join(str(r) for r in body.rows)
+    raise MVUnsupportedError(
+        f"unsupported query body in materialized view: "
+        f"{type(body).__name__}")
+
+
+def _render_spec(spec: t.QuerySpecification) -> str:
+    parts = ["SELECT"]
+    if spec.select.distinct:
+        parts.append("DISTINCT")
+    parts.append(", ".join(str(i) for i in spec.select.items))
+    if spec.from_ is not None:
+        parts.append("FROM " + _render_relation(spec.from_))
+    if spec.where is not None:
+        parts.append(f"WHERE {spec.where}")
+    if spec.group_by is not None:
+        parts.append("GROUP BY "
+                     + ("DISTINCT " if spec.group_by.distinct else "")
+                     + ", ".join(_render_grouping(el)
+                                 for el in spec.group_by.elements))
+    if spec.having is not None:
+        parts.append(f"HAVING {spec.having}")
+    parts += _render_tail(spec.order_by, spec.offset, spec.limit)
+    return " ".join(parts)
+
+
+def _render_grouping(el: t.GroupingElement) -> str:
+    if isinstance(el, t.SimpleGroupBy):
+        return ", ".join(str(e) for e in el.expressions)
+    if isinstance(el, t.Rollup):
+        return "ROLLUP (" + ", ".join(str(e) for e in el.expressions) + ")"
+    if isinstance(el, t.Cube):
+        return "CUBE (" + ", ".join(str(e) for e in el.expressions) + ")"
+    if isinstance(el, t.GroupingSets):
+        return "GROUPING SETS (" + ", ".join(
+            "(" + ", ".join(str(e) for e in s) + ")"
+            for s in el.sets) + ")"
+    raise MVUnsupportedError(
+        f"unsupported grouping element: {type(el).__name__}")
+
+
+def _render_relation(rel: t.Relation) -> str:
+    if isinstance(rel, t.Table):
+        out = str(rel.name)
+        if rel.version is not None:
+            out += f" FOR VERSION AS OF {rel.version}"
+        elif rel.timestamp is not None:
+            out += f" FOR TIMESTAMP AS OF {rel.timestamp}"
+        return out
+    if isinstance(rel, t.AliasedRelation):
+        cols = ""
+        if rel.column_names:
+            cols = " (" + ", ".join(c.value for c in rel.column_names) + ")"
+        return f"{_render_relation(rel.relation)} AS {rel.alias}{cols}"
+    if isinstance(rel, t.TableSubquery):
+        return f"({render_query(rel.query)})"
+    if isinstance(rel, t.Join):
+        left = _render_relation(rel.left)
+        right = _render_relation(rel.right)
+        if rel.join_type == "IMPLICIT":
+            return f"{left}, {right}"
+        if rel.join_type == "CROSS":
+            return f"{left} CROSS JOIN {right}"
+        out = f"{left} {rel.join_type} JOIN {right}"
+        if isinstance(rel.criteria, t.JoinOn):
+            out += f" ON {rel.criteria.expression}"
+        elif isinstance(rel.criteria, t.JoinUsing):
+            out += " USING (" + ", ".join(
+                c.value for c in rel.criteria.columns) + ")"
+        return out
+    if isinstance(rel, (t.QuerySpecification, t.SetOperation, t.Values)):
+        return f"({_render_body(rel)})"
+    raise MVUnsupportedError(
+        f"unsupported relation in materialized view: "
+        f"{type(rel).__name__}")
+
+
+# ------------------------------------------------- incremental analysis
+
+#: aggregate -> list of (state-column suffix, partial template, merge fn).
+#: Partial templates format with `arg`; the merge fn re-aggregates state
+#: columns across {stored state} UNION ALL {delta partials}. COUNT merges
+#: with SUM (a count of counts would be wrong); everything else merges
+#: with itself.
+_MERGEABLE: Dict[str, List[Tuple[str, str, str]]] = {
+    "sum":   [("", "SUM({arg})", "SUM")],
+    "count": [("", "COUNT({arg})", "SUM")],
+    "min":   [("", "MIN({arg})", "MIN")],
+    "max":   [("", "MAX({arg})", "MAX")],
+    "avg":   [("__s", "SUM({arg})", "SUM"),
+              ("__c", "COUNT({arg})", "SUM")],
+}
+
+
+def _select_item_name(item: t.SingleColumn, i: int) -> str:
+    """The column name direct execution gives this item (planner
+    naming: alias > identifier > dereference field > _col<i>)."""
+    if item.alias is not None:
+        return item.alias.value
+    if isinstance(item.expression, t.Identifier):
+        return item.expression.value
+    if isinstance(item.expression, t.DereferenceExpression):
+        return item.expression.field.value
+    return f"_col{i}"
+
+
+def _agg_call(expr: t.Expression) -> Optional[Tuple[str, Optional[str]]]:
+    """(func, arg SQL text or None for COUNT(*)) when `expr` is one bare
+    mergeable aggregate call; None otherwise."""
+    if not isinstance(expr, t.FunctionCall):
+        return None
+    if expr.distinct or expr.filter is not None or expr.window is not None:
+        return None
+    func = expr.name.suffix.lower()
+    if func not in _MERGEABLE:
+        return None
+    if len(expr.args) == 0 or (len(expr.args) == 1 and
+                               isinstance(expr.args[0], t.AllColumns)):
+        return ("count", "*") if func == "count" else None
+    if len(expr.args) != 1:
+        return None
+    arg = expr.args[0]
+    if isinstance(arg, t.AllColumns):
+        return None
+    # nested aggregates (sum(sum(x))) are invalid SQL anyway; a plain
+    # scalar expression over base columns is fine — partials evaluate it
+    # per delta row exactly as the full query would
+    for inner in _find_calls(arg):
+        if inner.name.suffix.lower() in _MERGEABLE:
+            return None
+    return func, str(arg)
+
+
+def _find_calls(expr) -> List[t.FunctionCall]:
+    out: List[t.FunctionCall] = []
+    stack = [expr]
+    while stack:
+        x = stack.pop()
+        if isinstance(x, t.FunctionCall):
+            out.append(x)
+        if dataclasses.is_dataclass(x) and isinstance(x, t.Node):
+            stack.extend(getattr(x, f.name)
+                         for f in dataclasses.fields(x))
+        elif isinstance(x, (tuple, list)):
+            stack.extend(x)
+    return out
+
+
+def analyze_incremental(query: t.Query) -> Optional[dict]:
+    """The structural spec when `query` fits the mergeable-aggregate
+    shape, else None (the view falls back to full-recompute refresh).
+
+    Returned spec (JSON-serializable, persisted in the view record):
+      keys:  [{expr, out}]           group-by expressions + output names
+      aggs:  [{out, func, arg, state: [{col, partial, merge}]}]
+      where: predicate SQL or None
+      base:  the single source table's name parts (unresolved)
+    """
+    if query.with_ is not None or query.order_by or \
+            query.offset is not None or query.limit is not None:
+        return None
+    spec = query.body
+    if not isinstance(spec, t.QuerySpecification):
+        return None
+    if spec.select.distinct or spec.having is not None or spec.order_by \
+            or spec.offset is not None or spec.limit is not None:
+        return None
+    if not isinstance(spec.from_, t.Table) or spec.from_.version is not None \
+            or spec.from_.timestamp is not None:
+        return None
+    group_exprs: List[str] = []
+    if spec.group_by is not None:
+        if spec.group_by.distinct:
+            return None
+        for el in spec.group_by.elements:
+            if not isinstance(el, t.SimpleGroupBy):
+                return None
+            group_exprs.extend(str(e) for e in el.expressions)
+    keys: List[dict] = []
+    aggs: List[dict] = []
+    outs = set()        # view output names (must be unique)
+    cols = set()        # storage column names (keys + state columns;
+                        # a non-AVG agg's state column IS its output)
+    for i, item in enumerate(spec.select.items):
+        if not isinstance(item, t.SingleColumn):
+            return None
+        out = _select_item_name(item, i)
+        if out in outs:
+            return None
+        outs.add(out)
+        expr_text = str(item.expression)
+        if expr_text in group_exprs:
+            if out in cols:
+                return None
+            cols.add(out)
+            keys.append({"expr": expr_text, "out": out})
+            continue
+        agg = _agg_call(item.expression)
+        if agg is None:
+            return None
+        func, arg = agg
+        state = [{"col": f"{out}{suffix}",
+                  "partial": template.format(arg=arg),
+                  "merge": merge}
+                 for suffix, template, merge in _MERGEABLE[func]]
+        if any(s["col"] in cols for s in state):
+            return None
+        cols.update(s["col"] for s in state)
+        aggs.append({"out": out, "func": func, "arg": arg,
+                     "state": state})
+    # every group key must be selected: the merge GROUP BY needs the key
+    # columns materialized in storage
+    if set(group_exprs) != {k["expr"] for k in keys}:
+        return None
+    if not aggs:
+        return None        # pure projection/dedup: nothing to merge
+    return {"keys": keys, "aggs": aggs,
+            "where": None if spec.where is None else str(spec.where),
+            "base": list(spec.from_.name.parts)}
+
+
+# ------------------------------------------------------ SQL generation
+
+def storage_columns(rec: dict) -> List[str]:
+    """Storage-table column names in layout order: keys, then state."""
+    out = [k["out"] for k in rec["keys"]]
+    for a in rec["aggs"]:
+        out.extend(s["col"] for s in a["state"])
+    return out
+
+
+def partial_select(rec: dict, base_sql: str) -> str:
+    """`SELECT keys, partial-states FROM <base> [WHERE] GROUP BY keys` —
+    the storage layout. Used by the initial CTAS, full refresh, and the
+    delta branch of the incremental merge (the delta scan is the same
+    query with the base pinned to the manifest-log diff)."""
+    items = [f'{k["expr"]} AS {k["out"]}' for k in rec["keys"]]
+    for a in rec["aggs"]:
+        items.extend(f'{s["partial"]} AS {s["col"]}' for s in a["state"])
+    sql = f"SELECT {', '.join(items)} FROM {base_sql}"
+    if rec.get("where"):
+        sql += f" WHERE {rec['where']}"
+    if rec["keys"]:
+        sql += " GROUP BY " + ", ".join(k["expr"] for k in rec["keys"])
+    return sql
+
+
+def merge_select(rec: dict, storage_sql: str, base_sql: str) -> str:
+    """The incremental-refresh merge: stored states UNION ALL delta
+    partials, re-aggregated by group key with each state's merge
+    function (sum-of-sums, sum-of-counts, min-of-mins)."""
+    items = [k["out"] for k in rec["keys"]]
+    for a in rec["aggs"]:
+        items.extend(f'{s["merge"]}({s["col"]}) AS {s["col"]}'
+                     for s in a["state"])
+    inner = (f"SELECT * FROM {storage_sql} UNION ALL "
+             f"{partial_select(rec, base_sql)}")
+    sql = f"SELECT {', '.join(items)} FROM ({inner}) u"
+    if rec["keys"]:
+        sql += " GROUP BY " + ", ".join(k["out"] for k in rec["keys"])
+    return sql
+
+
+def final_exprs(rec: dict, decimal_sums=frozenset()) -> Dict[str, str]:
+    """View output column -> expression over STORAGE columns (the
+    rewrite mapping). AVG reassembles from its sum/count pair: for a
+    DECIMAL sum (name in `decimal_sums`) plain division reproduces
+    AVG's decimal rounding; otherwise AVG returns DOUBLE, so cast."""
+    out = {k["out"]: k["out"] for k in rec["keys"]}
+    for a in rec["aggs"]:
+        if a["func"] == "avg":
+            s, c = (st["col"] for st in a["state"])
+            if s in decimal_sums:
+                out[a["out"]] = f"({s} / {c})"
+            else:
+                out[a["out"]] = f"(CAST({s} AS DOUBLE) / {c})"
+        else:
+            out[a["out"]] = a["state"][0]["col"]
+    return out

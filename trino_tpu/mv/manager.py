@@ -1,0 +1,765 @@
+"""Materialized-view lifecycle: create/refresh/drop, query rewrite, and
+the update-on-write serving tier.
+
+Durability model
+----------------
+A view's STATIC definition lives as a flat JSON record under the lake's
+`_mv/` directory (`<schema>.<view>.json`). Its DYNAMIC state — the base
+manifest versions folded in and the refresh timestamp — rides the
+storage table's OWN manifest under the `"mv"` key, committed in the
+same atomic pointer swap as the refreshed data files, so a crash can
+never separate "data merged" from "watermark advanced" (the
+double-merge hazard). The storage table is an ordinary lake table
+(`__mv_<view>`) holding the view's group keys plus mergeable partial
+states (definition.py).
+
+Refresh = one SQL INSERT
+------------------------
+Incremental refresh plans ONE statement:
+
+    INSERT INTO storage
+    SELECT keys, merge(states) FROM (
+        SELECT * FROM storage
+        UNION ALL
+        SELECT keys, partials FROM base GROUP BY keys   -- DELTA scan
+    ) u GROUP BY keys
+
+with the base scan pinned — through the planner's internal scan-pin
+channel — to the manifest-log diff (files added between the recorded
+and current versions), and the sink armed to REPLACE the storage file
+set and stamp the new watermark. The engine's own aggregation machinery
+does the merge; exactly-once rides the PR-8 write-token ledger with a
+deterministic token derived from the target base versions, so a QUERY
+retry that replays the whole refresh dedups at the sink.
+
+Update-on-write
+---------------
+Rewritten queries publish result-cache entries keyed on the ORIGINAL
+statement but referencing the STORAGE table — base-table inserts no
+longer invalidate them. REFRESH invalidates the storage table (plans,
+results, scan pages, device columns, fleet shm — the standard one-call
+fan-out), then RE-EXECUTES the rewritten statements it was serving and
+republishes fresh entries under generation guards, flipping the tier
+from invalidate-on-write to update-on-write. Entries are only ever
+served within `mv_max_staleness_s` of the bases: the hit path re-checks
+staleness against live manifests, so a served answer always matches a
+committed base snapshot inside the budget.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import threading
+import time
+import uuid
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+from trino_tpu import types as T
+from trino_tpu.connector.spi import SchemaTableName
+from trino_tpu.sql.analyzer import SemanticError
+from trino_tpu.sql import tree as t
+from trino_tpu.mv import definition as d
+
+#: live managers (one per owning LocalQueryRunner) — the
+#: system.runtime.materialized_views and metrics-gauge surface
+_MANAGERS: "weakref.WeakSet" = weakref.WeakSet()
+
+#: served-entry registry bound: rewritten statements remembered for
+#: republish after a refresh (an LRU of the hot serving set)
+_MAX_SERVED = 128
+
+
+def _counter(name: str, amount: int = 1, **labels) -> None:
+    from trino_tpu.obs import metrics as M
+    getattr(M, name).inc(amount, **labels)
+
+
+def _versioned_metadata(md) -> bool:
+    return hasattr(md, "resolve_version") and hasattr(md, "mv_dir")
+
+
+class MaterializedViewManager:
+    """Per-runner MV orchestrator (shared with for_query() clones, like
+    the plan cache). Holds no durable state of its own — records and
+    watermarks live in the lake — only the served-entry registry and
+    runtime counters."""
+
+    def __init__(self, owner=None):
+        self._lock = threading.RLock()
+        self._owner = None if owner is None else weakref.ref(owner)
+        # (catalog, schema, view) -> runtime stats
+        self.stats: Dict[tuple, Dict[str, Any]] = {}
+        # result-cache key -> {"view": (cat, sch, view), "query": AST}
+        self._served: Dict[Any, dict] = {}
+        # records cache: catalog -> (mv_dir mtime_ns, {(sch, view): rec})
+        self._records: Dict[str, Tuple[int, dict]] = {}
+        _MANAGERS.add(self)
+
+    # ---------------------------------------------------------- records
+
+    def _lake_metadata(self, runner, catalog: str):
+        md = runner.catalogs.get(catalog).metadata
+        if not _versioned_metadata(md):
+            raise SemanticError(
+                f"catalog '{catalog}' does not support materialized "
+                f"views (no versioned manifest log)")
+        return md
+
+    def _record_path(self, md, schema: str, view: str) -> str:
+        return os.path.join(md.mv_dir(), f"{schema}.{view}.json")
+
+    def load_records(self, runner, catalog: str) -> dict:
+        """{(schema, view): record} for one catalog, cached on the
+        `_mv/` directory mtime (record files are written atomically, so
+        a rename always bumps it)."""
+        try:
+            md = runner.catalogs.get(catalog).metadata
+        except KeyError:
+            return {}
+        if not _versioned_metadata(md):
+            return {}
+        mv_dir = md.mv_dir()
+        try:
+            stamp = os.stat(mv_dir).st_mtime_ns
+        except OSError:
+            return {}
+        with self._lock:
+            hit = self._records.get(catalog)
+            if hit is not None and hit[0] == stamp:
+                return hit[1]
+        out = {}
+        try:
+            entries = list(os.scandir(mv_dir))
+        except OSError:
+            entries = []
+        for entry in entries:
+            if not entry.name.endswith(".json"):
+                continue
+            try:
+                with open(entry.path, "rb") as f:
+                    rec = json.loads(f.read())
+                out[(rec["schema"], rec["name"])] = rec
+            except (OSError, ValueError, KeyError):
+                continue
+        with self._lock:
+            self._records[catalog] = (stamp, out)
+        return out
+
+    def _write_record(self, md, rec: dict) -> None:
+        os.makedirs(md.mv_dir(), exist_ok=True)
+        path = self._record_path(md, rec["schema"], rec["name"])
+        tmp = f"{path}.tmp.{uuid.uuid4().hex[:8]}"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+        with self._lock:
+            self._records.pop(rec["catalog"], None)
+
+    def _stats(self, key: tuple) -> Dict[str, Any]:
+        with self._lock:
+            return self.stats.setdefault(key, {
+                "refreshes_full": 0, "refreshes_delta": 0,
+                "refreshes_noop": 0, "rewrite_hits": 0,
+                "stale_served_misses": 0, "republished": 0})
+
+    # ----------------------------------------------------------- create
+
+    def create(self, runner, stmt: t.CreateMaterializedView):
+        from trino_tpu.exec.runner import MaterializedResult
+        from trino_tpu.serve.caches import statement_is_cacheable
+        qname = runner._resolve(stmt.name)
+        md = self._lake_metadata(runner, qname.catalog)
+        records = self.load_records(runner, qname.catalog)
+        existing = records.get((qname.schema, qname.table))
+        replaying = (qname.catalog, qname.schema, qname.table) in \
+            runner._created_tables
+        if existing is not None and not replaying:
+            if stmt.not_exists:
+                return MaterializedResult(
+                    ["result"], [T.BOOLEAN], [(True,)])
+            if not stmt.replace:
+                raise SemanticError(
+                    f"materialized view already exists: {qname}")
+            self._drop_storage(runner, existing)
+        if md.load_manifest(qname.schema_table) is not None:
+            raise SemanticError(
+                f"a table with this name already exists: {qname}")
+        if not statement_is_cacheable(stmt.query):
+            raise SemanticError(
+                "materialized view definition must be deterministic")
+        query = _qualify_tables(stmt.query, runner)
+        sql_text = d.render_query(query)
+        spec = d.analyze_incremental(query)
+        bases = self._resolve_bases(runner, query)
+        if not bases:
+            raise SemanticError(
+                "materialized view must read at least one table")
+        incremental = spec is not None and len(bases) == 1 and \
+            hasattr(runner.catalogs.get(bases[0]["catalog"]).metadata,
+                    "resolve_version")
+        rec = {
+            "catalog": qname.catalog, "schema": qname.schema,
+            "name": qname.table, "definition": sql_text,
+            "storage": {"schema": qname.schema,
+                        "table": f"__mv_{qname.table}"},
+            "bases": bases,
+            "incremental": incremental,
+            "spec": spec if incremental else None,
+            "created_at": time.time(),
+        }
+        # initial population is a FULL refresh as one CTAS: the engine
+        # infers the storage column types from the partial-state query,
+        # and the replace-commit channel stamps the watermark into the
+        # storage manifest's very first data commit
+        self._run_refresh(runner, rec, mode="full", create=True)
+        self._write_record(md, rec)
+        runner._created_tables.add(
+            (qname.catalog, qname.schema, qname.table))
+        return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
+
+    def _resolve_bases(self, runner, query: t.Query) -> List[dict]:
+        seen, out = set(), []
+        for node in t.walk(query):
+            if isinstance(node, t.Table):
+                q = runner._resolve(node.name)
+                key = (q.catalog, q.schema, q.table)
+                if key not in seen:
+                    seen.add(key)
+                    out.append({"catalog": q.catalog, "schema": q.schema,
+                                "table": q.table})
+        return out
+
+    # ---------------------------------------------------------- refresh
+
+    def refresh(self, runner, stmt: t.RefreshMaterializedView):
+        from trino_tpu.exec.runner import MaterializedResult
+        qname = runner._resolve(stmt.name)
+        self._lake_metadata(runner, qname.catalog)
+        rec = self.load_records(runner, qname.catalog).get(
+            (qname.schema, qname.table))
+        if rec is None:
+            raise SemanticError(
+                f"materialized view not found: {qname}")
+        mode = str(runner.session.get("mv_refresh_mode")).upper()
+        rows = self._run_refresh(
+            runner, rec, mode="full" if mode == "FULL" else "auto")
+        return MaterializedResult(["rows"], [T.BIGINT], [(rows,)])
+
+    def _base_versions(self, runner, rec: dict) -> Dict[str, int]:
+        """Current manifest version per VERSIONED base (the refresh
+        watermark's domain; non-versioned bases are unwatched)."""
+        out = {}
+        for b in rec["bases"]:
+            md = runner.catalogs.get(b["catalog"]).metadata
+            if not hasattr(md, "resolve_version"):
+                continue
+            name = SchemaTableName(b["schema"], b["table"])
+            out[f'{b["schema"]}.{b["table"]}'] = int(
+                md._require(name).get("version", 0))
+        return out
+
+    def _storage_watermark(self, runner, rec: dict) -> Optional[dict]:
+        md = self._lake_metadata(runner, rec["catalog"])
+        st = rec["storage"]
+        m = md.load_manifest(SchemaTableName(st["schema"], st["table"]))
+        return None if m is None else (m.get("mv") or None)
+
+    def _run_refresh(self, runner, rec: dict, mode: str,
+                     create: bool = False) -> int:
+        from trino_tpu.sql.parser import parse_statement
+        catalog = rec["catalog"]
+        st = rec["storage"]
+        storage_sql = f'{catalog}.{st["schema"]}.{st["table"]}'
+        view_key = (catalog, rec["schema"], rec["name"])
+        cur = self._base_versions(runner, rec)
+        watermark = None if create else self._storage_watermark(runner, rec)
+        recorded = (watermark or {}).get("base_versions") or {}
+        if not create and recorded and recorded == cur:
+            self._stats(view_key)["refreshes_noop"] += 1
+            _counter("MV_REFRESH_TOTAL", mode="noop")
+            return 0
+        # delta eligibility: incrementalizable shape, a recorded
+        # watermark for the single base, and a pure-append manifest-log
+        # diff still in retention — anything else falls back to full
+        use_delta = False
+        delta_pin = None
+        base = rec["bases"][0]
+        base_key = f'{base["schema"]}.{base["table"]}'
+        if mode != "full" and rec["incremental"] and not create and \
+                recorded.get(base_key) is not None:
+            md_base = runner.catalogs.get(base["catalog"]).metadata
+            v_from = int(recorded[base_key])
+            v_to = cur.get(base_key, 0)
+            added = md_base.added_files(
+                SchemaTableName(base["schema"], base["table"]),
+                v_from, v_to)
+            if added is not None:
+                use_delta = True
+                delta_pin = (v_from, v_to)
+        base_sql = f'{base["catalog"]}.{base["schema"]}.{base["table"]}'
+        pins: Dict[tuple, tuple] = {}
+        for b in rec["bases"]:
+            key = f'{b["schema"]}.{b["table"]}'
+            if key in cur:
+                pins[(b["catalog"], b["schema"], b["table"])] = \
+                    (None, cur[key])
+        if use_delta:
+            select = d.merge_select(rec["spec"], storage_sql, base_sql)
+            pins[(base["catalog"], base["schema"], base["table"])] = \
+                delta_pin
+        elif rec["incremental"]:
+            select = d.partial_select(rec["spec"], base_sql)
+        else:
+            select = rec["definition"]
+        if create:
+            sql = f"CREATE TABLE {storage_sql} AS {select}"
+        else:
+            sql = f"INSERT INTO {storage_sql} {select}"
+        meta = {"view": f'{rec["schema"]}.{rec["name"]}',
+                "base_versions": cur,
+                "refreshed_at": time.time(),
+                "mode": "delta" if use_delta else "full"}
+        token = "mv-refresh-{}.{}-{}".format(
+            rec["schema"], rec["name"],
+            "-".join(f"{k}={v}" for k, v in sorted(cur.items())))
+        t0 = time.perf_counter()
+        result = self._execute_armed(runner, parse_statement(sql),
+                                     pins, {
+            "table": (catalog, st["schema"], st["table"]),
+            "replace": True, "mv_meta": meta,
+        }, token)
+        wall = time.perf_counter() - t0
+        actual = "delta" if use_delta else "full"
+        self._stats(view_key)[f"refreshes_{actual}"] += 1
+        _counter("MV_REFRESH_TOTAL", mode=actual)
+        from trino_tpu.obs import metrics as M
+        M.MV_REFRESH_SECONDS_TOTAL.inc(wall)
+        if not create:
+            self._republish(runner, view_key)
+        rows = result.rows[0][0] if result.rows else 0
+        return int(rows or 0)
+
+    def _execute_armed(self, runner, stmt, pins, commit, token):
+        """Run one internal statement with the scan-pin + replace-commit
+        channels armed on the session and a deterministic write token
+        (stable across QUERY-retry replays: the sink's token ledger
+        makes the commit exactly-once)."""
+        session = runner.session
+        saved_token = runner._write_token
+        session._mv_scan_pins = pins
+        session._mv_commit = commit
+        runner._write_token = token
+        try:
+            return runner._execute_statement(stmt)
+        finally:
+            session._mv_scan_pins = None
+            session._mv_commit = None
+            runner._write_token = saved_token
+
+    # ------------------------------------------------------------- drop
+
+    def drop(self, runner, stmt: t.DropMaterializedView):
+        from trino_tpu.exec.runner import MaterializedResult
+        qname = runner._resolve(stmt.name)
+        md = self._lake_metadata(runner, qname.catalog)
+        rec = self.load_records(runner, qname.catalog).get(
+            (qname.schema, qname.table))
+        if rec is None:
+            if stmt.exists:
+                return MaterializedResult(
+                    ["result"], [T.BOOLEAN], [(True,)])
+            raise SemanticError(
+                f"materialized view not found: {qname}")
+        self._drop_storage(runner, rec)
+        try:
+            os.remove(self._record_path(md, qname.schema, qname.table))
+        except OSError:
+            pass
+        view_key = (qname.catalog, qname.schema, qname.table)
+        with self._lock:
+            self._records.pop(qname.catalog, None)
+            self.stats.pop(view_key, None)
+            self._served = {k: v for k, v in self._served.items()
+                            if v["view"] != view_key}
+        return MaterializedResult(["result"], [T.BOOLEAN], [(True,)])
+
+    def _drop_storage(self, runner, rec: dict) -> None:
+        md = runner.catalogs.get(rec["catalog"]).metadata
+        st = rec["storage"]
+        name = SchemaTableName(st["schema"], st["table"])
+        handle = md.get_table_handle(name)
+        if handle is not None:
+            md.drop_table(handle)
+        runner._plan_cache.invalidate(
+            (rec["catalog"], st["schema"], st["table"]))
+
+    # ---------------------------------------------------------- rewrite
+
+    def try_rewrite(self, runner, query: t.Query
+                    ) -> Optional[Tuple[tuple, t.Query]]:
+        """((catalog, schema, view), rewritten AST) when `query` matches
+        a registered incremental view that is fresh within the session's
+        staleness budget; None otherwise."""
+        session = runner.session
+        if not bool(session.get("mv_rewrite_enabled")):
+            return None
+        if getattr(session, "_mv_scan_pins", None):
+            return None     # never rewrite the refresher's own plans
+        if query.with_ is not None or \
+                not isinstance(query.body, t.QuerySpecification):
+            return None
+        spec = query.body
+        if not isinstance(spec.from_, t.Table) or \
+                spec.from_.version is not None or \
+                spec.from_.timestamp is not None:
+            return None
+        try:
+            base = runner._resolve(spec.from_.name)
+        except Exception:
+            return None
+        records = self.load_records(runner, base.catalog)
+        if not records:
+            return None
+        budget = float(session.get("mv_max_staleness_s"))
+        now = time.time()
+        for rec in records.values():
+            if not rec.get("incremental"):
+                continue
+            b = rec["bases"][0]
+            if (b["catalog"], b["schema"], b["table"]) != \
+                    (base.catalog, base.schema, base.table):
+                continue
+            rewritten = self._rewrite_onto(runner, rec, query)
+            if rewritten is None:
+                continue
+            if self._staleness_s(runner, rec, now) > budget:
+                _counter("MV_REWRITE_STALE_TOTAL")
+                continue
+            view_key = (rec["catalog"], rec["schema"], rec["name"])
+            self._stats(view_key)["rewrite_hits"] += 1
+            _counter("MV_REWRITE_HITS_TOTAL")
+            return view_key, rewritten
+        return None
+
+    def _decimal_sums(self, runner, rec: dict) -> frozenset:
+        """Names of AVG sum-state storage columns typed DECIMAL (their
+        finalizer divides without the to-DOUBLE cast, matching AVG)."""
+        avg_sums = {a["state"][0]["col"] for a in rec["spec"]["aggs"]
+                    if a["func"] == "avg"}
+        if not avg_sums:
+            return frozenset()
+        try:
+            md = runner.catalogs.get(rec["catalog"]).metadata
+            st = rec["storage"]
+            handle = md.get_table_handle(
+                SchemaTableName(st["schema"], st["table"]))
+            cols = md.get_table_metadata(handle).columns
+        except Exception:
+            return frozenset()
+        return frozenset(c.name for c in cols
+                         if c.name in avg_sums
+                         and isinstance(c.type, T.DecimalType))
+
+    def _rewrite_onto(self, runner, rec: dict, query: t.Query
+                      ) -> Optional[t.Query]:
+        from trino_tpu.sql.parser import parse_statement
+        spec = query.body
+        srec = rec["spec"]
+        if spec.having is not None or spec.select.distinct:
+            return None
+        where = None if spec.where is None else str(spec.where)
+        if where != srec.get("where"):
+            return None
+        group_exprs: List[str] = []
+        if spec.group_by is not None:
+            if spec.group_by.distinct:
+                return None
+            for el in spec.group_by.elements:
+                if not isinstance(el, t.SimpleGroupBy):
+                    return None
+                group_exprs.extend(str(e) for e in el.expressions)
+        key_exprs = {k["expr"] for k in srec["keys"]}
+        if set(group_exprs) != key_exprs:
+            return None
+        mapping = {k["expr"]: k["out"] for k in srec["keys"]}
+        finals = d.final_exprs(srec, self._decimal_sums(runner, rec))
+        for a in srec["aggs"]:
+            mapping[_agg_text(a)] = finals[a["out"]]
+        # map the select list; every item must land on a storage column
+        items: List[str] = []
+        out_names = set()
+        for i, item in enumerate(spec.select.items):
+            if not isinstance(item, t.SingleColumn):
+                return None
+            mapped = mapping.get(str(item.expression))
+            if mapped is None:
+                return None
+            name = d._select_item_name(item, i)
+            out_names.add(name)
+            items.append(f"{mapped} AS {name}")
+        order: List[str] = []
+        for s in tuple(spec.order_by or ()) + tuple(query.order_by or ()):
+            key_text = str(s.key)
+            if isinstance(s.key, t.Identifier) and key_text in out_names:
+                mapped = key_text       # output-alias reference
+            else:
+                mapped = mapping.get(key_text)
+            if mapped is None:
+                return None
+            suffix = "" if s.ascending else " DESC"
+            if s.nulls_first is True:
+                suffix += " NULLS FIRST"
+            elif s.nulls_first is False:
+                suffix += " NULLS LAST"
+            order.append(mapped + suffix)
+        offset = spec.offset if spec.offset is not None else query.offset
+        limit = spec.limit if spec.limit is not None else query.limit
+        st = rec["storage"]
+        sql = (f'SELECT {", ".join(items)} FROM '
+               f'{rec["catalog"]}.{st["schema"]}.{st["table"]}')
+        if order:
+            sql += " ORDER BY " + ", ".join(order)
+        if offset is not None:
+            sql += f" OFFSET {offset}"
+        if limit is not None:
+            sql += f" LIMIT {limit}"
+        try:
+            return parse_statement(sql)
+        except Exception:
+            return None
+
+    # -------------------------------------------------------- freshness
+
+    def _staleness_s(self, runner, rec: dict, now: float) -> float:
+        """Age of the oldest base commit NOT yet folded into the view
+        (0 when the view covers every committed version; +inf when the
+        watermark is missing or the oldest unfolded manifest was pruned
+        — conservative: unknown age must read as stale)."""
+        watermark = self._storage_watermark(runner, rec)
+        bv = (watermark or {}).get("base_versions") or {}
+        worst = 0.0
+        for b in rec["bases"]:
+            md = runner.catalogs.get(b["catalog"]).metadata
+            if not hasattr(md, "resolve_version"):
+                continue
+            name = SchemaTableName(b["schema"], b["table"])
+            try:
+                cur_v = int(md._require(name).get("version", 0))
+            except Exception:
+                return math.inf
+            pin = bv.get(f'{b["schema"]}.{b["table"]}')
+            if pin is None:
+                return math.inf
+            if cur_v <= int(pin):
+                continue
+            oldest = int(pin) + 1
+            age = math.inf
+            if oldest in md.retained_versions(name):
+                try:
+                    m = md.load_manifest_version(name, oldest)
+                    age = max(0.0, now - float(
+                        m.get("committed_at") or 0.0))
+                except Exception:
+                    age = math.inf
+            worst = max(worst, age)
+        return worst
+
+    def entry_fresh(self, runner, key, entry) -> bool:
+        """Result-cache hit gate: an entry backed by MV storage serves
+        only while its view is inside the staleness budget; anything
+        else is untouched (ordinary entries are invalidated on write,
+        so they are always exact)."""
+        backing = self._backing_views(runner, entry.tables)
+        if not backing:
+            return True
+        budget = float(runner.session.get("mv_max_staleness_s"))
+        now = time.time()
+        for view_key, rec in backing:
+            if self._staleness_s(runner, rec, now) > budget:
+                self._stats(view_key)["stale_served_misses"] += 1
+                _counter("MV_REWRITE_STALE_TOTAL")
+                return False
+        return True
+
+    def _backing_views(self, runner, tables) -> List[Tuple[tuple, dict]]:
+        out = []
+        for (catalog, schema, table) in tables or ():
+            if not table.startswith("__mv_"):
+                continue
+            rec = self.load_records(runner, catalog).get(
+                (schema, table[len("__mv_"):]))
+            if rec is not None and rec["storage"]["table"] == table:
+                out.append(((catalog, schema, rec["name"]), rec))
+        return out
+
+    # ------------------------------------------- update-on-write serving
+
+    def note_served(self, key, view_key: tuple, query: t.Query) -> None:
+        """Remember a rewritten statement published under `key`, so the
+        next REFRESH can re-execute it and UPDATE the entry in place."""
+        with self._lock:
+            self._served.pop(key, None)
+            self._served[key] = {"view": view_key, "query": query}
+            while len(self._served) > _MAX_SERVED:
+                self._served.pop(next(iter(self._served)))
+
+    def _republish(self, runner, view_key: tuple) -> None:
+        """After a refresh commit + storage invalidation: re-execute the
+        rewritten statements this view was serving and publish fresh
+        entries under the ORIGINAL keys. Generation snapshots are taken
+        before each re-execution, so a racing invalidation (the next
+        refresh, a DROP) still wins — same discipline as the normal
+        publish path."""
+        from trino_tpu.serve.caches import CachedResult
+        with self._lock:
+            entries = [(k, v["query"]) for k, v in self._served.items()
+                       if v["view"] == view_key]
+        if not entries:
+            return
+        max_rows = int(runner.session.get("result_cache_max_rows"))
+        saved_col = runner._collector
+        runner._collector = None    # keep the REFRESH's stats clean
+        try:
+            for key, query in entries:
+                gen = runner._result_cache.generation()
+                try:
+                    result = runner._execute_query(query)
+                except Exception:
+                    with self._lock:
+                        self._served.pop(key, None)
+                    continue
+                if result.reported_rows > max_rows:
+                    continue
+                runner._result_cache.put(
+                    key,
+                    CachedResult(tuple(result.column_names),
+                                 tuple(result.column_types),
+                                 tuple(result.rows),
+                                 result.reported_rows,
+                                 runner._last_output_nbytes,
+                                 frozenset(runner._last_plan_tables)),
+                    gen=gen)
+                self._stats(view_key)["republished"] += 1
+                _counter("MV_CACHE_REPUBLISH_TOTAL")
+        finally:
+            runner._collector = saved_col
+
+    # ---------------------------------------------------- observability
+
+    def rows(self) -> List[tuple]:
+        """system.runtime.materialized_views rows for this manager's
+        runner (None-safe when the runner is gone)."""
+        runner = None if self._owner is None else self._owner()
+        if runner is None:
+            return []
+        out = []
+        now = time.time()
+        for catalog in runner.catalogs.catalogs():
+            for rec in self.load_records(runner, catalog).values():
+                view_key = (rec["catalog"], rec["schema"], rec["name"])
+                stats = self._stats(view_key)
+                try:
+                    watermark = self._storage_watermark(runner, rec)
+                except Exception:
+                    watermark = None
+                try:
+                    staleness = self._staleness_s(runner, rec, now)
+                except Exception:
+                    staleness = math.inf
+                out.append((
+                    rec["catalog"], rec["schema"], rec["name"],
+                    rec["storage"]["table"], bool(rec["incremental"]),
+                    (watermark or {}).get("refreshed_at"),
+                    None if math.isinf(staleness) else staleness,
+                    json.dumps((watermark or {}).get("base_versions")
+                               or {}, sort_keys=True),
+                    stats["refreshes_delta"], stats["refreshes_full"],
+                    stats["rewrite_hits"], stats["republished"],
+                ))
+        return out
+
+
+# -------------------------------------------------------------- helpers
+
+def _agg_text(a: dict) -> str:
+    """The SQL text an AST aggregate call renders to (FunctionCall
+    __str__): the rewrite-matching key for this agg spec."""
+    if a["func"] == "count" and a["arg"] == "*":
+        return "count(*)"
+    return f'{a["func"]}({a["arg"]})'
+
+
+def _qualify_tables(query: t.Query, runner) -> t.Query:
+    """Rewrite every Table reference to its fully-qualified
+    catalog.schema.table form — the persisted definition must not
+    depend on the creating session's catalog/schema."""
+    def rebuild(node):
+        if isinstance(node, t.Table):
+            q = runner._resolve(node.name)
+            return dataclasses.replace(node, name=t.QualifiedName(
+                (q.catalog, q.schema, q.table)))
+        if dataclasses.is_dataclass(node) and isinstance(node, t.Node):
+            changes = {}
+            for f in dataclasses.fields(node):
+                v = getattr(node, f.name)
+                nv = rebuild_value(v)
+                if nv is not v:
+                    changes[f.name] = nv
+            return dataclasses.replace(node, **changes) if changes \
+                else node
+        return node
+
+    def rebuild_value(v):
+        if isinstance(v, tuple):
+            nv = tuple(rebuild_value(x) for x in v)
+            return nv if any(a is not b for a, b in zip(nv, v)) else v
+        if isinstance(v, t.Node):
+            return rebuild(v)
+        return v
+
+    return rebuild(query)
+
+
+def all_materialized_view_rows() -> List[tuple]:
+    """Union of every live manager's view rows, deduplicated by
+    (catalog, schema, view) — the system.runtime.materialized_views
+    surface."""
+    seen = set()
+    out = []
+    for mgr in list(_MANAGERS):
+        try:
+            rows = mgr.rows()
+        except Exception:
+            continue
+        for row in rows:
+            key = row[:3]
+            if key not in seen:
+                seen.add(key)
+                out.append(row)
+    return sorted(out, key=lambda r: r[:3])
+
+
+def _mv_gauges():
+    """Scrape-time staleness per view (labels: view) — the refresh-lag
+    alerting surface."""
+    for row in all_materialized_view_rows():
+        catalog, schema, name = row[:3]
+        staleness = row[6]
+        if staleness is not None:
+            yield ("trino_tpu_mv_staleness_seconds",
+                   "Age of the oldest base-table commit not yet folded "
+                   "into the materialized view.",
+                   float(staleness),
+                   {"view": f"{catalog}.{schema}.{name}"})
+
+
+def _register_gauges() -> None:
+    from trino_tpu.obs.metrics import REGISTRY
+    REGISTRY.register_gauges(_mv_gauges)
+
+
+_register_gauges()

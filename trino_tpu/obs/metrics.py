@@ -363,6 +363,26 @@ DEVICE_SECONDS_TOTAL = REGISTRY.counter(
     "Summed measured device wall attributed to queries (fused-chain "
     "dispatches fenced at chain granularity under operator-level "
     "collection).")
+MV_REFRESH_TOTAL = REGISTRY.counter(
+    "trino_tpu_mv_refresh_total",
+    "Materialized-view refreshes by mode: 'delta' = incremental merge "
+    "over the manifest-log diff, 'full' = complete recompute, 'noop' = "
+    "base versions unchanged since the last refresh.", labeled=True)
+MV_REFRESH_SECONDS_TOTAL = REGISTRY.counter(
+    "trino_tpu_mv_refresh_seconds_total",
+    "Summed wall-clock spent executing materialized-view refreshes.")
+MV_REWRITE_HITS_TOTAL = REGISTRY.counter(
+    "trino_tpu_mv_rewrite_hits_total",
+    "Queries rewritten onto a fresh materialized view's storage table.")
+MV_REWRITE_STALE_TOTAL = REGISTRY.counter(
+    "trino_tpu_mv_rewrite_stale_total",
+    "Rewrite/serve attempts refused because the view exceeded the "
+    "session's mv_max_staleness_s budget.")
+MV_CACHE_REPUBLISH_TOTAL = REGISTRY.counter(
+    "trino_tpu_mv_cache_republish_total",
+    "Result-cache entries UPDATED in place by a refresh (the "
+    "update-on-write flip: re-executed rewritten statements republished "
+    "under their original keys).")
 
 
 def set_wall_buckets(buckets) -> None:

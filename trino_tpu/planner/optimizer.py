@@ -909,6 +909,8 @@ class PushPredicateIntoTableScan(Rule):
         for p in conjuncts(node.predicate):
             extracted = _extract_domain(p, sym_to_col)
             if extracted is None:
+                extracted = _extract_or_domain(p, sym_to_col)
+            if extracted is None:
                 continue
             col, dom = extracted
             domains[col] = (domains[col].intersect(dom)
@@ -969,6 +971,47 @@ def _extract_domain(p: RowExpression, sym_to_col
     if op == "ge":
         return col, Domain.from_range(typ, Range.greater_equal(val))
     return None
+
+
+def _extract_or_domain(p: RowExpression, sym_to_col
+                       ) -> Optional[Tuple[str, Domain]]:
+    """Disjunctions over ONE column union into a multi-range domain:
+    `k IN (...)` (desugared to an OR-chain of eq by plan time) and ORed
+    range predicates like `(k >= 1 AND k < 5) OR k = 9`. Any branch that
+    constrains a different column — or nothing extractable — poisons the
+    whole disjunction (the OR is then not a row filter on one column)."""
+    if not (isinstance(p, SpecialForm) and p.kind is SpecialKind.OR):
+        return None
+    out_col: Optional[str] = None
+    out_dom: Optional[Domain] = None
+    stack = list(p.args)
+    while stack:
+        branch = stack.pop()
+        if isinstance(branch, SpecialForm) and \
+                branch.kind is SpecialKind.OR:
+            stack.extend(branch.args)
+            continue
+        # a branch may be a conjunctive range over the column
+        branch_dom: Optional[Domain] = None
+        for c in conjuncts(branch):
+            got = _extract_domain(c, sym_to_col) \
+                or _extract_or_domain(c, sym_to_col)
+            if got is None:
+                return None
+            col, d = got
+            if out_col is None:
+                out_col = col
+            elif col != out_col:
+                return None
+            branch_dom = d if branch_dom is None \
+                else branch_dom.intersect(d)
+        if branch_dom is None:
+            return None
+        out_dom = branch_dom if out_dom is None \
+            else out_dom.union(branch_dom)
+    if out_col is None or out_dom is None:
+        return None
+    return out_col, out_dom
 
 
 class PushLimitIntoTableScan(Rule):

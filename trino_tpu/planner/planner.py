@@ -331,6 +331,7 @@ class LogicalPlanner:
         handle = self.metadata.get_table_handle(qname)
         if handle is None:
             raise SemanticError(f"table not found: {qname}")
+        handle = self._pin_snapshot(rel, qname, handle)
         meta = self.metadata.get_table_metadata(qname.catalog, handle)
         columns = self.metadata.get_column_handles(qname.catalog, handle)
         assignments = []
@@ -341,6 +342,38 @@ class LogicalPlanner:
             fields.append(Field(col.name, qname.table, sym))
         node = TableScanNode(qname.catalog, handle, tuple(assignments))
         return RelationPlan(node, Scope(fields, outer))
+
+    def _pin_snapshot(self, rel: t.Table, qname, handle):
+        """Resolve time travel (`FOR VERSION|TIMESTAMP AS OF`) and the
+        MV refresher's internal scan pins into a version-pinned handle.
+        Scan pins ride the session as `_mv_scan_pins`:
+        {(catalog, schema, table): (v_from_or_None, v_to)} — never set
+        by SQL; the runner bypasses the plan/result caches while they
+        are armed."""
+        pins = getattr(self.session, "_mv_scan_pins", None) or {}
+        pin = pins.get((qname.catalog, qname.schema, qname.table))
+        if rel.version is None and rel.timestamp is None and pin is None:
+            return handle
+        conn = self.metadata.connector(qname.catalog)
+        resolve = getattr(conn.metadata, "resolve_version", None)
+        if resolve is None:
+            raise SemanticError(
+                f"catalog '{qname.catalog}' does not support versioned "
+                f"(time travel) reads")
+        if pin is not None:
+            delta_from, v_to = pin
+            return dataclasses.replace(handle, version=int(v_to),
+                                       delta_from=delta_from)
+        try:
+            if rel.version is not None:
+                v = resolve(qname.schema_table,
+                            version=_literal_version(rel.version))
+            else:
+                v = resolve(qname.schema_table,
+                            timestamp=_literal_timestamp(rel.timestamp))
+        except KeyError as e:
+            raise SemanticError(str(e))
+        return dataclasses.replace(handle, version=v)
 
     def _plan_values(self, rel: t.Values, outer) -> RelationPlan:
         rows = []
@@ -704,6 +737,31 @@ def _literal_count(e: t.Expression, what: str) -> int:
     raise SemanticError(f"{what} must be a literal integer")
 
 
+def _literal_version(e: t.Expression) -> int:
+    if isinstance(e, t.LongLiteral):
+        return int(e.value)
+    raise SemanticError(
+        "FOR VERSION AS OF expects a literal integer manifest version")
+
+
+def _literal_timestamp(e: t.Expression) -> float:
+    """FOR TIMESTAMP AS OF resolution: literal timestamp/string ->
+    epoch seconds (manifest `committed_at` scale)."""
+    from trino_tpu.planner.translate import _parse_timestamp
+    if isinstance(e, (t.TimestampLiteral, t.StringLiteral)):
+        text = e.text if isinstance(e, t.TimestampLiteral) else e.value
+        try:
+            return _parse_timestamp(text) / 1e6
+        except ValueError as err:
+            raise SemanticError(f"invalid timestamp: {text!r}") from err
+    if isinstance(e, (t.LongLiteral, t.DoubleLiteral)):
+        return float(e.value)  # epoch seconds
+    if isinstance(e, t.DecimalLiteral):
+        return float(e.text)   # epoch seconds with a fractional part
+    raise SemanticError(
+        "FOR TIMESTAMP AS OF expects a literal timestamp")
+
+
 class _PlanBuilder:
     """QueryPlanner's running (plan, translations) state."""
 
@@ -990,10 +1048,15 @@ class _PlanBuilder:
                 raise SemanticError(
                     f"'{expr_ast}' must be an aggregate expression or "
                     "appear in GROUP BY clause")
-            if isinstance(rx, SymbolRef):
+            if isinstance(rx, SymbolRef) and rx.name not in (
+                    f.symbol.name for f in fields):
                 sym = Symbol(rx.name, rx.type)
                 assigns.append((sym, rx))
             else:
+                # fresh symbol: non-trivial expression, or a second select
+                # item resolving to an already-projected symbol (e.g. the
+                # same aggregate under two aliases) — duplicate output
+                # symbols are rejected by the plan validator
                 sym = self.planner.symbols.new(name or "expr", rx.type)
                 assigns.append((sym, rx))
             fields.append(Field(name, None, sym))

@@ -148,6 +148,11 @@ class _Parser:
         if self.at_keyword("ANALYZE"):
             self.next()
             return t.Analyze(self.qualified_name())
+        if self.at_keyword("REFRESH"):
+            self.next()
+            self.expect_keyword("MATERIALIZED")
+            self.expect_keyword("VIEW")
+            return t.RefreshMaterializedView(self.qualified_name())
         self.error("unexpected statement")
 
     def explain(self) -> t.Explain:
@@ -217,6 +222,14 @@ class _Parser:
         if self.accept_keyword("OR"):
             self.expect_keyword("REPLACE")
             replace = True
+        if self.accept_keyword("MATERIALIZED"):
+            self.expect_keyword("VIEW")
+            not_exists = self._if_not_exists()
+            name = self.qualified_name()
+            props = self._with_properties()
+            self.expect_keyword("AS")
+            return t.CreateMaterializedView(
+                name, self.query(), replace, not_exists, props)
         if self.accept_keyword("VIEW"):
             name = self.qualified_name()
             self.expect_keyword("AS")
@@ -273,6 +286,9 @@ class _Parser:
     def drop(self) -> t.Statement:
         self.expect_keyword("DROP")
         kind = "VIEW" if self.accept_keyword("VIEW") else None
+        if kind is None and self.accept_keyword("MATERIALIZED"):
+            self.expect_keyword("VIEW")
+            kind = "MATERIALIZED VIEW"
         if kind is None:
             if self.accept_keyword("SCHEMA"):
                 kind = "SCHEMA"
@@ -284,6 +300,8 @@ class _Parser:
             self.expect_keyword("EXISTS")
             exists = True
         name = self.qualified_name()
+        if kind == "MATERIALIZED VIEW":
+            return t.DropMaterializedView(name, exists)
         if kind == "VIEW":
             return t.DropView(name, exists)
         if kind == "SCHEMA":
@@ -649,14 +667,35 @@ class _Parser:
             return t.Values(tuple(rows))
         if self.at_keyword("TABLE"):
             self.next()
-            return t.Table(self.qualified_name())
+            return self._table_reference()
         if self.at_keyword("LATERAL"):
             self.next()
             self.expect_op("(")
             query = self.query()
             self.expect_op(")")
             return t.TableSubquery(query)
-        return t.Table(self.qualified_name())
+        return self._table_reference()
+
+    def _table_reference(self) -> t.Table:
+        """Table name with optional time travel:
+        `name [FOR VERSION|TIMESTAMP AS OF <expr>]`."""
+        name = self.qualified_name()
+        version = timestamp = None
+        if self.accept_keyword("FOR"):
+            if self.accept_keyword("VERSION"):
+                which = "version"
+            elif self.accept_keyword("TIMESTAMP"):
+                which = "timestamp"
+            else:
+                self.error("expected VERSION or TIMESTAMP after FOR")
+            self.expect_keyword("AS")
+            self.expect_keyword("OF")
+            expr = self.expression()
+            if which == "version":
+                version = expr
+            else:
+                timestamp = expr
+        return t.Table(name, version, timestamp)
 
     # ------------------------------------------------------------ expressions
 

@@ -498,6 +498,11 @@ class AllColumns(Expression):
 @_d
 class Table(Relation):
     name: QualifiedName
+    # Time travel: `FOR VERSION AS OF <expr>` pins the scan to a committed
+    # manifest version; `FOR TIMESTAMP AS OF <expr>` resolves a commit
+    # timestamp to the newest version committed at or before it.
+    version: Optional[Expression] = None
+    timestamp: Optional[Expression] = None
 
     def __str__(self):
         return str(self.name)
@@ -763,6 +768,26 @@ class CreateView(Statement):
 
 @_d
 class DropView(Statement):
+    name: QualifiedName
+    exists: bool = False
+
+
+@_d
+class CreateMaterializedView(Statement):
+    name: QualifiedName
+    query: Query
+    replace: bool = False
+    not_exists: bool = False
+    properties: Tuple[Tuple[str, Expression], ...] = ()
+
+
+@_d
+class RefreshMaterializedView(Statement):
+    name: QualifiedName
+
+
+@_d
+class DropMaterializedView(Statement):
     name: QualifiedName
     exists: bool = False
 
